@@ -1,0 +1,138 @@
+"""Offline fleet demo: the minimum end-to-end slice.
+
+TPU-native equivalent of the reference's offline example
+(/root/reference/examples/kv_events/offline/main.go:129-173): two in-process
+publishers simulate vLLM-TPU pods streaming KVEvents over real ZMQ into the
+indexer's bound SUB socket; `get_pod_scores` then routes prompts to the pod
+with the longest cached prefix.
+
+Run: python examples/offline_demo.py
+"""
+
+import os
+import sys
+import tempfile
+import time
+import uuid
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.events import (
+    BlockRemoved,
+    BlockStored,
+    EventBatch,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.pool import EventPool, EventPoolConfig
+from llm_d_kv_cache_manager_tpu.kvevents.publisher import Publisher, make_topic
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPool,
+    TokenizersPoolConfig,
+)
+
+MODEL = "test-model"
+BLOCK_SIZE = 4
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "..", "tests", "fixtures", "test-model", "tokenizer.json"
+)
+
+
+def wait_for(predicate, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def main():
+    endpoint = f"ipc://{tempfile.gettempdir()}/kvdemo-{uuid.uuid4().hex[:8]}.sock"
+
+    indexer = Indexer(
+        config=IndexerConfig(
+            token_processor_config=TokenProcessorConfig(block_size=BLOCK_SIZE)
+        ),
+        tokenization_pool=TokenizationPool(
+            TokenizersPoolConfig(workers=2, local_tokenizer_files={MODEL: FIXTURE})
+        ),
+    )
+    indexer.run()
+
+    event_pool = EventPool(
+        EventPoolConfig(zmq_endpoint=endpoint, concurrency=2),
+        indexer.kv_block_index,
+        indexer.token_processor,
+    )
+    event_pool.start(with_subscriber=True)
+
+    shared_prefix = "The quick brown fox jumps over the lazy dog. " * 4
+    prompt = shared_prefix + "What does the fox say?"
+
+    print(f"[1] cold fleet: scores = {indexer.get_pod_scores(prompt, MODEL, [])}")
+
+    # pod-hot cached the full shared prefix; pod-warm only the first half.
+    enc = indexer.tokenizers_pool.tokenizer.encode(shared_prefix, MODEL)
+    n_blocks = len(enc.tokens) // BLOCK_SIZE
+    full_tokens = enc.tokens[: n_blocks * BLOCK_SIZE]
+    half_blocks = n_blocks // 2
+    half_tokens = enc.tokens[: half_blocks * BLOCK_SIZE]
+
+    hot = Publisher(endpoint, make_topic("pod-hot", MODEL))
+    warm = Publisher(endpoint, make_topic("pod-warm", MODEL))
+    time.sleep(0.3)  # ZMQ slow-joiner
+
+    hot.publish(
+        EventBatch(
+            ts=time.time(),
+            events=[BlockStored(list(range(1000, 1000 + n_blocks)), None, full_tokens, BLOCK_SIZE)],
+        )
+    )
+    warm.publish(
+        EventBatch(
+            ts=time.time(),
+            events=[
+                BlockStored(
+                    list(range(2000, 2000 + half_blocks)), None, half_tokens, BLOCK_SIZE
+                )
+            ],
+        )
+    )
+
+    ok = wait_for(
+        lambda: indexer.get_pod_scores(prompt, MODEL, []).get("pod-hot", 0) >= n_blocks
+    )
+    scores = indexer.get_pod_scores(prompt, MODEL, [])
+    print(f"[2] after events: scores = {scores}")
+    assert ok, "pod-hot never reached full-prefix score"
+    assert scores["pod-hot"] > scores.get("pod-warm", 0), "routing should prefer pod-hot"
+
+    best = max(scores, key=scores.get)
+    print(f"[3] route prompt -> {best}")
+
+    # pod-hot evicts its blocks; pod-warm should win the next score.
+    hot.publish(
+        EventBatch(
+            ts=time.time(),
+            events=[BlockRemoved(list(range(1000, 1000 + n_blocks)))],
+        )
+    )
+    ok = wait_for(
+        lambda: "pod-hot" not in indexer.get_pod_scores(prompt, MODEL, [])
+    )
+    scores = indexer.get_pod_scores(prompt, MODEL, [])
+    print(f"[4] after pod-hot eviction: scores = {scores}")
+    assert ok and "pod-warm" in scores
+
+    hot.close()
+    warm.close()
+    event_pool.shutdown()
+    indexer.shutdown()
+    print("OK: offline end-to-end slice works")
+
+
+if __name__ == "__main__":
+    main()

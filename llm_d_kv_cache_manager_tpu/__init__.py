@@ -1,0 +1,26 @@
+"""llm-d-kv-cache-manager-tpu: TPU-native KV-cache-aware routing control plane.
+
+A brand-new, TPU-first rebuild of the capabilities of
+llm-d/llm-d-kv-cache-manager (reference: /root/reference): a control plane
+that maintains a global, near-real-time index of KV-cache block locality
+across a fleet of vLLM-TPU pods (TPU-HBM / host-memory tiers) and scores
+candidate pods for incoming prompts by longest consecutive prefix of
+already-cached KV blocks.
+
+Layer map (mirrors reference SURVEY.md §1, re-designed Python/JAX/C++-native):
+  - kvcache/        orchestrator (Indexer.get_pod_scores), scorer, kvblock index
+  - kvevents/       msgpack KVEvents ingestion: ZMQ subscriber + sharded pool
+  - tokenization/   cached tokenizers + chunked prefix-token store + pool
+  - preprocessing/  chat-template rendering
+  - metrics/        Prometheus collectors + instrumented index decorator
+  - api/            gRPC + HTTP scoring services
+  - models/ ops/ parallel/ engine/   TPU-side: Pallas paged attention, a
+    paged-KV JAX engine that emits KVEvents (the in-repo vLLM-TPU stand-in),
+    mesh/sharding utilities and the kv_connectors data plane.
+"""
+
+__version__ = "0.1.0"
+
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+
+__all__ = ["Indexer", "IndexerConfig", "__version__"]

@@ -1,0 +1,20 @@
+from llm_d_kv_cache_manager_tpu.kvcache.backend import (
+    KVCacheBackendConfig,
+    default_kv_cache_backend_configs,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.scorer import (
+    KVBlockScorerConfig,
+    LongestPrefixScorer,
+    new_kv_block_scorer,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+
+__all__ = [
+    "KVCacheBackendConfig",
+    "default_kv_cache_backend_configs",
+    "KVBlockScorerConfig",
+    "LongestPrefixScorer",
+    "new_kv_block_scorer",
+    "Indexer",
+    "IndexerConfig",
+]

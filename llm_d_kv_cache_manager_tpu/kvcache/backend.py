@@ -1,0 +1,38 @@
+"""Device-tier backends and scoring weights.
+
+Parity target: KVCacheBackendConfig (/root/reference/pkg/kvcache/backend.go:19-31),
+retargeted to TPU tiers: a block resident in TPU **HBM** is worth full weight
+(served directly by the Pallas paged-attention kernel), a block offloaded to
+**host** memory is discounted (it must be DMA'd back over PCIe before use).
+The reference's gpu/cpu names are kept as aliases so events from GPU-era
+engines still score sensibly. Tier names are fully config-driven.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List
+
+
+@dataclass
+class KVCacheBackendConfig:
+    name: str
+    weight: float
+
+
+DEFAULT_TIER_HBM = "hbm"
+DEFAULT_TIER_HOST = "host"
+
+
+def default_kv_cache_backend_configs() -> List[KVCacheBackendConfig]:
+    return [
+        KVCacheBackendConfig(name=DEFAULT_TIER_HBM, weight=1.0),
+        KVCacheBackendConfig(name=DEFAULT_TIER_HOST, weight=0.8),
+        # Aliases for engines emitting GPU-era medium names.
+        KVCacheBackendConfig(name="gpu", weight=1.0),
+        KVCacheBackendConfig(name="cpu", weight=0.8),
+    ]
+
+
+def weight_map(configs: List[KVCacheBackendConfig]) -> Dict[str, float]:
+    return {c.name: c.weight for c in configs}

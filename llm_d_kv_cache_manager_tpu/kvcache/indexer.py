@@ -1,0 +1,127 @@
+"""Indexer orchestrator: the read path.
+
+Parity target: kvcache.Indexer (/root/reference/pkg/kvcache/indexer.go:62-166).
+`get_pod_scores` runs the four-stage read path:
+
+  1. tokenize the prompt (chat-template render → prefix-store shortcut →
+     full tokenization) via the tokenization pool,
+  2. convert tokens to chained KV-block keys (ChunkedTokenDatabase),
+  3. look the keys up in the KV-block index (which pods hold which blocks),
+  4. score pods by weighted longest consecutive cached prefix.
+
+The write plane (kvevents.Pool) is constructed separately and shares this
+Indexer's `kv_block_index` and token processor — index sharing is the only
+read/write coupling, as in the reference
+(/root/reference/examples/kv_events/online/main.go:115,248-258).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from llm_d_kv_cache_manager_tpu.kvcache.backend import (
+    KVCacheBackendConfig,
+    default_kv_cache_backend_configs,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index, IndexConfig, new_index
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.scorer import (
+    KVBlockScorerConfig,
+    new_kv_block_scorer,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPool,
+    TokenizersPoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.prefixstore.indexer import (
+    PrefixStoreConfig,
+    new_prefix_store,
+)
+from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
+
+logger = kvlog.get_logger("kvcache.indexer")
+
+
+@dataclass
+class IndexerConfig:
+    prefix_store_config: PrefixStoreConfig = field(default_factory=PrefixStoreConfig)
+    token_processor_config: TokenProcessorConfig = field(
+        default_factory=TokenProcessorConfig
+    )
+    kv_block_index_config: IndexConfig = field(default_factory=IndexConfig.default)
+    scorer_config: KVBlockScorerConfig = field(default_factory=KVBlockScorerConfig)
+    tokenizers_pool_config: TokenizersPoolConfig = field(
+        default_factory=TokenizersPoolConfig
+    )
+    backend_configs: List[KVCacheBackendConfig] = field(
+        default_factory=default_kv_cache_backend_configs
+    )
+
+
+class Indexer:
+    """KV-cache-aware pod scorer over a fleet of vLLM-TPU pods."""
+
+    def __init__(
+        self,
+        config: Optional[IndexerConfig] = None,
+        tokenization_pool: Optional[TokenizationPool] = None,
+        kv_block_index: Optional[Index] = None,
+        chat_templating=None,
+    ):
+        self.config = config or IndexerConfig()
+
+        self.prefix_store = (
+            tokenization_pool.prefix_store
+            if tokenization_pool is not None
+            else new_prefix_store(self.config.prefix_store_config)
+        )
+        self.token_processor = ChunkedTokenDatabase(self.config.token_processor_config)
+        self.kv_block_index = kv_block_index or new_index(self.config.kv_block_index_config)
+
+        # Scorer tier weights follow the top-level backend configs, like the
+        # reference's override in NewKVCacheIndexer (indexer.go:93-98).
+        self.config.scorer_config.backend_configs = self.config.backend_configs
+        self.scorer = new_kv_block_scorer(self.config.scorer_config)
+
+        self.tokenizers_pool = tokenization_pool or TokenizationPool(
+            self.config.tokenizers_pool_config,
+            prefix_store=self.prefix_store,
+            chat_templating=chat_templating,
+        )
+
+    def run(self) -> None:
+        """Start the tokenization workers."""
+        self.tokenizers_pool.run()
+
+    def shutdown(self) -> None:
+        self.tokenizers_pool.shutdown()
+
+    def get_pod_scores(
+        self,
+        prompt: str,
+        model_name: str,
+        pod_identifiers: Sequence[str],
+        render_request=None,
+    ) -> Dict[str, float]:
+        """Score pods by cached-prefix length for `prompt`.
+
+        Empty `pod_identifiers` means all known pods are relevant. Returns
+        {pod_identifier: score}; pods without hits are absent.
+        """
+        tokens = self.tokenizers_pool.tokenize(render_request, prompt, model_name)
+
+        block_keys = self.token_processor.tokens_to_kv_block_keys(
+            None, tokens, model_name
+        )
+        if not block_keys:
+            kvlog.trace(logger, "no block keys for prompt, returning empty scores")
+            return {}
+
+        key_to_pods = self.kv_block_index.lookup(block_keys, set(pod_identifiers))
+        scores = self.scorer.score(block_keys, key_to_pods)
+        kvlog.trace(logger, "pod scores: %s", scores)
+        return scores

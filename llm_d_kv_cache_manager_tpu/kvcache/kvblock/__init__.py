@@ -1,0 +1,26 @@
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
+    Index,
+    IndexConfig,
+    new_index,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+    InMemoryIndex,
+    InMemoryIndexConfig,
+)
+
+__all__ = [
+    "Key",
+    "PodEntry",
+    "ChunkedTokenDatabase",
+    "TokenProcessorConfig",
+    "Index",
+    "IndexConfig",
+    "new_index",
+    "InMemoryIndex",
+    "InMemoryIndexConfig",
+]

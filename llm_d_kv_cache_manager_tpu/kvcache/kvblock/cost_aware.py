@@ -1,0 +1,167 @@
+"""Cost-aware (byte-budgeted) in-memory index.
+
+Parity target: CostAwareMemoryIndex
+(/root/reference/pkg/kvcache/kvblock/cost_aware_memory.go): instead of
+bounding the index by entry *count*, bound it by estimated resident *bytes*
+(config accepts human-readable sizes like "2GiB"). Where the reference uses
+ristretto's cost-based admission, this build uses an LRU whose eviction is
+driven by accumulated entry cost — same contract (stay under the byte
+budget), simpler machinery.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Union
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+from llm_d_kv_cache_manager_tpu.utils.humansize import parse_human_size
+from llm_d_kv_cache_manager_tpu.utils.lru import LRUCache
+
+DEFAULT_MAX_SIZE = "1GiB"
+DEFAULT_PODS_PER_KEY = 10
+
+# Fixed per-object overheads (dict/list headers etc.) — an estimate, like the
+# reference's CalculateByteSize (cost_aware_memory.go:126-158).
+_ENTRY_OVERHEAD = 64
+
+
+def calculate_byte_size(key: Key, entries: Sequence[PodEntry]) -> int:
+    size = _ENTRY_OVERHEAD + len(key.model_name) + 8
+    for e in entries:
+        size += _ENTRY_OVERHEAD + len(e.pod_identifier) + len(e.device_tier)
+    return size
+
+
+@dataclass
+class CostAwareIndexConfig:
+    max_size_bytes: Union[int, str] = DEFAULT_MAX_SIZE
+    pod_cache_size: int = DEFAULT_PODS_PER_KEY
+
+
+class _CostedPodCache:
+    __slots__ = ("cache", "mu", "cost")
+
+    def __init__(self, capacity: int):
+        self.cache: LRUCache[PodEntry, None] = LRUCache(capacity)
+        self.mu = threading.Lock()
+        self.cost = 0
+
+
+class CostAwareMemoryIndex(Index):
+    """Byte-budget-bounded index; evicts least-recently-used keys on pressure."""
+
+    def __init__(self, config: Optional[CostAwareIndexConfig] = None):
+        cfg = config or CostAwareIndexConfig()
+        self._budget = parse_human_size(cfg.max_size_bytes)
+        self._pod_cache_size = cfg.pod_cache_size
+        self._data: "OrderedDict[Key, _CostedPodCache]" = OrderedDict()
+        self._engine_to_request: Dict[Key, Key] = {}
+        self._request_to_engines: Dict[Key, Set[Key]] = {}
+        self._total_cost = 0
+        self._mu = threading.Lock()
+
+    @property
+    def total_cost_bytes(self) -> int:
+        with self._mu:
+            return self._total_cost
+
+    def lookup(
+        self, request_keys: Sequence[Key], pod_identifier_set: Set[str]
+    ) -> Dict[Key, List[PodEntry]]:
+        if not request_keys:
+            raise ValueError("no request keys provided for lookup")
+        pods_per_key: Dict[Key, List[PodEntry]] = {}
+        with self._mu:
+            for key in request_keys:
+                pod_cache = self._data.get(key)
+                if pod_cache is None:
+                    continue
+                self._data.move_to_end(key)
+                entries = pod_cache.cache.keys()
+                if not entries:
+                    return pods_per_key  # prefix chain breaks here
+                if pod_identifier_set:
+                    entries = [
+                        e for e in entries if e.pod_identifier in pod_identifier_set
+                    ]
+                    if entries:
+                        pods_per_key[key] = entries
+                else:
+                    pods_per_key[key] = entries
+        return pods_per_key
+
+    def add(
+        self,
+        engine_keys: Sequence[Key],
+        request_keys: Sequence[Key],
+        entries: Sequence[PodEntry],
+    ) -> None:
+        if not engine_keys or not request_keys or not entries:
+            raise ValueError("no keys or entries provided for adding to index")
+        if len(engine_keys) != len(request_keys):
+            raise ValueError("engine/request key length mismatch")
+
+        with self._mu:
+            for engine_key, request_key in zip(engine_keys, request_keys):
+                self._engine_to_request[engine_key] = request_key
+                self._request_to_engines.setdefault(request_key, set()).add(engine_key)
+
+                pod_cache = self._data.get(request_key)
+                if pod_cache is None:
+                    pod_cache = _CostedPodCache(self._pod_cache_size)
+                    self._data[request_key] = pod_cache
+                else:
+                    self._data.move_to_end(request_key)
+
+                self._total_cost -= pod_cache.cost
+                with pod_cache.mu:
+                    for entry in entries:
+                        pod_cache.cache.add(entry, None)
+                    pod_cache.cost = calculate_byte_size(
+                        request_key, pod_cache.cache.keys()
+                    )
+                self._total_cost += pod_cache.cost
+
+            # Evict least-recently-used keys until under budget.
+            while self._total_cost > self._budget and len(self._data) > 1:
+                evicted_key, evicted_cache = self._data.popitem(last=False)
+                self._total_cost -= evicted_cache.cost
+                self._drop_engine_mappings(evicted_key)
+
+    def evict(self, engine_key: Key, entries: Sequence[PodEntry]) -> None:
+        if not entries:
+            raise ValueError("no entries provided for eviction from index")
+        with self._mu:
+            request_key = self._engine_to_request.get(engine_key)
+            if request_key is None:
+                return
+            pod_cache = self._data.get(request_key)
+            if pod_cache is None:
+                self._engine_to_request.pop(engine_key, None)
+                return
+            self._total_cost -= pod_cache.cost
+            with pod_cache.mu:
+                for entry in entries:
+                    pod_cache.cache.remove(entry)
+                is_empty = len(pod_cache.cache) == 0
+                pod_cache.cost = calculate_byte_size(
+                    request_key, pod_cache.cache.keys()
+                )
+            self._total_cost += pod_cache.cost
+            if is_empty:
+                self._data.pop(request_key, None)
+                self._total_cost -= pod_cache.cost
+                self._drop_engine_mappings(request_key)
+
+    def get_request_key(self, engine_key: Key) -> Optional[Key]:
+        with self._mu:
+            return self._engine_to_request.get(engine_key)
+
+    def _drop_engine_mappings(self, request_key: Key) -> None:
+        for engine_key in self._request_to_engines.pop(request_key, ()):  # noqa: B020
+            self._engine_to_request.pop(engine_key, None)

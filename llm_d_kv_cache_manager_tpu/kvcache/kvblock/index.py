@@ -1,0 +1,108 @@
+"""KV-block index contract + backend selection.
+
+Parity target: the Index interface and NewIndex backend selection
+(/root/reference/pkg/kvcache/kvblock/index.go:59-135). The index maps
+*request keys* to the set of pods (with device tier) holding that block, and
+separately maps *engine keys* to request keys so eviction events — which only
+carry engine hashes — can find their entries.
+
+Backend selection order matches the reference: in-memory → cost-aware →
+redis/valkey, first configured wins; metrics wrapping is applied last.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+
+
+class Index(abc.ABC):
+    """Thread-safe KV-block locality index."""
+
+    @abc.abstractmethod
+    def lookup(
+        self, request_keys: Sequence[Key], pod_identifier_set: Set[str]
+    ) -> Dict[Key, List[PodEntry]]:
+        """Return pods per key, filtered to `pod_identifier_set` (empty = all).
+
+        Walks keys in order; a key that exists with an empty pod set cuts the
+        search (prefix chain broke there). Raises ValueError on empty input.
+        """
+
+    @abc.abstractmethod
+    def add(
+        self,
+        engine_keys: Sequence[Key],
+        request_keys: Sequence[Key],
+        entries: Sequence[PodEntry],
+    ) -> None:
+        """Record that `entries` hold the given blocks (both key spaces)."""
+
+    @abc.abstractmethod
+    def evict(self, engine_key: Key, entries: Sequence[PodEntry]) -> None:
+        """Remove `entries` from the block identified by its engine key."""
+
+    @abc.abstractmethod
+    def get_request_key(self, engine_key: Key) -> Optional[Key]:
+        """Resolve an engine key to its request key, or None if unknown."""
+
+
+@dataclass
+class IndexConfig:
+    """First non-None backend wins, in field order (reference index.go:67-92)."""
+
+    in_memory_config: Optional["InMemoryIndexConfig"] = None
+    cost_aware_config: Optional["CostAwareIndexConfig"] = None
+    redis_config: Optional["RedisIndexConfig"] = None
+    enable_metrics: bool = False
+    metrics_logging_interval_s: float = 60.0
+
+    @classmethod
+    def default(cls) -> "IndexConfig":
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+            InMemoryIndexConfig,
+        )
+
+        return cls(in_memory_config=InMemoryIndexConfig())
+
+
+def new_index(config: Optional[IndexConfig] = None) -> Index:
+    """Build the configured index backend, optionally metrics-instrumented."""
+    if config is None:
+        config = IndexConfig.default()
+
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import InMemoryIndex
+
+    index: Optional[Index] = None
+    if config.in_memory_config is not None:
+        index = InMemoryIndex(config.in_memory_config)
+    elif config.cost_aware_config is not None:
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.cost_aware import (
+            CostAwareMemoryIndex,
+        )
+
+        index = CostAwareMemoryIndex(config.cost_aware_config)
+    elif config.redis_config is not None:
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import RedisIndex
+
+        index = RedisIndex(config.redis_config)
+    else:
+        index = InMemoryIndex(None)
+
+    if config.enable_metrics:
+        from llm_d_kv_cache_manager_tpu.metrics.collector import (
+            register_metrics,
+            start_metrics_logging,
+        )
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.instrumented import (
+            InstrumentedIndex,
+        )
+
+        register_metrics()
+        start_metrics_logging(config.metrics_logging_interval_s)
+        index = InstrumentedIndex(index)
+
+    return index

@@ -1,0 +1,58 @@
+"""Metrics-instrumented index decorator.
+
+Parity target: instrumentedIndex
+(/root/reference/pkg/kvcache/kvblock/instrumented_index.go:25-92): wraps any
+Index, emitting admission/eviction counters and, per lookup, the latency plus
+the maximum per-pod consecutive hit count.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import Counter as PyCounter
+from typing import Dict, List, Optional, Sequence, Set
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+from llm_d_kv_cache_manager_tpu.metrics import collector as m
+
+
+class InstrumentedIndex(Index):
+    def __init__(self, inner: Index):
+        self.inner = inner
+
+    def lookup(
+        self, request_keys: Sequence[Key], pod_identifier_set: Set[str]
+    ) -> Dict[Key, List[PodEntry]]:
+        start = time.perf_counter()
+        result = self.inner.lookup(request_keys, pod_identifier_set)
+        elapsed = time.perf_counter() - start
+
+        if m.index_lookup_requests is not None:
+            m.index_lookup_requests.inc()
+            m.index_lookup_latency.observe(elapsed)
+            m.index_lookup_hits.inc(len(result))
+            hit_counts: PyCounter = PyCounter()
+            for entries in result.values():
+                for entry in entries:
+                    hit_counts[entry.pod_identifier] += 1
+            m.index_max_pod_hits.observe(max(hit_counts.values()) if hit_counts else 0)
+        return result
+
+    def add(
+        self,
+        engine_keys: Sequence[Key],
+        request_keys: Sequence[Key],
+        entries: Sequence[PodEntry],
+    ) -> None:
+        self.inner.add(engine_keys, request_keys, entries)
+        if m.index_admissions is not None:
+            m.index_admissions.inc(len(request_keys))
+
+    def evict(self, engine_key: Key, entries: Sequence[PodEntry]) -> None:
+        self.inner.evict(engine_key, entries)
+        if m.index_evictions is not None:
+            m.index_evictions.inc()
+
+    def get_request_key(self, engine_key: Key) -> Optional[Key]:
+        return self.inner.get_request_key(engine_key)

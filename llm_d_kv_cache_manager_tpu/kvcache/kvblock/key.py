@@ -1,0 +1,30 @@
+"""KV-block key model.
+
+Parity target: Key{ModelName, ChunkHash} and PodEntry{PodIdentifier, DeviceTier}
+(/root/reference/pkg/kvcache/kvblock/index.go:137-159).
+
+The index is dual-keyed: an *engine key* carries the block hash reported by the
+engine's KVEvents verbatim, while a *request key* is recomputed on the indexer
+side from the event's token IDs with the chained CBOR+FNV scheme, so that
+read-path lookups (which only ever see tokens) land on the same keys.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+
+class Key(NamedTuple):
+    model_name: str
+    chunk_hash: int  # uint64
+
+    def __str__(self) -> str:
+        return f"{self.model_name}@{self.chunk_hash:x}"
+
+
+class PodEntry(NamedTuple):
+    pod_identifier: str
+    device_tier: str  # e.g. "hbm" | "host" (TPU tiers; reference used gpu/cpu)
+
+    def __str__(self) -> str:
+        return f"{self.pod_identifier}@{self.device_tier}"

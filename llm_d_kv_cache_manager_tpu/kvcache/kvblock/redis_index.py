@@ -1,0 +1,148 @@
+"""Distributed KV-block index on Redis/Valkey.
+
+Parity target: RedisIndex (/root/reference/pkg/kvcache/kvblock/redis.go):
+the index shared by multiple indexer replicas. Schema:
+
+- hash per request key (`<model>@<decimal-hash>`), one field per pod entry
+  (`pod@tier`, empty value),
+- string key `engine:<model>@<hash>` → request-key string for the
+  engine→request mapping.
+
+Lookups pipeline one HKEYS per block key (single RTT); a missing key or a
+fully-filtered-out key cuts the prefix walk, matching redis.go:179-205.
+Valkey URLs (valkey://) are accepted and rewritten; the reference's RDMA
+placeholder maps to DCN-attached Valkey on TPU fleets (config flag kept).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.resp import (
+    RespConnection,
+    RespError,
+)
+from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
+
+logger = kvlog.get_logger("kvblock.redis")
+
+
+@dataclass
+class RedisIndexConfig:
+    url: str = "redis://localhost:6379"
+    timeout_s: float = 5.0
+    enable_rdma: bool = False  # Valkey-over-DCN placeholder (reference parity)
+
+
+def _key_str(key: Key) -> str:
+    return f"{key.model_name}@{key.chunk_hash:d}"
+
+
+def _engine_key_str(key: Key) -> str:
+    return "engine:" + _key_str(key)
+
+
+def _parse_key(text: str) -> Optional[Key]:
+    model, sep, hash_str = text.rpartition("@")
+    if not sep or not hash_str.isdigit():
+        return None
+    return Key(model, int(hash_str))
+
+
+def _parse_entry(field: str) -> Optional[PodEntry]:
+    pod, sep, tier = field.partition("@")
+    if not sep:
+        return None
+    return PodEntry(pod, tier)
+
+
+class RedisIndex(Index):
+    def __init__(self, config: Optional[RedisIndexConfig] = None):
+        self.config = config or RedisIndexConfig()
+        self._conn = RespConnection(self.config.url, self.config.timeout_s)
+        self._mu = threading.Lock()  # serialize reconnect attempts
+        self._conn.connect()
+        if not self._conn.ping():
+            raise ConnectionError(f"redis PING failed for {self.config.url}")
+
+    def close(self) -> None:
+        self._conn.close()
+
+    def _pipeline(self, commands):
+        try:
+            return self._conn.pipeline(commands)
+        except (ConnectionError, OSError):
+            with self._mu:
+                self._conn.connect()
+            return self._conn.pipeline(commands)
+
+    def lookup(
+        self, request_keys: Sequence[Key], pod_identifier_set: Set[str]
+    ) -> Dict[Key, List[PodEntry]]:
+        if not request_keys:
+            raise ValueError("no request keys provided for lookup")
+
+        replies = self._pipeline([("HKEYS", _key_str(k)) for k in request_keys])
+
+        pods_per_key: Dict[Key, List[PodEntry]] = {}
+        for key, reply in zip(request_keys, replies):
+            if isinstance(reply, RespError) or reply is None:
+                logger.debug("lookup reply error for %s: %s", key, reply)
+                return pods_per_key  # cut: prefix chain breaks here
+            entries: List[PodEntry] = []
+            for field in reply:
+                entry = _parse_entry(
+                    field.decode("utf-8") if isinstance(field, bytes) else field
+                )
+                if entry is None:
+                    continue
+                if not pod_identifier_set or entry.pod_identifier in pod_identifier_set:
+                    entries.append(entry)
+            if not entries:
+                return pods_per_key  # cut on miss or fully-filtered key
+            pods_per_key[key] = entries
+        return pods_per_key
+
+    def add(
+        self,
+        engine_keys: Sequence[Key],
+        request_keys: Sequence[Key],
+        entries: Sequence[PodEntry],
+    ) -> None:
+        if not engine_keys or not request_keys or not entries:
+            raise ValueError("no keys or entries provided for adding to index")
+        if len(engine_keys) != len(request_keys):
+            raise ValueError("engine/request key length mismatch")
+
+        commands = []
+        for engine_key, request_key in zip(engine_keys, request_keys):
+            commands.append(("SET", _engine_key_str(engine_key), _key_str(request_key)))
+            for entry in entries:
+                commands.append(("HSET", _key_str(request_key), str(entry), ""))
+        self._pipeline(commands)
+
+    def evict(self, engine_key: Key, entries: Sequence[PodEntry]) -> None:
+        if not entries:
+            raise ValueError("no entries provided for eviction from index")
+        request_key = self.get_request_key(engine_key)
+        if request_key is None:
+            return
+        commands = [("HDEL", _key_str(request_key), str(e)) for e in entries]
+        commands.append(("HLEN", _key_str(request_key)))
+        replies = self._pipeline(commands)
+        if replies and replies[-1] == 0:
+            self._pipeline([
+                ("DEL", _key_str(request_key)),
+                ("DEL", _engine_key_str(engine_key)),
+            ])
+
+    def get_request_key(self, engine_key: Key) -> Optional[Key]:
+        replies = self._pipeline([("GET", _engine_key_str(engine_key))])
+        value = replies[0]
+        if value is None or isinstance(value, RespError):
+            return None
+        return _parse_key(value.decode("utf-8") if isinstance(value, bytes) else value)

@@ -1,0 +1,58 @@
+"""Tokens → chained KV-block keys.
+
+Parity target: ChunkedTokenDatabase
+(/root/reference/pkg/kvcache/kvblock/token_processor.go:61-162): tokens are
+chunked into full blocks of `block_size` (partial tail dropped; vLLM default
+16, TPU deployments commonly 64 per the reference benchmark config), each
+block's key is the chained CBOR+FNV-64a hash of (parent_hash, block_tokens),
+and an optional parent key continues an existing chain (used by the event
+pool when BlockStored events carry a parent block hash).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import hashing
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key
+
+DEFAULT_BLOCK_SIZE = 16  # vLLM default block size
+
+
+@dataclass
+class TokenProcessorConfig:
+    block_size: int = DEFAULT_BLOCK_SIZE
+    # Must match the engine fleet's PYTHONHASHSEED (vLLM NONE_HASH alignment).
+    hash_seed: str = ""
+
+    @classmethod
+    def default(cls) -> "TokenProcessorConfig":
+        return cls()
+
+
+class ChunkedTokenDatabase:
+    """Converts token sequences into chained KV-block keys."""
+
+    def __init__(self, config: Optional[TokenProcessorConfig] = None):
+        self.config = config or TokenProcessorConfig.default()
+        self._init_hash = hashing.init_hash(self.config.hash_seed)
+
+    @property
+    def block_size(self) -> int:
+        return self.config.block_size
+
+    @property
+    def init_hash(self) -> int:
+        return self._init_hash
+
+    def tokens_to_kv_block_keys(
+        self,
+        parent_key: Optional[Key],
+        tokens: Sequence[int],
+        model_name: str,
+    ) -> List[Key]:
+        """Chain-hash full blocks of tokens into Keys; [] if no full block."""
+        parent_hash = parent_key.chunk_hash if parent_key is not None else self._init_hash
+        hashes = hashing.prefix_hashes_fast(parent_hash, tokens, self.config.block_size)
+        return [Key(model_name, h) for h in hashes]

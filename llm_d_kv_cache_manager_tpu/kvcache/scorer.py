@@ -1,0 +1,82 @@
+"""Pod scoring from block lookup results.
+
+Parity target: LongestPrefixScorer
+(/root/reference/pkg/kvcache/kvblock_scorer.go:76-151): walk block keys in
+prompt order; only pods present for block 0 start "active"; each subsequent
+block intersects the active set; every hit adds the pod's maximum device-tier
+weight for that block (unknown tiers default to 1.0). Pods that drop out keep
+the score accumulated so far — the score is the weighted length of the longest
+consecutive cached prefix.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from llm_d_kv_cache_manager_tpu.kvcache.backend import (
+    KVCacheBackendConfig,
+    default_kv_cache_backend_configs,
+    weight_map,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+
+LONGEST_PREFIX_MATCH = "LongestPrefix"
+
+
+@dataclass
+class KVBlockScorerConfig:
+    scoring_strategy: str = LONGEST_PREFIX_MATCH
+    backend_configs: List[KVCacheBackendConfig] = field(
+        default_factory=default_kv_cache_backend_configs
+    )
+
+
+def _max_weight(
+    entries: Sequence[PodEntry], pod_id: str, weights: Dict[str, float]
+) -> float:
+    best = 0.0
+    for entry in entries:
+        if entry.pod_identifier == pod_id:
+            w = weights.get(entry.device_tier, 1.0)
+            if w > best:
+                best = w
+    return best
+
+
+class LongestPrefixScorer:
+    strategy = LONGEST_PREFIX_MATCH
+
+    def __init__(self, medium_weights: Dict[str, float]):
+        self.medium_weights = medium_weights
+
+    def score(
+        self,
+        keys: Sequence[Key],
+        key_to_pods: Dict[Key, List[PodEntry]],
+    ) -> Dict[str, float]:
+        if not keys:
+            return {}
+
+        pods_first = key_to_pods.get(keys[0], [])
+        active = {e.pod_identifier for e in pods_first}
+        scores: Dict[str, float] = {
+            pod: _max_weight(pods_first, pod, self.medium_weights) for pod in active
+        }
+
+        for key in keys[1:]:
+            if not active:
+                break
+            pods_here = key_to_pods.get(key, [])
+            active &= {e.pod_identifier for e in pods_here}
+            for pod in active:
+                scores[pod] += _max_weight(pods_here, pod, self.medium_weights)
+
+        return scores
+
+
+def new_kv_block_scorer(config: Optional[KVBlockScorerConfig] = None) -> LongestPrefixScorer:
+    cfg = config or KVBlockScorerConfig()
+    if cfg.scoring_strategy != LONGEST_PREFIX_MATCH:
+        raise ValueError(f"unsupported scoring strategy: {cfg.scoring_strategy}")
+    return LongestPrefixScorer(weight_map(cfg.backend_configs))

@@ -1,0 +1,17 @@
+from llm_d_kv_cache_manager_tpu.kvevents.events import (
+    AllBlocksCleared,
+    BlockRemoved,
+    BlockStored,
+    EventBatch,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.pool import EventPool, EventPoolConfig, Message
+
+__all__ = [
+    "AllBlocksCleared",
+    "BlockRemoved",
+    "BlockStored",
+    "EventBatch",
+    "EventPool",
+    "EventPoolConfig",
+    "Message",
+]

@@ -1,0 +1,210 @@
+"""Sharded event-processing pool with per-pod ordering.
+
+Parity target: kvevents.Pool (/root/reference/pkg/kvcache/kvevents/pool.go):
+messages are sharded to worker queues by FNV-1a(pod_identifier) % concurrency
+so all events from one pod are processed in publish order; workers decode
+msgpack EventBatches and digest them into the shared KV-block index:
+
+- BlockStored → engine keys from the event's block hashes; request keys
+  recomputed from the event's token IDs (continuing the parent chain when the
+  parent's request key is known) → index.add (pool.go:246-306).
+- BlockRemoved → index.evict per engine key (pool.go:307-331).
+- AllBlocksCleared → no-op (vLLM emits per-block removals too).
+
+Undecodable messages are dropped ("poison pills"), never retried
+(pool.go:182-187). The default device tier here is TPU "hbm" (the reference
+defaulted to "gpu"); events carrying an explicit Medium override it.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import List, Optional
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.hashing import fnv32a
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    ChunkedTokenDatabase,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.events import (
+    AllBlocksCleared,
+    BlockRemoved,
+    BlockStored,
+    EventBatch,
+    hash_as_uint64,
+)
+from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
+
+logger = kvlog.get_logger("kvevents.pool")
+
+DEFAULT_DEVICE_TIER = "hbm"  # TPU default (reference used "gpu")
+
+
+@dataclass
+class EventPoolConfig:
+    zmq_endpoint: str = "tcp://*:5557"
+    topic_filter: str = "kv@"
+    concurrency: int = 4
+    default_device_tier: str = DEFAULT_DEVICE_TIER
+
+
+@dataclass
+class Message:
+    topic: str
+    payload: bytes
+    seq: int
+    pod_identifier: str
+    model_name: str
+
+
+class EventPool:
+    """Sharded worker pool fed by the ZMQ subscriber (or directly in tests)."""
+
+    def __init__(
+        self,
+        config: Optional[EventPoolConfig],
+        index: Index,
+        token_processor: ChunkedTokenDatabase,
+    ):
+        self.config = config or EventPoolConfig()
+        self.index = index
+        self.token_processor = token_processor
+        self._queues: List["queue.Queue[Optional[Message]]"] = [
+            queue.Queue() for _ in range(self.config.concurrency)
+        ]
+        self._workers: List[threading.Thread] = []
+        self._subscriber = None
+        self._started = False
+        self._mu = threading.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, with_subscriber: bool = True) -> None:
+        with self._mu:
+            if self._started:
+                return
+            self._started = True
+            for i, q in enumerate(self._queues):
+                t = threading.Thread(
+                    target=self._worker_loop, args=(q,), name=f"kvevents-worker-{i}",
+                    daemon=True,
+                )
+                t.start()
+                self._workers.append(t)
+            if with_subscriber:
+                from llm_d_kv_cache_manager_tpu.kvevents.zmq_subscriber import (
+                    ZMQSubscriber,
+                )
+
+                self._subscriber = ZMQSubscriber(
+                    self, self.config.zmq_endpoint, self.config.topic_filter
+                )
+                self._subscriber.start()
+
+    def shutdown(self) -> None:
+        with self._mu:
+            if not self._started:
+                return
+            self._started = False
+        if self._subscriber is not None:
+            self._subscriber.stop()
+            self._subscriber = None
+        for q in self._queues:
+            q.put(None)
+        for t in self._workers:
+            t.join(timeout=5.0)
+        self._workers = []
+
+    def drain(self) -> None:
+        """Block until all queued events are processed (test/bench helper)."""
+        for q in self._queues:
+            q.join()
+
+    # -- ingestion ---------------------------------------------------------
+
+    def add_task(self, msg: Message) -> None:
+        """Shard by FNV-1a(pod) so per-pod ordering is preserved."""
+        shard = fnv32a(msg.pod_identifier.encode("utf-8")) % len(self._queues)
+        self._queues[shard].put(msg)
+
+    # -- workers -----------------------------------------------------------
+
+    def _worker_loop(self, q: "queue.Queue[Optional[Message]]") -> None:
+        while True:
+            msg = q.get()
+            try:
+                if msg is None:
+                    return
+                self._process_event(msg)
+            finally:
+                q.task_done()
+
+    def _process_event(self, msg: Message) -> None:
+        try:
+            batch = EventBatch.from_msgpack(msg.payload)
+        except Exception as e:  # noqa: BLE001 - poison pill: drop, don't retry
+            logger.debug("dropping undecodable event batch (topic=%s): %s", msg.topic, e)
+            return
+        self._digest_events(msg.pod_identifier, msg.model_name, batch)
+
+    def _digest_events(
+        self, pod_identifier: str, model_name: str, batch: EventBatch
+    ) -> None:
+        for event in batch.events:
+            if isinstance(event, BlockStored):
+                self._digest_block_stored(pod_identifier, model_name, event)
+            elif isinstance(event, BlockRemoved):
+                self._digest_block_removed(pod_identifier, model_name, event)
+            elif isinstance(event, AllBlocksCleared):
+                continue  # engines emit per-block removals as well
+
+    def _digest_block_stored(
+        self, pod_identifier: str, model_name: str, ev: BlockStored
+    ) -> None:
+        tier = (ev.medium or self.config.default_device_tier).lower()
+        entries = [PodEntry(pod_identifier, tier)]
+
+        engine_keys: List[Key] = []
+        for raw in ev.block_hashes:
+            try:
+                engine_keys.append(Key(model_name, hash_as_uint64(raw)))
+            except (TypeError, ValueError) as e:
+                logger.debug("bad block hash in BlockStored: %s", e)
+
+        parent_request_key: Optional[Key] = None
+        if ev.parent_block_hash is not None:
+            try:
+                parent_engine_key = Key(model_name, hash_as_uint64(ev.parent_block_hash))
+            except (TypeError, ValueError) as e:
+                logger.debug("bad parent hash in BlockStored: %s", e)
+                return
+            parent_request_key = self.index.get_request_key(parent_engine_key)
+
+        request_keys = self.token_processor.tokens_to_kv_block_keys(
+            parent_request_key, ev.token_ids, model_name
+        )
+
+        if engine_keys:
+            try:
+                self.index.add(engine_keys, request_keys, entries)
+            except ValueError as e:
+                logger.debug("failed to add BlockStored to index: %s", e)
+
+    def _digest_block_removed(
+        self, pod_identifier: str, model_name: str, ev: BlockRemoved
+    ) -> None:
+        tier = (ev.medium or self.config.default_device_tier).lower()
+        entries = [PodEntry(pod_identifier, tier)]
+        for raw in ev.block_hashes:
+            try:
+                engine_key = Key(model_name, hash_as_uint64(raw))
+            except (TypeError, ValueError) as e:
+                logger.debug("bad block hash in BlockRemoved: %s", e)
+                continue
+            try:
+                self.index.evict(engine_key, entries)
+            except ValueError as e:
+                logger.debug("failed to evict from index: %s", e)

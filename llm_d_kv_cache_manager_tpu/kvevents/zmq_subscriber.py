@@ -1,0 +1,116 @@
+"""ZMQ SUB subscriber for engine KVEvents.
+
+Parity target: /root/reference/pkg/kvcache/kvevents/zmq_subscriber.go: the
+indexer *binds* a SUB socket (engines connect out to it, so a fleet of pods
+needs no per-pod endpoint config), subscribes to the topic filter (default
+"kv@"), and receives 3-frame messages:
+
+    [topic: "kv@<pod-id>@<model>", seq: uint64 big-endian, payload: msgpack]
+
+The receive loop polls with a 250ms timeout so shutdown is responsive, and on
+any socket error tears down and reconnects after 5s, forever.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import zmq
+
+from llm_d_kv_cache_manager_tpu.kvevents.pool import Message
+from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
+
+logger = kvlog.get_logger("kvevents.zmq_subscriber")
+
+RETRY_INTERVAL_S = 5.0
+POLL_TIMEOUT_MS = 250
+
+
+class ZMQSubscriber:
+    def __init__(self, pool, endpoint: str, topic_filter: str = "kv@"):
+        self.pool = pool
+        self.endpoint = endpoint
+        self.topic_filter = topic_filter
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._ctx: Optional[zmq.Context] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._ctx = zmq.Context.instance()
+        self._thread = threading.Thread(
+            target=self._run, name="zmq-subscriber", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._run_subscriber()
+            if self._stop.wait(RETRY_INTERVAL_S):
+                return
+            logger.info("retrying zmq-subscriber")
+
+    def _run_subscriber(self) -> None:
+        try:
+            sub = self._ctx.socket(zmq.SUB)
+        except zmq.ZMQError as e:
+            logger.error("failed to create SUB socket: %s", e)
+            return
+        try:
+            sub.bind(self.endpoint)
+            sub.setsockopt_string(zmq.SUBSCRIBE, self.topic_filter)
+            logger.info("bound subscriber socket at %s", self.endpoint)
+
+            poller = zmq.Poller()
+            poller.register(sub, zmq.POLLIN)
+
+            while not self._stop.is_set():
+                try:
+                    polled = dict(poller.poll(POLL_TIMEOUT_MS))
+                except zmq.ZMQError as e:
+                    logger.debug("poll failed: %s", e)
+                    return  # reconnect
+                if sub not in polled:
+                    continue
+                try:
+                    parts = sub.recv_multipart()
+                except zmq.ZMQError as e:
+                    logger.debug("recv failed: %s", e)
+                    return  # reconnect
+                if len(parts) != 3:
+                    logger.debug("malformed message: %d frames", len(parts))
+                    continue
+                topic = parts[0].decode("utf-8", errors="replace")
+                seq = int.from_bytes(parts[1], "big")
+                payload = parts[2]
+
+                topic_parts = topic.split("@")
+                if len(topic_parts) != 3:
+                    logger.debug(
+                        "bad topic %r, expected kv@<pod-id>@<model-name>", topic
+                    )
+                    continue
+                _prefix, pod_identifier, model_name = topic_parts
+
+                self.pool.add_task(
+                    Message(
+                        topic=topic,
+                        payload=payload,
+                        seq=seq,
+                        pod_identifier=pod_identifier,
+                        model_name=model_name,
+                    )
+                )
+        except zmq.ZMQError as e:
+            logger.error("subscriber socket error on %s: %s", self.endpoint, e)
+        finally:
+            sub.close(linger=0)

@@ -1,0 +1,6 @@
+from llm_d_kv_cache_manager_tpu.metrics.collector import (
+    register_metrics,
+    start_metrics_logging,
+)
+
+__all__ = ["register_metrics", "start_metrics_logging"]

@@ -1,0 +1,135 @@
+"""Prometheus collectors + periodic metrics-beat logging.
+
+Parity target: /root/reference/pkg/kvcache/metrics/collector.go:28-157 — eight
+collectors under the `kvcache_index_*` / `kvcache_tokenization_*` namespaces,
+a once-guarded Register(), and a periodic human-readable "metrics beat" log
+line summarizing counters so operators can follow cache health without a
+Prometheus stack.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from prometheus_client import REGISTRY, Counter, Histogram
+
+from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
+
+logger = kvlog.get_logger("metrics")
+
+_LATENCY_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5,
+)
+
+# Collectors are created lazily in register_metrics() so importing this module
+# never mutates the global registry (mirrors the reference's explicit
+# Register() + sync.Once).
+index_admissions: Optional[Counter] = None
+index_evictions: Optional[Counter] = None
+index_lookup_requests: Optional[Counter] = None
+index_lookup_hits: Optional[Counter] = None
+index_max_pod_hits: Optional[Histogram] = None
+index_lookup_latency: Optional[Histogram] = None
+tokenization_latency: Optional[Histogram] = None
+tokenized_tokens: Optional[Counter] = None
+render_latency: Optional[Histogram] = None
+
+_registered = False
+_register_lock = threading.Lock()
+_beat_thread: Optional[threading.Thread] = None
+
+
+def register_metrics(registry=None) -> None:
+    """Create and register all collectors exactly once."""
+    global _registered, index_admissions, index_evictions, index_lookup_requests
+    global index_lookup_hits, index_max_pod_hits, index_lookup_latency
+    global tokenization_latency, tokenized_tokens, render_latency
+
+    with _register_lock:
+        if _registered:
+            return
+        reg = registry or REGISTRY
+        index_admissions = Counter(
+            "kvcache_index_admissions_total",
+            "Number of KV-block keys admitted into the index",
+            registry=reg,
+        )
+        index_evictions = Counter(
+            "kvcache_index_evictions_total",
+            "Number of KV-block evictions processed",
+            registry=reg,
+        )
+        index_lookup_requests = Counter(
+            "kvcache_index_lookup_requests_total",
+            "Number of index lookup requests",
+            registry=reg,
+        )
+        index_lookup_hits = Counter(
+            "kvcache_index_lookup_hits_total",
+            "Number of block keys that hit at least one pod",
+            registry=reg,
+        )
+        index_max_pod_hits = Histogram(
+            "kvcache_index_max_pod_hit_count",
+            "Per-lookup maximum consecutive hit count across pods",
+            buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512),
+            registry=reg,
+        )
+        index_lookup_latency = Histogram(
+            "kvcache_index_lookup_latency_seconds",
+            "Index lookup latency",
+            buckets=_LATENCY_BUCKETS,
+            registry=reg,
+        )
+        tokenization_latency = Histogram(
+            "kvcache_tokenization_latency_seconds",
+            "Full-tokenization latency per prompt",
+            buckets=_LATENCY_BUCKETS,
+            registry=reg,
+        )
+        tokenized_tokens = Counter(
+            "kvcache_tokenization_tokens_total",
+            "Number of tokens produced by full tokenization",
+            registry=reg,
+        )
+        render_latency = Histogram(
+            "kvcache_tokenization_render_latency_seconds",
+            "Chat-template render latency",
+            buckets=_LATENCY_BUCKETS,
+            registry=reg,
+        )
+        _registered = True
+
+
+def start_metrics_logging(interval_s: float = 60.0) -> None:
+    """Start the periodic metrics-beat logger thread (idempotent)."""
+    global _beat_thread
+    with _register_lock:
+        if _beat_thread is not None:
+            return
+        _beat_thread = threading.Thread(
+            target=_beat_loop, args=(interval_s,), name="metrics-beat", daemon=True
+        )
+        _beat_thread.start()
+
+
+def _counter_value(c: Optional[Counter]) -> float:
+    if c is None:
+        return 0.0
+    return c._value.get()  # noqa: SLF001 - prometheus_client has no public read
+
+
+def _beat_loop(interval_s: float) -> None:
+    import time
+
+    while True:
+        time.sleep(interval_s)
+        logger.info(
+            "metrics beat: admissions=%d evictions=%d lookups=%d hits=%d",
+            _counter_value(index_admissions),
+            _counter_value(index_evictions),
+            _counter_value(index_lookup_requests),
+            _counter_value(index_lookup_hits),
+        )

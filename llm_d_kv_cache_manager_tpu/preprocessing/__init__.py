@@ -1,0 +1,6 @@
+from llm_d_kv_cache_manager_tpu.preprocessing.chat_completions import (
+    ChatTemplatingProcessor,
+    RenderRequest,
+)
+
+__all__ = ["ChatTemplatingProcessor", "RenderRequest"]

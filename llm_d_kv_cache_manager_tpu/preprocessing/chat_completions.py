@@ -1,0 +1,146 @@
+"""Chat-completions preprocessing: Jinja chat-template rendering + fetching.
+
+Parity target: the reference's preprocessing layer
+(/root/reference/pkg/preprocessing/chat_completions/): a Go↔C↔embedded-CPython
+bridge (cgo_functions.c:40-86,148-225) that calls
+`transformers.utils.chat_template_utils.render_jinja_template` and fetches
+model chat templates, with module-level template caching
+(render_jinja_template_wrapper.py:81-207).
+
+This build is Python-native, so the entire FFI tower collapses into a direct
+call into `transformers` — same JSON contract, no GIL gymnastics. The
+templating seam is kept as a class so the UDS sidecar can serve it
+out-of-process when the control plane itself is run natively (C++ service
+embedding CPython, services/uds_tokenizer/).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
+
+logger = kvlog.get_logger("preprocessing.chat_completions")
+
+
+@dataclass
+class RenderRequest:
+    """Mirror of the reference's RenderJinjaTemplateRequest JSON contract."""
+
+    conversations: List[List[Dict[str, Any]]]
+    chat_template: Optional[str] = None
+    tools: Optional[List[Dict[str, Any]]] = None
+    documents: Optional[List[Dict[str, Any]]] = None
+    add_generation_prompt: bool = True
+    continue_final_message: bool = False
+    template_vars: Dict[str, Any] = field(default_factory=dict)
+    model_name: Optional[str] = None
+
+    @classmethod
+    def from_json(cls, payload: str) -> "RenderRequest":
+        data = json.loads(payload)
+        return cls(
+            conversations=data["conversations"],
+            chat_template=data.get("chat_template"),
+            tools=data.get("tools"),
+            documents=data.get("documents"),
+            add_generation_prompt=data.get("add_generation_prompt", True),
+            continue_final_message=data.get("continue_final_message", False),
+            template_vars=data.get("template_vars", {}),
+            model_name=data.get("model"),
+        )
+
+
+class ChatTemplatingProcessor:
+    """Renders chat templates and fetches/caches per-model templates."""
+
+    def __init__(self):
+        self._template_cache: Dict[str, str] = {}
+        self._mu = threading.Lock()
+
+    def render(self, request: RenderRequest) -> str:
+        """Render the first conversation to a prompt string."""
+        template = request.chat_template
+        if not template and request.model_name:
+            template = self.fetch_chat_template(request.model_name)
+        if not template:
+            raise ValueError("no chat template provided or fetchable")
+
+        from transformers.utils.chat_template_utils import render_jinja_template
+
+        rendered, _generation_indices = render_jinja_template(
+            conversations=request.conversations,
+            chat_template=template,
+            tools=request.tools,
+            documents=request.documents,
+            add_generation_prompt=request.add_generation_prompt,
+            continue_final_message=request.continue_final_message,
+            **request.template_vars,
+        )
+        return rendered[0]
+
+    def fetch_chat_template(
+        self, model_name: str, local_dir: Optional[str] = None
+    ) -> Optional[str]:
+        """Fetch a model's chat template, caching per model.
+
+        Resolution order: cache → local `tokenizer_config.json` /
+        `chat_template.jinja` (under `local_dir` or LOCAL_TOKENIZER_DIR) →
+        `transformers.AutoTokenizer` (may hit the network).
+        """
+        with self._mu:
+            cached = self._template_cache.get(model_name)
+        if cached is not None:
+            return cached
+
+        template = self._fetch_local(model_name, local_dir)
+        if template is None:
+            template = self._fetch_auto(model_name)
+        if template is not None:
+            with self._mu:
+                self._template_cache[model_name] = template
+        return template
+
+    def clear_caches(self) -> None:
+        with self._mu:
+            self._template_cache.clear()
+
+    def _fetch_local(self, model_name: str, local_dir: Optional[str]) -> Optional[str]:
+        root = local_dir or os.environ.get("LOCAL_TOKENIZER_DIR", "")
+        if not root:
+            return None
+        candidates = [
+            os.path.join(root, model_name),
+            os.path.join(root, model_name.replace("/", os.sep)),
+        ]
+        for base in candidates:
+            jinja_path = os.path.join(base, "chat_template.jinja")
+            if os.path.isfile(jinja_path):
+                with open(jinja_path, encoding="utf-8") as f:
+                    return f.read()
+            cfg_path = os.path.join(base, "tokenizer_config.json")
+            if os.path.isfile(cfg_path):
+                try:
+                    with open(cfg_path, encoding="utf-8") as f:
+                        cfg = json.load(f)
+                    template = cfg.get("chat_template")
+                    if isinstance(template, str):
+                        return template
+                except (OSError, json.JSONDecodeError) as e:
+                    logger.warning("failed reading %s: %s", cfg_path, e)
+        return None
+
+    def _fetch_auto(self, model_name: str) -> Optional[str]:
+        try:
+            from transformers import AutoTokenizer
+
+            tok = AutoTokenizer.from_pretrained(model_name)
+            template = getattr(tok, "chat_template", None)
+            return template if isinstance(template, str) else None
+        except Exception as e:  # noqa: BLE001 - network/model errors are soft
+            logger.warning("AutoTokenizer template fetch failed for %s: %s", model_name, e)
+            return None

@@ -1,0 +1,21 @@
+from llm_d_kv_cache_manager_tpu.tokenization.tokenizer import (
+    CachedHFTokenizer,
+    CachedLocalTokenizer,
+    CompositeTokenizer,
+    Tokenizer,
+    TokenizationResult,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPool,
+    TokenizersPoolConfig,
+)
+
+__all__ = [
+    "CachedHFTokenizer",
+    "CachedLocalTokenizer",
+    "CompositeTokenizer",
+    "Tokenizer",
+    "TokenizationResult",
+    "TokenizationPool",
+    "TokenizersPoolConfig",
+]

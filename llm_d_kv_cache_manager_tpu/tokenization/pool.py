@@ -1,0 +1,182 @@
+"""Tokenization worker pool.
+
+Parity target: tokenization.Pool (/root/reference/pkg/tokenization/pool.go):
+N workers (default 5) drain a task queue; each task optionally renders a chat
+template, then consults the prefix store — if the cached-prefix coverage is
+at least `min_prefix_overlap_ratio` (default 0.8) the cached tokens are used
+directly, otherwise the prompt is fully tokenized and the result is fed back
+into the prefix store. Two submission modes: blocking `tokenize` (the read
+path) and fire-and-forget `enqueue_tokenization` (cache warming), matching
+pool.go:140-161.
+
+The composite tokenizer is assembled from the enabled backends in the order
+local → UDS sidecar → HF hub (pool.go:103-135).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+from llm_d_kv_cache_manager_tpu.tokenization.prefixstore.indexer import (
+    PrefixStore,
+    PrefixStoreConfig,
+    new_prefix_store,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.tokenizer import (
+    CachedHFTokenizer,
+    CachedLocalTokenizer,
+    CompositeTokenizer,
+    Tokenizer,
+)
+from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
+
+logger = kvlog.get_logger("tokenization.pool")
+
+DEFAULT_WORKERS = 5
+DEFAULT_MIN_PREFIX_OVERLAP_RATIO = 0.8
+
+
+@dataclass
+class TokenizersPoolConfig:
+    workers: int = DEFAULT_WORKERS
+    min_prefix_overlap_ratio: float = DEFAULT_MIN_PREFIX_OVERLAP_RATIO
+    enable_local: bool = True
+    enable_uds: bool = False
+    enable_hf: bool = False
+    uds_socket_path: Optional[str] = None
+    hf_auth_token: Optional[str] = None
+    # Explicit model→tokenizer.json map for the local backend; None = discover
+    # from LOCAL_TOKENIZER_DIR.
+    local_tokenizer_files: Optional[dict] = None
+
+
+@dataclass
+class _Task:
+    render_request: Optional[object]
+    prompt: str
+    model_name: str
+    future: Optional[Future]
+
+
+class TokenizationPool:
+    """Sync/async tokenization over a shared prefix store."""
+
+    def __init__(
+        self,
+        config: Optional[TokenizersPoolConfig] = None,
+        prefix_store: Optional[PrefixStore] = None,
+        tokenizer: Optional[Tokenizer] = None,
+        chat_templating=None,
+    ):
+        self.config = config or TokenizersPoolConfig()
+        self.prefix_store = prefix_store or new_prefix_store(PrefixStoreConfig())
+        self.tokenizer = tokenizer or self._build_composite(chat_templating)
+        self._queue: "queue.Queue[Optional[_Task]]" = queue.Queue()
+        self._workers: List[threading.Thread] = []
+        self._started = False
+        self._mu = threading.Lock()
+
+    def _build_composite(self, chat_templating) -> CompositeTokenizer:
+        backends: List[Tokenizer] = []
+        if self.config.enable_local:
+            backends.append(
+                CachedLocalTokenizer(
+                    tokenizer_files=self.config.local_tokenizer_files,
+                    chat_templating=chat_templating,
+                )
+            )
+        if self.config.enable_uds:
+            from llm_d_kv_cache_manager_tpu.tokenization.uds_client import UDSTokenizer
+
+            backends.append(UDSTokenizer(self.config.uds_socket_path))
+        if self.config.enable_hf:
+            backends.append(
+                CachedHFTokenizer(
+                    auth_token=self.config.hf_auth_token,
+                    chat_templating=chat_templating,
+                )
+            )
+        if not backends:
+            raise ValueError("no tokenizer backends enabled")
+        return CompositeTokenizer(backends)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def run(self) -> None:
+        """Start the worker threads (idempotent)."""
+        with self._mu:
+            if self._started:
+                return
+            self._started = True
+            for i in range(self.config.workers):
+                t = threading.Thread(
+                    target=self._worker_loop, name=f"tokenize-worker-{i}", daemon=True
+                )
+                t.start()
+                self._workers.append(t)
+
+    def shutdown(self) -> None:
+        with self._mu:
+            if not self._started:
+                return
+            for _ in self._workers:
+                self._queue.put(None)
+            workers, self._workers = self._workers, []
+            self._started = False
+        for t in workers:
+            t.join(timeout=5.0)
+
+    # -- submission --------------------------------------------------------
+
+    def tokenize(
+        self, render_request, prompt: str, model_name: str, timeout: Optional[float] = None
+    ) -> List[int]:
+        """Blocking tokenization (the read path)."""
+        fut: Future = Future()
+        self._queue.put(_Task(render_request, prompt, model_name, fut))
+        if not self._started:
+            self.run()
+        return fut.result(timeout=timeout)
+
+    def enqueue_tokenization(self, render_request, prompt: str, model_name: str) -> None:
+        """Fire-and-forget tokenization (cache warming)."""
+        self._queue.put(_Task(render_request, prompt, model_name, None))
+
+    # -- workers -----------------------------------------------------------
+
+    def drain(self) -> None:
+        """Block until every queued task has been processed."""
+        self._queue.join()
+
+    def _worker_loop(self) -> None:
+        while True:
+            task = self._queue.get()
+            try:
+                if task is None:
+                    return
+                tokens = self._process(task)
+                if task.future is not None:
+                    task.future.set_result(tokens)
+            except Exception as e:  # noqa: BLE001 - deliver errors to waiter
+                if task is not None and task.future is not None:
+                    task.future.set_exception(e)
+                else:
+                    logger.warning("async tokenization task failed: %s", e)
+            finally:
+                self._queue.task_done()
+
+    def _process(self, task: _Task) -> List[int]:
+        prompt = task.prompt
+        if task.render_request is not None:
+            prompt = self.tokenizer.render_chat_template(task.render_request)
+
+        tokens, ratio = self.prefix_store.find_longest_contained_tokens(prompt)
+        if ratio < self.config.min_prefix_overlap_ratio:
+            result = self.tokenizer.encode(prompt, task.model_name)
+            self.prefix_store.add_tokenization(prompt, result.tokens, result.offsets)
+            tokens = result.tokens
+        return list(tokens)

@@ -1,0 +1,21 @@
+from llm_d_kv_cache_manager_tpu.tokenization.prefixstore.indexer import (
+    PrefixStore,
+    PrefixStoreConfig,
+    new_prefix_store,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.prefixstore.lru_store import (
+    LRUTokenStore,
+    LRUStoreConfig,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.prefixstore.trie_store import (
+    TrieTokenStore,
+)
+
+__all__ = [
+    "PrefixStore",
+    "PrefixStoreConfig",
+    "new_prefix_store",
+    "LRUTokenStore",
+    "LRUStoreConfig",
+    "TrieTokenStore",
+]

@@ -1,0 +1,89 @@
+"""LRU prefix-token store with chained xxhash64 chunk keys.
+
+Parity target: LRUTokenStore
+(/root/reference/pkg/tokenization/prefixstore/lru_store.go:60-190): the prompt
+byte string is cut into fixed-size chunks (default 256 bytes, partial tail
+dropped); each chunk's key is xxhash64(little_endian(prev_hash) ‖ chunk_bytes)
+with prev_hash chained from 0; the value is the list of tokens whose [_, high)
+byte offset ends inside that chunk. Lookup re-derives the chain and early-stops
+at the first missing chunk, returning accumulated tokens and the byte-coverage
+ratio.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple
+
+import xxhash
+
+from llm_d_kv_cache_manager_tpu.tokenization.prefixstore.indexer import (
+    Offset,
+    PrefixStore,
+)
+from llm_d_kv_cache_manager_tpu.utils.lru import LRUCache
+
+DEFAULT_BLOCK_SIZE = 256  # bytes of prompt text per chunk
+DEFAULT_MAX_CACHE_SIZE = 500_000
+
+_pack_u64 = struct.Struct("<Q").pack
+
+
+@dataclass
+class LRUStoreConfig:
+    cache_size: int = DEFAULT_MAX_CACHE_SIZE
+    block_size: int = DEFAULT_BLOCK_SIZE
+
+
+def _chunk_hash(prev_hash: int, chunk: bytes) -> int:
+    return xxhash.xxh64(_pack_u64(prev_hash) + chunk).intdigest()
+
+
+class LRUTokenStore(PrefixStore):
+    def __init__(self, config: LRUStoreConfig | None = None):
+        cfg = config or LRUStoreConfig()
+        self.block_size = cfg.block_size
+        self._cache: LRUCache[int, List[int]] = LRUCache(cfg.cache_size)
+        self._mu = threading.Lock()
+
+    def add_tokenization(
+        self, prompt: str, tokens: Sequence[int], offsets: Sequence[Offset]
+    ) -> None:
+        if not prompt or not tokens:
+            return
+        prompt_bytes = prompt.encode("utf-8")
+        with self._mu:
+            token_idx = 0
+            prev_hash = 0
+            for start in range(0, len(prompt_bytes) - self.block_size + 1, self.block_size):
+                end = start + self.block_size
+                block_hash = _chunk_hash(prev_hash, prompt_bytes[start:end])
+                prev_hash = block_hash
+
+                # A token belongs to this chunk iff its end offset falls within
+                # it; a start offset before the chunk is fine.
+                block_tokens: List[int] = []
+                while token_idx < len(tokens) and offsets[token_idx][1] <= end:
+                    block_tokens.append(tokens[token_idx])
+                    token_idx += 1
+
+                self._cache.add(block_hash, block_tokens)
+
+    def find_longest_contained_tokens(self, prompt: str) -> Tuple[List[int], float]:
+        contained: List[int] = []
+        prompt_bytes = prompt.encode("utf-8")
+        prev_hash = 0
+        overlap_ratio = 0.0
+        for start in range(0, len(prompt_bytes) - self.block_size + 1, self.block_size):
+            end = start + self.block_size
+            block_hash = _chunk_hash(prev_hash, prompt_bytes[start:end])
+            prev_hash = block_hash
+
+            block_tokens = self._cache.get(block_hash)
+            if block_tokens is None:
+                break  # early stop: prefix chain broke
+            contained.extend(block_tokens)
+            overlap_ratio = end / len(prompt_bytes)
+        return contained, overlap_ratio

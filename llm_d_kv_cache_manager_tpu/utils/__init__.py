@@ -1,0 +1,4 @@
+from llm_d_kv_cache_manager_tpu.utils.lru import LRUCache
+from llm_d_kv_cache_manager_tpu.utils.humansize import parse_human_size
+
+__all__ = ["LRUCache", "parse_human_size"]

@@ -1,0 +1,35 @@
+"""Leveled logging with DEBUG/TRACE verbosity.
+
+Mirrors the reference's logr verbosity convention DEBUG=4, TRACE=5
+(/root/reference/pkg/utils/logging/levels.go:17-20) on top of stdlib logging:
+TRACE sits below logging.DEBUG so hot-path logs are free unless enabled.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+TRACE = 5  # below logging.DEBUG (10)
+DEBUG = logging.DEBUG
+
+logging.addLevelName(TRACE, "TRACE")
+
+
+def get_logger(name: str) -> logging.Logger:
+    return logging.getLogger(f"kvtpu.{name}")
+
+
+def trace(logger: logging.Logger, msg: str, *args) -> None:
+    if logger.isEnabledFor(TRACE):
+        logger.log(TRACE, msg, *args)
+
+
+def setup(level: str | None = None) -> None:
+    """Configure root logging once; level from arg or KVTPU_LOG_LEVEL env."""
+    level_name = (level or os.environ.get("KVTPU_LOG_LEVEL", "INFO")).upper()
+    resolved = TRACE if level_name == "TRACE" else getattr(logging, level_name, logging.INFO)
+    logging.basicConfig(
+        level=resolved,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )

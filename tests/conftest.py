@@ -1,0 +1,35 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU platform BEFORE jax is imported
+anywhere, so multi-chip sharding tests (parallel/, models/, engine/) run
+without TPU hardware. This mirrors how the driver dry-runs the multichip
+path (xla_force_host_platform_device_count).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+if "jax" in sys.modules:
+    # A plugin imported jax before us; the XLA backend is still uninitialized
+    # at collection time, so routing to CPU via the config API still works.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+FIXTURES_DIR = os.path.join(os.path.dirname(__file__), "fixtures")
+TEST_MODEL_NAME = "test-model"
+TEST_TOKENIZER_JSON = os.path.join(FIXTURES_DIR, "test-model", "tokenizer.json")
+
+
+@pytest.fixture
+def test_tokenizer_files():
+    return {TEST_MODEL_NAME: TEST_TOKENIZER_JSON}

@@ -1,0 +1,157 @@
+"""In-process fake Redis server speaking minimal RESP2.
+
+Test double equivalent to the reference's miniredis dependency
+(/root/reference/pkg/kvcache/kvblock/redis_test.go:22): enough of the
+protocol (PING, SET, GET, DEL, HSET, HDEL, HKEYS, HLEN, FLUSHALL, SELECT)
+for the RedisIndex behavior suite, no external server needed.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Dict
+
+
+class FakeRedisServer:
+    def __init__(self):
+        self._strings: Dict[bytes, bytes] = {}
+        self._hashes: Dict[bytes, Dict[bytes, bytes]] = {}
+        self._mu = threading.Lock()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"redis://127.0.0.1:{self.port}"
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # -- server loops --------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        buf = b""
+        try:
+            while not self._stop.is_set():
+                while b"\r\n" not in buf:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+                cmd, buf = self._parse_command(buf, conn)
+                if cmd is None:
+                    return
+                conn.sendall(self._dispatch(cmd))
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def _parse_command(self, buf: bytes, conn: socket.socket):
+        def read_line():
+            nonlocal buf
+            while b"\r\n" not in buf:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise OSError("client gone")
+                buf += chunk
+            line, rest = buf.split(b"\r\n", 1)
+            buf = rest
+            return line
+
+        def read_exact(n):
+            nonlocal buf
+            while len(buf) < n + 2:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise OSError("client gone")
+                buf += chunk
+            data, rest = buf[:n], buf[n + 2:]
+            buf = rest
+            return data
+
+        try:
+            header = read_line()
+            if not header.startswith(b"*"):
+                return None, buf
+            n = int(header[1:])
+            parts = []
+            for _ in range(n):
+                length_line = read_line()
+                assert length_line.startswith(b"$")
+                parts.append(read_exact(int(length_line[1:])))
+            return parts, buf
+        except OSError:
+            return None, buf
+
+    # -- command dispatch ----------------------------------------------------
+
+    def _dispatch(self, parts) -> bytes:
+        cmd = parts[0].upper()
+        args = parts[1:]
+        with self._mu:
+            if cmd == b"PING":
+                return b"+PONG\r\n"
+            if cmd == b"SELECT":
+                return b"+OK\r\n"
+            if cmd == b"FLUSHALL":
+                self._strings.clear()
+                self._hashes.clear()
+                return b"+OK\r\n"
+            if cmd == b"SET":
+                self._strings[args[0]] = args[1]
+                return b"+OK\r\n"
+            if cmd == b"GET":
+                value = self._strings.get(args[0])
+                if value is None:
+                    return b"$-1\r\n"
+                return b"$%d\r\n%s\r\n" % (len(value), value)
+            if cmd == b"DEL":
+                n = 0
+                for key in args:
+                    n += int(self._strings.pop(key, None) is not None)
+                    n += int(self._hashes.pop(key, None) is not None)
+                return b":%d\r\n" % n
+            if cmd == b"HSET":
+                h = self._hashes.setdefault(args[0], {})
+                added = 0
+                for i in range(1, len(args) - 1, 2):
+                    added += int(args[i] not in h)
+                    h[args[i]] = args[i + 1]
+                return b":%d\r\n" % added
+            if cmd == b"HDEL":
+                h = self._hashes.get(args[0], {})
+                n = sum(int(h.pop(f, None) is not None) for f in args[1:])
+                if not h:
+                    self._hashes.pop(args[0], None)
+                return b":%d\r\n" % n
+            if cmd == b"HKEYS":
+                fields = list(self._hashes.get(args[0], {}))
+                out = b"*%d\r\n" % len(fields)
+                for f in fields:
+                    out += b"$%d\r\n%s\r\n" % (len(f), f)
+                return out
+            if cmd == b"HLEN":
+                return b":%d\r\n" % len(self._hashes.get(args[0], {}))
+            return b"-ERR unknown command '%s'\r\n" % cmd

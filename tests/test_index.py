@@ -1,0 +1,268 @@
+"""Shared behavior suite for KV-block index backends.
+
+Mirrors the reference's parameterized backend suite
+(/root/reference/pkg/kvcache/kvblock/index_test.go:35-63): every backend must
+pass the same behavioral contract. Backends register via the `index_factory`
+fixture params.
+"""
+
+import threading
+
+import pytest
+
+from tests.fake_redis import FakeRedisServer
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.cost_aware import (
+    CostAwareIndexConfig,
+    CostAwareMemoryIndex,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+    InMemoryIndex,
+    InMemoryIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.instrumented import InstrumentedIndex
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import (
+    RedisIndex,
+    RedisIndexConfig,
+)
+
+
+def _k(i: int, model: str = "m") -> Key:
+    return Key(model, i)
+
+
+def _pod(name: str, tier: str = "hbm") -> PodEntry:
+    return PodEntry(name, tier)
+
+
+_fake_redis = None
+
+
+def _redis_backend():
+    global _fake_redis
+    if _fake_redis is None:
+        _fake_redis = FakeRedisServer()
+    index = RedisIndex(RedisIndexConfig(url=_fake_redis.url))
+    index._pipeline([("FLUSHALL",)])
+    return index
+
+
+BACKENDS = {
+    "in_memory": lambda: InMemoryIndex(InMemoryIndexConfig(size=1000, pod_cache_size=10)),
+    "cost_aware": lambda: CostAwareMemoryIndex(
+        CostAwareIndexConfig(max_size_bytes="1MiB", pod_cache_size=10)
+    ),
+    "instrumented": lambda: InstrumentedIndex(
+        InMemoryIndex(InMemoryIndexConfig(size=1000, pod_cache_size=10))
+    ),
+    "redis": _redis_backend,
+}
+
+
+@pytest.fixture(params=sorted(BACKENDS))
+def index(request):
+    backend = BACKENDS[request.param]()
+    yield backend
+
+
+class TestCommonIndexBehavior:
+    def test_basic_add_and_lookup(self, index):
+        keys = [_k(1), _k(2)]
+        index.add(keys, keys, [_pod("p1")])
+        got = index.lookup(keys, set())
+        assert got == {_k(1): [_pod("p1")], _k(2): [_pod("p1")]}
+
+    def test_duplicate_pod_handling(self, index):
+        index.add([_k(1)], [_k(1)], [_pod("p1")])
+        index.add([_k(1)], [_k(1)], [_pod("p1")])
+        got = index.lookup([_k(1)], set())
+        assert got[_k(1)] == [_pod("p1")]
+
+    def test_multiple_pods_and_tiers(self, index):
+        index.add([_k(1)], [_k(1)], [_pod("p1", "hbm"), _pod("p1", "host"), _pod("p2")])
+        got = index.lookup([_k(1)], set())
+        assert set(got[_k(1)]) == {_pod("p1", "hbm"), _pod("p1", "host"), _pod("p2")}
+
+    def test_filtered_lookup(self, index):
+        index.add([_k(1)], [_k(1)], [_pod("p1"), _pod("p2")])
+        got = index.lookup([_k(1)], {"p2"})
+        assert got[_k(1)] == [_pod("p2")]
+
+    def test_filtered_lookup_no_match_omits_key(self, index):
+        index.add([_k(1)], [_k(1)], [_pod("p1")])
+        got = index.lookup([_k(1)], {"nope"})
+        assert _k(1) not in got
+
+    def test_evict_basic(self, index):
+        index.add([_k(1)], [_k(1)], [_pod("p1"), _pod("p2")])
+        index.evict(_k(1), [_pod("p1")])
+        got = index.lookup([_k(1)], set())
+        assert got[_k(1)] == [_pod("p2")]
+
+    def test_evict_last_pod_removes_key(self, index):
+        index.add([_k(1)], [_k(1)], [_pod("p1")])
+        index.evict(_k(1), [_pod("p1")])
+        got = index.lookup([_k(1)], set())
+        assert got == {}
+        assert index.get_request_key(_k(1)) is None
+
+    def test_evict_unknown_engine_key_is_noop(self, index):
+        index.evict(_k(99), [_pod("p1")])
+
+    def test_engine_to_request_key_mapping(self, index):
+        engine, request = _k(100), _k(200)
+        index.add([engine], [request], [_pod("p1")])
+        assert index.get_request_key(engine) == request
+        # lookups must use request keys, not engine keys
+        assert request in index.lookup([request], set())
+
+    def test_empty_inputs_raise(self, index):
+        with pytest.raises(ValueError):
+            index.lookup([], set())
+        with pytest.raises(ValueError):
+            index.add([], [], [])
+        with pytest.raises(ValueError):
+            index.evict(_k(1), [])
+
+    def test_mismatched_key_lengths_raise(self, index):
+        with pytest.raises(ValueError):
+            index.add([_k(1), _k(2)], [_k(1)], [_pod("p1")])
+
+    def test_concurrent_operations(self, index):
+        keys = [_k(i) for i in range(20)]
+        errors = []
+
+        def writer(pod: str):
+            try:
+                for key in keys:
+                    index.add([key], [key], [_pod(pod)])
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def reader():
+            try:
+                for _ in range(50):
+                    index.lookup(keys, set())
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(f"p{i}",)) for i in range(4)]
+        threads += [threading.Thread(target=reader) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        got = index.lookup(keys, set())
+        for key in keys:
+            assert {e.pod_identifier for e in got[key]} == {"p0", "p1", "p2", "p3"}
+
+
+class TestInMemorySpecific:
+    def test_missing_key_does_not_cut_lookup(self):
+        # In-memory semantics: a *missing* key doesn't cut (only a present key
+        # with an empty pod set does) — reference in_memory.go:137-139. The
+        # Redis backend cuts on misses too (redis.go:199-205), hence not in
+        # the shared suite.
+        index = InMemoryIndex(InMemoryIndexConfig(size=10, pod_cache_size=2))
+        index.add([_k(2)], [_k(2)], [_pod("p1")])
+        got = index.lookup([_k(1), _k(2)], set())
+        assert got == {_k(2): [_pod("p1")]}
+
+    def test_lru_size_bound(self):
+        index = InMemoryIndex(InMemoryIndexConfig(size=5, pod_cache_size=2))
+        keys = [_k(i) for i in range(10)]
+        for key in keys:
+            index.add([key], [key], [_pod("p1")])
+        present = sum(1 for key in keys if index.lookup([key], set()))
+        assert present == 5
+
+    def test_pod_cache_size_bound(self):
+        index = InMemoryIndex(InMemoryIndexConfig(size=10, pod_cache_size=2))
+        for i in range(5):
+            index.add([_k(1)], [_k(1)], [_pod(f"p{i}")])
+        got = index.lookup([_k(1)], set())
+        assert len(got[_k(1)]) == 2
+
+    def test_empty_pod_cache_cuts_lookup(self):
+        # A key that exists with no pods means the prefix chain is broken
+        # there: later keys must not be returned.
+        index = InMemoryIndex(InMemoryIndexConfig(size=10, pod_cache_size=2))
+        for i in (1, 2, 3):
+            index.add([_k(i)], [_k(i)], [_pod("p1")])
+        # Manually empty key 2's pod cache without removing the key.
+        pod_cache = index._data.get(_k(2))
+        pod_cache.cache.remove(_pod("p1"))
+        got = index.lookup([_k(1), _k(2), _k(3)], set())
+        assert _k(1) in got and _k(2) not in got and _k(3) not in got
+
+
+class TestCostAwareSpecific:
+    def test_budget_eviction(self):
+        index = CostAwareMemoryIndex(
+            CostAwareIndexConfig(max_size_bytes=2000, pod_cache_size=4)
+        )
+        keys = [_k(i) for i in range(50)]
+        for key in keys:
+            index.add([key], [key], [_pod("p1")])
+        assert index.total_cost_bytes <= 2000
+        # Oldest keys were evicted, newest survive.
+        assert index.lookup([keys[-1]], set())
+        assert not index.lookup([keys[0]], set())
+
+    def test_human_size_parsing(self):
+        index = CostAwareMemoryIndex(CostAwareIndexConfig(max_size_bytes="4KiB"))
+        assert index._budget == 4096
+
+    def test_evicted_key_drops_engine_mapping(self):
+        index = CostAwareMemoryIndex(
+            CostAwareIndexConfig(max_size_bytes=600, pod_cache_size=4)
+        )
+        engine, request = _k(1000), _k(2000)
+        index.add([engine], [request], [_pod("p1")])
+        for i in range(30):  # push the first key out of budget
+            index.add([_k(i)], [_k(i)], [_pod("p1")])
+        assert index.get_request_key(engine) is None
+
+
+class TestRedisSpecific:
+    def test_valkey_url_normalization(self):
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.resp import _normalize_url
+
+        assert _normalize_url("valkey://h:1") == "redis://h:1"
+        assert _normalize_url("valkeys://h:1") == "rediss://h:1"
+        assert _normalize_url("h:1") == "redis://h:1"
+        assert _normalize_url("redis://h:1") == "redis://h:1"
+
+    def test_missing_key_cuts_lookup(self):
+        index = _redis_backend()
+        index.add([_k(2)], [_k(2)], [_pod("p1")])
+        # Key 1 missing: Redis semantics cut the walk immediately.
+        assert index.lookup([_k(1), _k(2)], set()) == {}
+        index.close()
+
+    def test_shared_state_across_clients(self):
+        a = _redis_backend()
+        b = RedisIndex(RedisIndexConfig(url=_fake_redis.url))
+        a.add([_k(5)], [_k(5)], [_pod("p9")])
+        assert b.lookup([_k(5)], set()) == {_k(5): [_pod("p9")]}
+        a.close()
+        b.close()
+
+
+class TestInstrumentedMetrics:
+    def test_counters_increment(self):
+        from llm_d_kv_cache_manager_tpu.metrics import collector as m
+
+        m.register_metrics()
+        index = InstrumentedIndex(
+            InMemoryIndex(InMemoryIndexConfig(size=100, pod_cache_size=4))
+        )
+        before_adds = m.index_admissions._value.get()
+        before_lookups = m.index_lookup_requests._value.get()
+        index.add([_k(1), _k(2)], [_k(1), _k(2)], [_pod("p1")])
+        index.lookup([_k(1), _k(2)], set())
+        index.evict(_k(1), [_pod("p1")])
+        assert m.index_admissions._value.get() == before_adds + 2
+        assert m.index_lookup_requests._value.get() == before_lookups + 1
+        assert m.index_max_pod_hits._sum.get() >= 2

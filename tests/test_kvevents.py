@@ -1,0 +1,240 @@
+"""KVEvents schema + sharded pool tests.
+
+Mirrors the reference's event decode/digest behavior
+(/root/reference/pkg/kvcache/kvevents/pool.go:177-338) including hash
+coercion (uint64 / int64 / bytes-tail-8, pool.go:343-367), parent-chain
+continuation via get_request_key, and poison-pill dropping.
+"""
+
+import time
+
+import msgpack
+import pytest
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+    InMemoryIndex,
+    InMemoryIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.events import (
+    AllBlocksCleared,
+    BlockRemoved,
+    BlockStored,
+    EventBatch,
+    hash_as_uint64,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.pool import (
+    EventPool,
+    EventPoolConfig,
+    Message,
+)
+
+
+class TestHashCoercion:
+    def test_int_passthrough(self):
+        assert hash_as_uint64(42) == 42
+
+    def test_negative_int64_wraps_to_uint64(self):
+        assert hash_as_uint64(-1) == 0xFFFFFFFFFFFFFFFF
+
+    def test_bytes_tail_8_big_endian(self):
+        raw = bytes(range(1, 13))  # 12 bytes: take last 8
+        assert hash_as_uint64(raw) == int.from_bytes(raw[-8:], "big")
+
+    def test_short_bytes_left_padded(self):
+        assert hash_as_uint64(b"\x01\x02") == 0x0102
+
+    def test_empty_bytes_raises(self):
+        with pytest.raises(ValueError):
+            hash_as_uint64(b"")
+
+    def test_unsupported_type_raises(self):
+        with pytest.raises(TypeError):
+            hash_as_uint64("nope")
+
+
+class TestEventBatchWire:
+    def test_roundtrip_block_stored(self):
+        batch = EventBatch(
+            ts=123.5,
+            events=[
+                BlockStored(
+                    block_hashes=[1, 2],
+                    parent_block_hash=None,
+                    token_ids=[10, 11, 12, 13],
+                    block_size=4,
+                    medium="hbm",
+                )
+            ],
+        )
+        decoded = EventBatch.from_msgpack(batch.to_msgpack())
+        assert decoded.ts == 123.5
+        ev = decoded.events[0]
+        assert isinstance(ev, BlockStored)
+        assert ev.block_hashes == [1, 2]
+        assert ev.token_ids == [10, 11, 12, 13]
+        assert ev.medium == "hbm"
+
+    def test_roundtrip_removed_and_cleared(self):
+        batch = EventBatch(
+            ts=1.0,
+            events=[BlockRemoved(block_hashes=[7]), AllBlocksCleared()],
+            data_parallel_rank=3,
+        )
+        decoded = EventBatch.from_msgpack(batch.to_msgpack())
+        assert isinstance(decoded.events[0], BlockRemoved)
+        assert isinstance(decoded.events[1], AllBlocksCleared)
+        assert decoded.data_parallel_rank == 3
+
+    def test_wire_format_is_arrays(self):
+        # vLLM compatibility: everything is msgpack arrays, not maps.
+        batch = EventBatch(ts=2.0, events=[BlockStored([5], None, [1], 1)])
+        raw = msgpack.unpackb(batch.to_msgpack(), raw=False)
+        assert raw[0] == 2.0
+        assert raw[1][0][0] == "BlockStored"
+
+    def test_unknown_tag_skipped(self):
+        raw = msgpack.packb([1.0, [["FutureEvent", 1, 2], ["AllBlocksCleared"]]])
+        decoded = EventBatch.from_msgpack(raw)
+        assert len(decoded.events) == 1
+
+    def test_bytes_hashes_survive_roundtrip(self):
+        h = (123456789).to_bytes(32, "big")  # sha256-style 32-byte hash
+        batch = EventBatch(ts=0.0, events=[BlockStored([h], h, [1, 2], 2)])
+        decoded = EventBatch.from_msgpack(batch.to_msgpack())
+        assert decoded.events[0].block_hashes[0] == h
+
+
+def _make_pool(block_size=4):
+    index = InMemoryIndex(InMemoryIndexConfig(size=1000, pod_cache_size=10))
+    processor = ChunkedTokenDatabase(TokenProcessorConfig(block_size=block_size))
+    pool = EventPool(EventPoolConfig(concurrency=2), index, processor)
+    pool.start(with_subscriber=False)
+    return pool, index, processor
+
+
+def _msg(batch: EventBatch, pod="pod-1", model="m") -> Message:
+    return Message(
+        topic=f"kv@{pod}@{model}",
+        payload=batch.to_msgpack(),
+        seq=0,
+        pod_identifier=pod,
+        model_name=model,
+    )
+
+
+class TestEventPool:
+    def test_block_stored_populates_index(self):
+        pool, index, processor = _make_pool()
+        try:
+            tokens = [1, 2, 3, 4, 5, 6, 7, 8]
+            request_keys = processor.tokens_to_kv_block_keys(None, tokens, "m")
+            batch = EventBatch(
+                ts=0.0,
+                events=[BlockStored([100, 200], None, tokens, 4)],
+            )
+            pool.add_task(_msg(batch))
+            pool.drain()
+            got = index.lookup(request_keys, set())
+            assert got[request_keys[0]] == [PodEntry("pod-1", "hbm")]
+            assert got[request_keys[1]] == [PodEntry("pod-1", "hbm")]
+            # Engine keys resolve to request keys.
+            assert index.get_request_key(Key("m", 100)) == request_keys[0]
+            assert index.get_request_key(Key("m", 200)) == request_keys[1]
+        finally:
+            pool.shutdown()
+
+    def test_medium_overrides_tier(self):
+        pool, index, processor = _make_pool()
+        try:
+            tokens = [1, 2, 3, 4]
+            batch = EventBatch(
+                ts=0.0, events=[BlockStored([100], None, tokens, 4, medium="HOST")]
+            )
+            pool.add_task(_msg(batch))
+            pool.drain()
+            keys = processor.tokens_to_kv_block_keys(None, tokens, "m")
+            got = index.lookup(keys, set())
+            assert got[keys[0]] == [PodEntry("pod-1", "host")]  # lowercased
+        finally:
+            pool.shutdown()
+
+    def test_parent_chain_continuation(self):
+        pool, index, processor = _make_pool()
+        try:
+            t1, t2 = [1, 2, 3, 4], [5, 6, 7, 8]
+            pool.add_task(_msg(EventBatch(0.0, [BlockStored([100], None, t1, 4)])))
+            pool.drain()
+            # Second event continues from engine-parent 100.
+            pool.add_task(_msg(EventBatch(1.0, [BlockStored([200], 100, t2, 4)])))
+            pool.drain()
+            full_keys = processor.tokens_to_kv_block_keys(None, t1 + t2, "m")
+            got = index.lookup(full_keys, set())
+            assert set(got) == set(full_keys)  # chained request keys match
+        finally:
+            pool.shutdown()
+
+    def test_unknown_parent_starts_fresh_chain(self):
+        pool, index, processor = _make_pool()
+        try:
+            tokens = [5, 6, 7, 8]
+            pool.add_task(_msg(EventBatch(0.0, [BlockStored([200], 999, tokens, 4)])))
+            pool.drain()
+            # Parent unknown → request key computed from root.
+            keys = processor.tokens_to_kv_block_keys(None, tokens, "m")
+            assert keys[0] in index.lookup(keys, set())
+        finally:
+            pool.shutdown()
+
+    def test_block_removed_evicts(self):
+        pool, index, processor = _make_pool()
+        try:
+            tokens = [1, 2, 3, 4]
+            pool.add_task(_msg(EventBatch(0.0, [BlockStored([100], None, tokens, 4)])))
+            pool.drain()
+            pool.add_task(_msg(EventBatch(1.0, [BlockRemoved([100])])))
+            pool.drain()
+            keys = processor.tokens_to_kv_block_keys(None, tokens, "m")
+            assert index.lookup(keys, set()) == {}
+        finally:
+            pool.shutdown()
+
+    def test_poison_pill_dropped(self):
+        pool, index, _ = _make_pool()
+        try:
+            pool.add_task(
+                Message(
+                    topic="kv@pod-1@m",
+                    payload=b"\xc1garbage",
+                    seq=0,
+                    pod_identifier="pod-1",
+                    model_name="m",
+                )
+            )
+            pool.drain()  # must not hang or crash the worker
+            # Pool still functional afterwards.
+            tokens = [1, 2, 3, 4]
+            pool.add_task(_msg(EventBatch(0.0, [BlockStored([1], None, tokens, 4)])))
+            pool.drain()
+            keys = pool.token_processor.tokens_to_kv_block_keys(None, tokens, "m")
+            assert keys[0] in index.lookup(keys, set())
+        finally:
+            pool.shutdown()
+
+    def test_per_pod_ordering_same_shard(self):
+        pool, index, processor = _make_pool()
+        try:
+            tokens = [1, 2, 3, 4]
+            # Store then remove, many times: final state must be "removed".
+            for _ in range(20):
+                pool.add_task(_msg(EventBatch(0.0, [BlockStored([100], None, tokens, 4)])))
+                pool.add_task(_msg(EventBatch(1.0, [BlockRemoved([100])])))
+            pool.drain()
+            keys = processor.tokens_to_kv_block_keys(None, tokens, "m")
+            assert index.lookup(keys, set()) == {}
+        finally:
+            pool.shutdown()

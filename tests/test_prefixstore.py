@@ -1,0 +1,126 @@
+"""Prefix-token store tests (LRU chained-hash store + trie store).
+
+Mirrors /root/reference/pkg/tokenization/prefixstore/lru_store_test.go:49-162:
+add/retrieve, prefix matching, partial mismatch, eviction bounds.
+"""
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.tokenization.prefixstore.lru_store import (
+    LRUStoreConfig,
+    LRUTokenStore,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.prefixstore.trie_store import (
+    TrieTokenStore,
+)
+
+
+def _offsets_for(prompt: str):
+    """One token per 4 bytes, offsets covering the prompt."""
+    b = len(prompt.encode("utf-8"))
+    tokens, offsets = [], []
+    for i, start in enumerate(range(0, b, 4)):
+        tokens.append(i + 100)
+        offsets.append((start, min(start + 4, b)))
+    return tokens, offsets
+
+
+class TestLRUTokenStore:
+    def _store(self, block_size=16, cache_size=100):
+        return LRUTokenStore(LRUStoreConfig(cache_size=cache_size, block_size=block_size))
+
+    def test_roundtrip_full_coverage(self):
+        store = self._store(block_size=16)
+        prompt = "a" * 64
+        tokens, offsets = _offsets_for(prompt)
+        store.add_tokenization(prompt, tokens, offsets)
+        got, ratio = store.find_longest_contained_tokens(prompt)
+        assert got == tokens
+        assert ratio == 1.0
+
+    def test_prefix_match(self):
+        store = self._store(block_size=16)
+        prompt = "a" * 64
+        tokens, offsets = _offsets_for(prompt)
+        store.add_tokenization(prompt, tokens, offsets)
+        # Same first 32 bytes, different tail: only 2 chunks match.
+        other = "a" * 32 + "b" * 32
+        got, ratio = store.find_longest_contained_tokens(other)
+        assert got == tokens[:8]
+        assert ratio == 0.5
+
+    def test_mismatch_first_block(self):
+        store = self._store(block_size=16)
+        prompt = "a" * 64
+        tokens, offsets = _offsets_for(prompt)
+        store.add_tokenization(prompt, tokens, offsets)
+        got, ratio = store.find_longest_contained_tokens("z" * 64)
+        assert got == [] and ratio == 0.0
+
+    def test_short_prompt_no_full_block(self):
+        store = self._store(block_size=16)
+        got, ratio = store.find_longest_contained_tokens("short")
+        assert got == [] and ratio == 0.0
+        store.add_tokenization("short", [1], [(0, 5)])  # no-op: < 1 block
+        assert store.find_longest_contained_tokens("short") == ([], 0.0)
+
+    def test_token_chunk_assignment_by_end_offset(self):
+        store = self._store(block_size=8)
+        prompt = "x" * 16
+        # Token 1 ends at 8 (chunk 0), token 2 spans the boundary ending at 12
+        # (chunk 1), token 3 ends at 16 (chunk 1).
+        tokens = [1, 2, 3]
+        offsets = [(0, 8), (6, 12), (12, 16)]
+        store.add_tokenization(prompt, tokens, offsets)
+        got, ratio = store.find_longest_contained_tokens("x" * 8)
+        assert got == [1] and ratio == 1.0
+
+    def test_bos_zero_offset_token_in_first_block(self):
+        store = self._store(block_size=8)
+        prompt = "x" * 8
+        store.add_tokenization(prompt, [7, 1], [(0, 0), (0, 8)])
+        got, _ = store.find_longest_contained_tokens(prompt)
+        assert got == [7, 1]
+
+    def test_lru_eviction_bound(self):
+        store = self._store(block_size=4, cache_size=4)
+        prompt = "a" * 64  # 16 chunks > cache_size 4
+        tokens, offsets = _offsets_for(prompt)
+        store.add_tokenization(prompt, tokens, offsets)
+        got, _ = store.find_longest_contained_tokens(prompt)
+        assert got == []  # early chunks evicted → chain broken at block 0
+
+    def test_unicode_byte_chunking(self):
+        store = self._store(block_size=4)
+        prompt = "héllo wörld!"  # multi-byte chars
+        b = prompt.encode("utf-8")
+        tokens = [1]
+        offsets = [(0, len(b))]
+        store.add_tokenization(prompt, tokens, offsets)
+        got, ratio = store.find_longest_contained_tokens(prompt)
+        n_full = (len(b) // 4) * 4
+        assert ratio == pytest.approx(n_full / len(b))
+
+
+class TestTrieTokenStore:
+    def test_roundtrip(self):
+        store = TrieTokenStore()
+        prompt = "hello world"
+        tokens = [1, 2]
+        offsets = [(0, 5), (5, 11)]
+        store.add_tokenization(prompt, tokens, offsets)
+        got, ratio = store.find_longest_contained_tokens(prompt)
+        assert got == [1, 2] and ratio == 1.0
+
+    def test_partial_prefix(self):
+        store = TrieTokenStore()
+        store.add_tokenization("hello world", [1, 2], [(0, 5), (5, 11)])
+        got, ratio = store.find_longest_contained_tokens("hello there")
+        assert got == [1]
+        assert 0 < ratio < 1
+
+    def test_divergent_first_char(self):
+        store = TrieTokenStore()
+        store.add_tokenization("hello", [1], [(0, 5)])
+        got, ratio = store.find_longest_contained_tokens("zebra")
+        assert got == [] and ratio == 0.0

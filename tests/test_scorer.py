@@ -1,0 +1,85 @@
+"""LongestPrefixScorer tests.
+
+Mirrors the reference scorer cases
+(/root/reference/pkg/kvcache/kvblock_scorer_test.go:34-110): consecutive-from-
+block-0 matching, intersection semantics, device-tier weighting.
+"""
+
+from llm_d_kv_cache_manager_tpu.kvcache.backend import KVCacheBackendConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.scorer import (
+    KVBlockScorerConfig,
+    new_kv_block_scorer,
+)
+
+
+def _k(i):
+    return Key("m", i)
+
+
+def _scorer(**weights):
+    cfg = KVBlockScorerConfig(
+        backend_configs=[KVCacheBackendConfig(n, w) for n, w in weights.items()]
+    )
+    return new_kv_block_scorer(cfg)
+
+
+class TestLongestPrefixScorer:
+    def test_empty_keys(self):
+        assert _scorer(hbm=1.0).score([], {}) == {}
+
+    def test_single_pod_full_prefix(self):
+        s = _scorer(hbm=1.0)
+        keys = [_k(1), _k(2), _k(3)]
+        mapping = {k: [PodEntry("p1", "hbm")] for k in keys}
+        assert s.score(keys, mapping) == {"p1": 3.0}
+
+    def test_prefix_breaks_at_gap(self):
+        s = _scorer(hbm=1.0)
+        keys = [_k(1), _k(2), _k(3)]
+        mapping = {_k(1): [PodEntry("p1", "hbm")], _k(3): [PodEntry("p1", "hbm")]}
+        # p1 misses block 2: score stops at 1 even though block 3 is cached.
+        assert s.score(keys, mapping) == {"p1": 1.0}
+
+    def test_pod_missing_first_block_scores_zero(self):
+        s = _scorer(hbm=1.0)
+        keys = [_k(1), _k(2)]
+        mapping = {
+            _k(1): [PodEntry("p1", "hbm")],
+            _k(2): [PodEntry("p1", "hbm"), PodEntry("p2", "hbm")],
+        }
+        scores = s.score(keys, mapping)
+        assert scores == {"p1": 2.0}
+        assert "p2" not in scores
+
+    def test_intersection_drops_pod_but_keeps_score(self):
+        s = _scorer(hbm=1.0)
+        keys = [_k(1), _k(2), _k(3)]
+        mapping = {
+            _k(1): [PodEntry("p1", "hbm"), PodEntry("p2", "hbm")],
+            _k(2): [PodEntry("p1", "hbm")],
+            _k(3): [PodEntry("p1", "hbm")],
+        }
+        assert s.score(keys, mapping) == {"p1": 3.0, "p2": 1.0}
+
+    def test_tier_weights(self):
+        s = _scorer(hbm=1.0, host=0.8)
+        keys = [_k(1), _k(2)]
+        mapping = {
+            _k(1): [PodEntry("p1", "host"), PodEntry("p2", "hbm")],
+            _k(2): [PodEntry("p1", "host"), PodEntry("p2", "hbm")],
+        }
+        scores = s.score(keys, mapping)
+        assert scores["p1"] == 1.6 and scores["p2"] == 2.0
+
+    def test_max_tier_weight_per_block(self):
+        s = _scorer(hbm=1.0, host=0.8)
+        keys = [_k(1)]
+        mapping = {_k(1): [PodEntry("p1", "host"), PodEntry("p1", "hbm")]}
+        assert s.score(keys, mapping) == {"p1": 1.0}
+
+    def test_unknown_tier_defaults_to_one(self):
+        s = _scorer(hbm=1.0)
+        keys = [_k(1)]
+        mapping = {_k(1): [PodEntry("p1", "mystery-tier")]}
+        assert s.score(keys, mapping) == {"p1": 1.0}

@@ -1,0 +1,196 @@
+"""Tokenizer stack + tokenization pool tests.
+
+Mirrors /root/reference/pkg/tokenization/tokenizer_test.go (local encode,
+discovery layouts, composite fallback) and pool_test.go (prefix-store
+shortcut, sync/async modes) using the generated tests/fixtures tokenizer.
+"""
+
+import os
+import threading
+
+import pytest
+
+from tests.conftest import TEST_MODEL_NAME, TEST_TOKENIZER_JSON
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPool,
+    TokenizersPoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.prefixstore.lru_store import (
+    LRUStoreConfig,
+    LRUTokenStore,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.tokenizer import (
+    CachedLocalTokenizer,
+    CompositeTokenizer,
+    TokenizationResult,
+    Tokenizer,
+    discover_local_tokenizers,
+)
+
+
+class TestCachedLocalTokenizer:
+    def test_encode_with_byte_offsets(self, test_tokenizer_files):
+        tok = CachedLocalTokenizer(tokenizer_files=test_tokenizer_files)
+        result = tok.encode("The quick brown fox", TEST_MODEL_NAME)
+        assert result.tokens
+        assert len(result.tokens) == len(result.offsets)
+        assert result.offsets[-1][1] == len("The quick brown fox".encode("utf-8"))
+
+    def test_unicode_byte_offsets(self, test_tokenizer_files):
+        tok = CachedLocalTokenizer(tokenizer_files=test_tokenizer_files)
+        prompt = "héllo wörld"
+        result = tok.encode(prompt, TEST_MODEL_NAME)
+        assert result.offsets[-1][1] == len(prompt.encode("utf-8"))
+
+    def test_unknown_model_raises(self, test_tokenizer_files):
+        tok = CachedLocalTokenizer(tokenizer_files=test_tokenizer_files)
+        with pytest.raises(Exception):
+            tok.encode("hi", "no-such-model")
+
+    def test_tokenizer_instance_cached(self, test_tokenizer_files):
+        tok = CachedLocalTokenizer(tokenizer_files=test_tokenizer_files)
+        tok.encode("one", TEST_MODEL_NAME)
+        first = tok._cache.get(TEST_MODEL_NAME)
+        tok.encode("two", TEST_MODEL_NAME)
+        assert tok._cache.get(TEST_MODEL_NAME) is first
+
+    def test_concurrent_loads_singleflight(self, test_tokenizer_files):
+        tok = CachedLocalTokenizer(tokenizer_files=test_tokenizer_files)
+        results, errors = [], []
+
+        def encode():
+            try:
+                results.append(tok.encode("concurrent load", TEST_MODEL_NAME))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=encode) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert all(r.tokens == results[0].tokens for r in results)
+
+
+class TestDiscovery:
+    def test_hf_cache_layout(self, tmp_path):
+        snap = tmp_path / "models--org--name" / "snapshots" / "abc123"
+        snap.mkdir(parents=True)
+        (snap / "tokenizer.json").write_text("{}")
+        found = discover_local_tokenizers(str(tmp_path))
+        assert found == {"org/name": str(snap / "tokenizer.json")}
+
+    def test_plain_relative_dir_layout(self, tmp_path):
+        d = tmp_path / "my" / "model"
+        d.mkdir(parents=True)
+        (d / "tokenizer.json").write_text("{}")
+        found = discover_local_tokenizers(str(tmp_path))
+        assert found == {"my/model": str(d / "tokenizer.json")}
+
+    def test_custom_filename(self, tmp_path):
+        d = tmp_path / "model"
+        d.mkdir()
+        (d / "tok.json").write_text("{}")
+        assert discover_local_tokenizers(str(tmp_path), "tok.json") == {
+            "model": str(d / "tok.json")
+        }
+
+    def test_missing_dir(self):
+        assert discover_local_tokenizers("/no/such/dir") == {}
+
+
+class _FailingTokenizer(Tokenizer):
+    def encode(self, prompt, model_name):
+        raise RuntimeError("backend down")
+
+
+class _CountingTokenizer(Tokenizer):
+    def __init__(self):
+        self.calls = 0
+
+    def encode(self, prompt, model_name):
+        self.calls += 1
+        b = prompt.encode("utf-8")
+        tokens = list(range(0, len(b), 4))
+        offsets = [(i, min(i + 4, len(b))) for i in tokens]
+        return TokenizationResult(tokens=tokens, offsets=offsets)
+
+
+class TestCompositeTokenizer:
+    def test_fallback_order(self, test_tokenizer_files):
+        composite = CompositeTokenizer(
+            [_FailingTokenizer(), CachedLocalTokenizer(tokenizer_files=test_tokenizer_files)]
+        )
+        result = composite.encode("fallback works", TEST_MODEL_NAME)
+        assert result.tokens
+
+    def test_all_fail_raises_with_causes(self):
+        composite = CompositeTokenizer([_FailingTokenizer(), _FailingTokenizer()])
+        with pytest.raises(RuntimeError, match="backend down"):
+            composite.encode("hi", "m")
+
+
+class TestTokenizationPool:
+    def _pool(self, tokenizer, block_size=16):
+        store = LRUTokenStore(LRUStoreConfig(cache_size=1000, block_size=block_size))
+        pool = TokenizationPool(
+            TokenizersPoolConfig(workers=2), prefix_store=store, tokenizer=tokenizer
+        )
+        pool.run()
+        return pool
+
+    def test_sync_tokenize(self):
+        counting = _CountingTokenizer()
+        pool = self._pool(counting)
+        try:
+            tokens = pool.tokenize(None, "x" * 64, "m")
+            assert tokens == list(range(0, 64, 4))
+            assert counting.calls == 1
+        finally:
+            pool.shutdown()
+
+    def test_prefix_store_shortcut_skips_encode(self):
+        counting = _CountingTokenizer()
+        pool = self._pool(counting, block_size=16)
+        try:
+            prompt = "y" * 64
+            pool.tokenize(None, prompt, "m")
+            assert counting.calls == 1
+            # Fully covered prompt: second call must come from the store.
+            tokens = pool.tokenize(None, prompt, "m")
+            assert counting.calls == 1
+            assert tokens == list(range(0, 64, 4))
+        finally:
+            pool.shutdown()
+
+    def test_low_overlap_reencodes(self):
+        counting = _CountingTokenizer()
+        pool = self._pool(counting, block_size=16)
+        try:
+            pool.tokenize(None, "a" * 64, "m")
+            pool.tokenize(None, "a" * 16 + "b" * 48, "m")  # 25% overlap < 0.8
+            assert counting.calls == 2
+        finally:
+            pool.shutdown()
+
+    def test_enqueue_async_populates_store(self):
+        counting = _CountingTokenizer()
+        pool = self._pool(counting)
+        try:
+            pool.enqueue_tokenization(None, "z" * 64, "m")
+            pool.drain()
+            assert counting.calls == 1
+            # Blocking call after async warm: served from store.
+            pool.tokenize(None, "z" * 64, "m")
+            assert counting.calls == 1
+        finally:
+            pool.shutdown()
+
+    def test_error_propagates_to_caller(self):
+        pool = self._pool(_FailingTokenizer())
+        try:
+            with pytest.raises(RuntimeError, match="backend down"):
+                pool.tokenize(None, "q" * 64, "m")
+        finally:
+            pool.shutdown()

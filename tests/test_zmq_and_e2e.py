@@ -1,0 +1,126 @@
+"""ZMQ wire integration + offline end-to-end slice.
+
+The e2e scenario reproduces the reference's offline example flow
+(/root/reference/examples/kv_events/offline/main.go:129-173): an in-process
+ZMQ publisher simulates a vLLM-TPU engine publishing real msgpack KVEvents
+into the bound subscriber; `get_pod_scores` must then rank the publishing pod
+by its cached prefix.
+"""
+
+import os
+import time
+import uuid
+
+import pytest
+
+from tests.conftest import TEST_MODEL_NAME, TEST_TOKENIZER_JSON
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.events import BlockStored, EventBatch
+from llm_d_kv_cache_manager_tpu.kvevents.pool import EventPool, EventPoolConfig
+from llm_d_kv_cache_manager_tpu.kvevents.publisher import Publisher, make_topic
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPool,
+    TokenizersPoolConfig,
+)
+
+
+def _wait_until(predicate, timeout=10.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def endpoint(tmp_path):
+    return f"ipc://{tmp_path}/kvevents-{uuid.uuid4().hex[:8]}.sock"
+
+
+class TestZMQWire:
+    def test_publish_subscribe_roundtrip(self, endpoint):
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+            InMemoryIndex,
+            InMemoryIndexConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+            ChunkedTokenDatabase,
+        )
+
+        index = InMemoryIndex(InMemoryIndexConfig(size=1000, pod_cache_size=10))
+        processor = ChunkedTokenDatabase(TokenProcessorConfig(block_size=4))
+        pool = EventPool(
+            EventPoolConfig(zmq_endpoint=endpoint, concurrency=2), index, processor
+        )
+        pool.start(with_subscriber=True)
+        try:
+            publisher = Publisher(endpoint, make_topic("pod-a", "m"))
+            time.sleep(0.3)  # let SUB/PUB connect (slow-joiner)
+            tokens = [1, 2, 3, 4, 5, 6, 7, 8]
+            publisher.publish(
+                EventBatch(ts=time.monotonic(), events=[BlockStored([11, 22], None, tokens, 4)])
+            )
+            keys = processor.tokens_to_kv_block_keys(None, tokens, "m")
+            assert _wait_until(lambda: len(index.lookup(keys, set())) == 2)
+            publisher.close()
+        finally:
+            pool.shutdown()
+
+
+class TestOfflineEndToEnd:
+    def test_score_after_events(self, endpoint, test_tokenizer_files):
+        block_size = 4
+        config = IndexerConfig(
+            token_processor_config=TokenProcessorConfig(block_size=block_size),
+        )
+        tokenization_pool = TokenizationPool(
+            TokenizersPoolConfig(workers=2, local_tokenizer_files=test_tokenizer_files),
+        )
+        indexer = Indexer(config=config, tokenization_pool=tokenization_pool)
+        indexer.run()
+
+        event_pool = EventPool(
+            EventPoolConfig(zmq_endpoint=endpoint, concurrency=2),
+            indexer.kv_block_index,
+            indexer.token_processor,
+        )
+        event_pool.start(with_subscriber=True)
+        try:
+            prompt = "The quick brown fox jumps over the lazy dog. " * 4
+
+            # No events yet: no scores.
+            assert indexer.get_pod_scores(prompt, TEST_MODEL_NAME, []) == {}
+
+            # Simulate the engine reporting it cached the prompt's blocks:
+            # tokenize the same way the engine would and publish BlockStored.
+            enc = tokenization_pool.tokenizer.encode(prompt, TEST_MODEL_NAME)
+            n_blocks = len(enc.tokens) // block_size
+            event_tokens = enc.tokens[: n_blocks * block_size]
+            engine_hashes = list(range(1000, 1000 + n_blocks))
+
+            publisher = Publisher(endpoint, make_topic("pod-hot", TEST_MODEL_NAME))
+            time.sleep(0.3)
+            publisher.publish(
+                EventBatch(
+                    ts=time.monotonic(),
+                    events=[BlockStored(engine_hashes, None, event_tokens, block_size)],
+                )
+            )
+
+            def has_score():
+                scores = indexer.get_pod_scores(prompt, TEST_MODEL_NAME, [])
+                return scores.get("pod-hot", 0) >= n_blocks
+
+            assert _wait_until(has_score), "pod-hot never reached full prefix score"
+
+            # Filtering to another pod excludes pod-hot.
+            scores = indexer.get_pod_scores(prompt, TEST_MODEL_NAME, ["pod-cold"])
+            assert "pod-hot" not in scores
+            publisher.close()
+        finally:
+            event_pool.shutdown()
+            indexer.shutdown()
