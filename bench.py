@@ -1,0 +1,256 @@
+"""Fleet routing benchmark: prefix-aware scoring vs round-robin.
+
+Reproduces the reference's headline experiment shape
+(/root/reference/benchmarking/37-capacity, BASELINE.md) at simulation scale:
+an 8-pod vLLM-TPU fleet serving multi-turn conversations with large shared
+system prompts. Everything in the control plane is REAL — engines run real
+block managers (prefix caching, LRU eviction) emitting real msgpack KVEvents
+through the real sharded event pool into the real index; routing calls the
+real `Indexer.get_pod_scores` read path (tokenization included). Only device
+compute is modeled: TTFT = queue wait + alpha * uncached_prefill_tokens +
+beta, with pods busy for prefill + output decode.
+
+Target (BASELINE.json): >=80% prefix-cache hit rate and >=2x TTFT speedup vs
+round-robin on an 8-replica fleet.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+from llm_d_kv_cache_manager_tpu.engine.block_manager import OutOfPagesError
+from llm_d_kv_cache_manager_tpu.engine.engine import EnginePod, EnginePodConfig
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.pool import EventPool, EventPoolConfig, Message
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPool,
+    TokenizersPoolConfig,
+)
+
+MODEL = "test-model"
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "test-model", "tokenizer.json")
+
+# Fleet / engine shape.
+N_PODS = 8
+PAGE_SIZE = 16
+PAGES_PER_POD = 2048  # 32k tokens of KV per pod -> eviction pressure is real
+
+# Workload: groups share a system prompt; each user runs a multi-turn chat.
+N_GROUPS = 12
+USERS_PER_GROUP = 5
+TURNS_PER_USER = 5
+SYSTEM_PROMPT_WORDS = 900  # ~8x question size, like the 8k-shared-prefix runs
+QUESTION_WORDS = 110
+RESPONSE_WORDS = 120
+QPS = 20.0
+
+# TTFT model (v5e-class serving constants). Pods continuously batch decode,
+# so the serialized per-pod resource is prefill compute; queue wait is time
+# until the pod's prefill slot frees up.
+ALPHA_PREFILL_S_PER_TOKEN = 0.00035
+BETA_OVERHEAD_S = 0.02
+
+_WORDS = (
+    "the quick brown fox jumps over lazy dog system user assistant tool "
+    "response message conversation template routing cache block prefix "
+    "token mesh shard kernel attention page table fleet score index event"
+).split()
+
+
+def _text(rng: random.Random, n_words: int) -> str:
+    return " ".join(rng.choice(_WORDS) for _ in range(n_words))
+
+
+def build_workload(seed: int = 42):
+    """Returns a time-ordered list of (arrival_time, conv_id, prompt_text)."""
+    rng = random.Random(seed)
+    system_prompts = [
+        f"[group {g}] " + _text(rng, SYSTEM_PROMPT_WORDS) for g in range(N_GROUPS)
+    ]
+    conversations = {}  # conv_id -> history text
+    turns = []
+    for g in range(N_GROUPS):
+        for u in range(USERS_PER_GROUP):
+            conv_id = f"g{g}-u{u}"
+            conversations[conv_id] = system_prompts[g]
+            for t in range(TURNS_PER_USER):
+                turns.append((conv_id, t, g, u))
+    rng.shuffle(turns)
+
+    arrival = 0.0
+    requests = []
+    for conv_id, _t, _g, _u in turns:
+        arrival += rng.expovariate(QPS)
+        requests.append((arrival, conv_id))
+    responses = {}
+    return requests, conversations, responses, rng
+
+
+class FleetSim:
+    def __init__(self, strategy: str, seed: int = 42):
+        self.strategy = strategy
+        self.indexer = Indexer(
+            config=IndexerConfig(
+                token_processor_config=TokenProcessorConfig(block_size=PAGE_SIZE),
+            ),
+            tokenization_pool=TokenizationPool(
+                TokenizersPoolConfig(workers=2, local_tokenizer_files={MODEL: FIXTURE}),
+            ),
+        )
+        self.indexer.run()
+        self.event_pool = EventPool(
+            EventPoolConfig(concurrency=2),
+            self.indexer.kv_block_index,
+            self.indexer.token_processor,
+        )
+        self.event_pool.start(with_subscriber=False)
+
+        self.pods = []
+        for i in range(N_PODS):
+            pod_id = f"pod-{i}"
+            pod = EnginePod(
+                EnginePodConfig(
+                    pod_id=pod_id,
+                    model_name=MODEL,
+                    n_pages=PAGES_PER_POD,
+                    page_size=PAGE_SIZE,
+                    max_pages_per_seq=4096,
+                ),
+                event_sink=self._sink_for(pod_id),
+            )
+            self.pods.append(pod)
+        self.pod_free_at = [0.0] * N_PODS
+        self.rr_counter = 0
+        self.read_latencies = []
+        self.hit_tokens = 0
+        self.total_tokens = 0
+
+    def _sink_for(self, pod_id: str):
+        def sink(batch):
+            self.event_pool.add_task(
+                Message(
+                    topic=f"kv@{pod_id}@{MODEL}",
+                    payload=batch.to_msgpack(),
+                    seq=0,
+                    pod_identifier=pod_id,
+                    model_name=MODEL,
+                )
+            )
+
+        return sink
+
+    def route(self, prompt: str) -> int:
+        if self.strategy == "round_robin":
+            pod = self.rr_counter % N_PODS
+            self.rr_counter += 1
+            return pod
+        t0 = time.perf_counter()
+        scores = self.indexer.get_pod_scores(prompt, MODEL, [])
+        self.read_latencies.append(time.perf_counter() - t0)
+        if not scores:
+            # No cache anywhere: least-loaded pod.
+            return min(range(N_PODS), key=lambda i: self.pod_free_at[i])
+        best = max(scores.values())
+        candidates = [int(p.split("-")[1]) for p, s in scores.items() if s == best]
+        return min(candidates, key=lambda i: self.pod_free_at[i])
+
+    def serve(self, arrival: float, prompt: str) -> float:
+        """Returns TTFT for this request under the simulated clock."""
+        pod_idx = self.route(prompt)
+        pod = self.pods[pod_idx]
+
+        tokens = self.indexer.tokenizers_pool.tokenize(None, prompt, MODEL)
+        self.total_tokens += len(tokens)
+        try:
+            state, cached = pod.prefill(tokens)
+        except OutOfPagesError:
+            # Sequence larger than the pod's whole free pool: serve uncached
+            # (count the full prefill) without touching the cache.
+            return BETA_OVERHEAD_S + ALPHA_PREFILL_S_PER_TOKEN * len(tokens)
+        self.hit_tokens += min(cached, len(tokens))
+
+        uncached = max(len(tokens) - cached, 0)
+        prefill_s = BETA_OVERHEAD_S + ALPHA_PREFILL_S_PER_TOKEN * uncached
+        start = max(arrival, self.pod_free_at[pod_idx])
+        ttft = (start - arrival) + prefill_s
+        self.pod_free_at[pod_idx] = start + prefill_s
+
+        pod.free(state)  # pages stay cached for future turns
+        self.event_pool.drain()
+        return ttft
+
+    def shutdown(self):
+        self.event_pool.shutdown()
+        self.indexer.shutdown()
+        for pod in self.pods:
+            pod.close()
+
+
+def run_strategy(strategy: str):
+    requests, conversations, responses, rng = build_workload()
+    sim = FleetSim(strategy)
+    ttfts = []
+    try:
+        for arrival, conv_id in requests:
+            question = _text(rng, QUESTION_WORDS)
+            prompt = conversations[conv_id] + " [user] " + question
+            ttfts.append(sim.serve(arrival, prompt))
+            # Assistant response extends the conversation (next turn's prefix).
+            conversations[conv_id] = prompt + " [assistant] " + _text(rng, RESPONSE_WORDS)
+        hit_rate = sim.hit_tokens / max(sim.total_tokens, 1)
+        lat = sorted(sim.read_latencies)
+        read_p50 = lat[len(lat) // 2] if lat else 0.0
+        return ttfts, hit_rate, read_p50
+    finally:
+        sim.shutdown()
+
+
+def p50(xs):
+    return sorted(xs)[len(xs) // 2]
+
+
+def main():
+    t_start = time.time()
+    ttft_precise, hit_rate, read_p50 = run_strategy("precise")
+    ttft_rr, _, _ = run_strategy("round_robin")
+
+    speedup = p50(ttft_rr) / max(p50(ttft_precise), 1e-9)
+    stats = {
+        "ttft_p50_precise_s": round(p50(ttft_precise), 4),
+        "ttft_p50_round_robin_s": round(p50(ttft_rr), 4),
+        "ttft_mean_precise_s": round(sum(ttft_precise) / len(ttft_precise), 4),
+        "ttft_mean_round_robin_s": round(sum(ttft_rr) / len(ttft_rr), 4),
+        "prefix_hit_rate": round(hit_rate, 4),
+        "read_path_p50_ms": round(read_p50 * 1e3, 3),
+        "requests": len(ttft_precise),
+        "wall_s": round(time.time() - t_start, 1),
+    }
+    print(json.dumps(stats), file=sys.stderr)
+
+    print(
+        json.dumps(
+            {
+                "metric": "ttft_p50_speedup_vs_round_robin",
+                "value": round(speedup, 3),
+                "unit": "x",
+                # BASELINE.json target: >=2x TTFT speedup vs round-robin.
+                "vs_baseline": round(speedup / 2.0, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

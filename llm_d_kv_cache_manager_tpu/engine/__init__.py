@@ -1,0 +1,7 @@
+from llm_d_kv_cache_manager_tpu.engine.block_manager import (
+    BlockManager,
+    BlockManagerConfig,
+)
+from llm_d_kv_cache_manager_tpu.engine.engine import EnginePod, EnginePodConfig
+
+__all__ = ["BlockManager", "BlockManagerConfig", "EnginePod", "EnginePodConfig"]

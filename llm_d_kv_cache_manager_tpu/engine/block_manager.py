@@ -1,0 +1,281 @@
+"""KV-page manager with prefix caching and KVEvent emission.
+
+The engine-side source of truth the control plane indexes. Responsibilities
+(mirroring what vLLM's block manager + KV-event publisher do around the
+reference's write plane, /root/reference/pkg/kvcache/kvevents/events.go):
+
+- page allocation for sequences over a fixed HBM page pool,
+- prefix caching: full pages are keyed by the *same* chained CBOR+FNV-64a
+  hash scheme the control plane recomputes (kvcache/kvblock/hashing.py), so
+  an indexer with a matching hash seed maps engine events onto identical
+  request keys — the hash-parity invariant, exercised end-to-end in tests,
+- copy-on-reuse refcounting: freed sequences leave their pages cached; pages
+  are reclaimed LRU on allocation pressure,
+- event emission: BlockStored when a full page is committed (with parent
+  hash chaining), BlockRemoved when a cached page is reclaimed,
+  AllBlocksCleared on reset.
+
+Pure host-side bookkeeping — device work (the actual page tensors) lives in
+models/llama.py + ops/paged_attention.py and is driven by engine.EnginePod.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.events import (
+    AllBlocksCleared,
+    BlockRemoved,
+    BlockStored,
+    Event,
+    EventBatch,
+)
+
+EventSink = Callable[[EventBatch], None]
+
+
+@dataclass
+class BlockManagerConfig:
+    n_pages: int = 512
+    page_size: int = 16  # tokens per page == control-plane block size
+    hash_seed: str = ""
+    enable_prefix_caching: bool = True
+    device_tier: Optional[str] = None  # None -> events carry no Medium (default tier)
+
+
+@dataclass
+class SequenceState:
+    seq_id: int
+    tokens: List[int]
+    block_table: List[int]
+    num_cached_tokens: int  # prefix-cache hit length at allocation time
+    n_hashed_pages: int  # pages already committed (hashed + event emitted)
+
+
+class _Page:
+    __slots__ = ("page_id", "ref_count", "chunk_hash")
+
+    def __init__(self, page_id: int):
+        self.page_id = page_id
+        self.ref_count = 0
+        self.chunk_hash: Optional[int] = None  # set when committed (full page)
+
+
+class OutOfPagesError(RuntimeError):
+    pass
+
+
+class BlockManager:
+    def __init__(self, config: BlockManagerConfig, event_sink: Optional[EventSink] = None):
+        self.config = config
+        self.event_sink = event_sink
+        self.token_db = ChunkedTokenDatabase(
+            TokenProcessorConfig(block_size=config.page_size, hash_seed=config.hash_seed)
+        )
+        self._pages = [_Page(i) for i in range(config.n_pages)]
+        self._free_fresh = list(range(config.n_pages - 1, -1, -1))  # pop() -> page 0 first
+        # hash -> page_id for committed, reusable pages.
+        self._hash_to_page: Dict[int, int] = {}
+        # LRU of ref_count==0 committed pages, eligible for reclaim.
+        self._reclaimable: "OrderedDict[int, None]" = OrderedDict()
+        self._seq_counter = 0
+        self._sequences: Dict[int, SequenceState] = {}
+
+    # -- stats ---------------------------------------------------------------
+
+    @property
+    def num_free_pages(self) -> int:
+        return len(self._free_fresh) + len(self._reclaimable)
+
+    @property
+    def num_cached_pages(self) -> int:
+        return len(self._hash_to_page)
+
+    # -- allocation ----------------------------------------------------------
+
+    def allocate(self, tokens: Sequence[int]) -> SequenceState:
+        """Allocate pages for a new sequence, reusing cached prefix pages.
+
+        Returns the sequence state; `num_cached_tokens` tells the caller how
+        many leading tokens need no recompute. Raises OutOfPagesError if the
+        pool cannot cover the request (caller should retry later).
+        """
+        tokens = list(tokens)
+        n_pages_needed = (len(tokens) + self.config.page_size - 1) // self.config.page_size
+
+        block_table: List[int] = []
+        hashes = (
+            self.token_db.tokens_to_kv_block_keys(None, tokens, "")
+            if self.config.enable_prefix_caching
+            else []
+        )
+
+        # 1. Reuse cached pages along the hash chain.
+        n_cached_pages = 0
+        for key in hashes:
+            page_id = self._hash_to_page.get(key.chunk_hash)
+            if page_id is None:
+                break
+            page = self._pages[page_id]
+            if page.ref_count == 0:
+                self._reclaimable.pop(page_id, None)
+            page.ref_count += 1
+            block_table.append(page_id)
+            n_cached_pages += 1
+
+        # 2. Fresh pages for the rest.
+        try:
+            while len(block_table) < n_pages_needed:
+                block_table.append(self._take_free_page())
+        except OutOfPagesError:
+            self._rollback(block_table, n_cached_pages)
+            raise
+
+        state = SequenceState(
+            seq_id=self._seq_counter,
+            tokens=tokens,
+            block_table=block_table,
+            num_cached_tokens=n_cached_pages * self.config.page_size,
+            n_hashed_pages=n_cached_pages,
+        )
+        self._seq_counter += 1
+        self._sequences[state.seq_id] = state
+        return state
+
+    def commit_prefill(self, state: SequenceState) -> None:
+        """Commit the sequence's full pages after prefill compute: hash,
+        register for reuse, and emit one BlockStored chaining from the cached
+        prefix."""
+        self._commit_full_pages(state)
+
+    def append_token(self, state: SequenceState, token: int) -> None:
+        """Record one decoded token; allocates a new page at boundaries and
+        commits pages as they fill."""
+        state.tokens.append(token)
+        pages_needed = (
+            len(state.tokens) + self.config.page_size - 1
+        ) // self.config.page_size
+        if pages_needed > len(state.block_table):
+            state.block_table.append(self._take_free_page())
+        self._commit_full_pages(state)
+
+    def free(self, state: SequenceState) -> None:
+        """Release the sequence. Committed pages stay cached (reclaimable);
+        uncommitted (partial) pages return to the fresh pool."""
+        for i, page_id in enumerate(state.block_table):
+            page = self._pages[page_id]
+            page.ref_count -= 1
+            if page.ref_count > 0:
+                continue
+            if page.chunk_hash is not None:
+                self._reclaimable[page_id] = None
+                self._reclaimable.move_to_end(page_id)
+            else:
+                self._free_fresh.append(page_id)
+        self._sequences.pop(state.seq_id, None)
+
+    def clear(self) -> None:
+        """Drop everything (engine restart).
+
+        Emits BlockRemoved for every cached page before AllBlocksCleared:
+        the event pool (matching the reference, pool.go:332-333) treats
+        AllBlocksCleared as a no-op on the assumption that engines emit
+        per-block removals — so we must, or the index would keep scoring
+        this pod for blocks it no longer holds.
+        """
+        cached_hashes = list(self._hash_to_page)
+        self.__init__(self.config, self.event_sink)
+        events: List[Event] = []
+        if cached_hashes:
+            events.append(
+                BlockRemoved(block_hashes=cached_hashes, medium=self.config.device_tier)
+            )
+        events.append(AllBlocksCleared())
+        self._emit(events)
+
+    # -- internals -----------------------------------------------------------
+
+    def _take_free_page(self) -> int:
+        if self._free_fresh:
+            return self._free_fresh.pop()
+        if self._reclaimable:
+            page_id, _ = self._reclaimable.popitem(last=False)  # LRU
+            page = self._pages[page_id]
+            assert page.chunk_hash is not None
+            # Only drop the mapping (and tell the control plane) if this page
+            # is the registered holder of its hash — a duplicate-content page
+            # may have lost the registration race, and its reclaim must not
+            # evict the live page's index entry.
+            if self._hash_to_page.get(page.chunk_hash) == page_id:
+                self._hash_to_page.pop(page.chunk_hash)
+                self._emit([BlockRemoved(block_hashes=[page.chunk_hash],
+                                         medium=self.config.device_tier)])
+            page.chunk_hash = None
+            return page_id
+        raise OutOfPagesError(
+            f"no free pages (pool={self.config.n_pages})"
+        )
+
+    def _rollback(self, block_table: List[int], n_cached: int) -> None:
+        for i, page_id in enumerate(block_table):
+            page = self._pages[page_id]
+            if i < n_cached:
+                page.ref_count -= 1
+                if page.ref_count == 0:
+                    self._reclaimable[page_id] = None
+            else:
+                self._free_fresh.append(page_id)
+
+    def _commit_full_pages(self, state: SequenceState) -> None:
+        if not self.config.enable_prefix_caching:
+            return
+        n_full = len(state.tokens) // self.config.page_size
+        if n_full <= state.n_hashed_pages:
+            return
+
+        start_page = state.n_hashed_pages
+        parent_hash: Optional[int] = None
+        if start_page > 0:
+            parent_hash = self._pages[state.block_table[start_page - 1]].chunk_hash
+
+        new_tokens = state.tokens[
+            start_page * self.config.page_size : n_full * self.config.page_size
+        ]
+        parent_key = None
+        if parent_hash is not None:
+            from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key
+
+            parent_key = Key("", parent_hash)
+        keys = self.token_db.tokens_to_kv_block_keys(parent_key, new_tokens, "")
+
+        new_hashes: List[int] = []
+        for offset, key in enumerate(keys):
+            page = self._pages[state.block_table[start_page + offset]]
+            page.chunk_hash = key.chunk_hash
+            # First registration wins: if another page already holds this
+            # hash, leave its mapping intact (this page is duplicate content).
+            self._hash_to_page.setdefault(key.chunk_hash, page.page_id)
+            new_hashes.append(key.chunk_hash)
+
+        state.n_hashed_pages = n_full
+        if new_hashes:
+            self._emit([
+                BlockStored(
+                    block_hashes=new_hashes,
+                    parent_block_hash=parent_hash,
+                    token_ids=new_tokens,
+                    block_size=self.config.page_size,
+                    medium=self.config.device_tier,
+                )
+            ])
+
+    def _emit(self, events: List[Event]) -> None:
+        if self.event_sink is not None and events:
+            self.event_sink(EventBatch(ts=time.time(), events=events))

@@ -1,0 +1,181 @@
+"""EnginePod: a minimal vLLM-TPU-style serving pod.
+
+Ties together the device path (models/llama.py + ops/paged_attention.py) and
+the host path (engine/block_manager.py), publishing the same KVEvents wire
+traffic a real vLLM-TPU engine would (kvevents/publisher.py) so the control
+plane can index it. Used three ways:
+
+- e2e tests: two pods + an Indexer, verifying scores follow real cache state,
+- bench.py: fleet simulation (accounting-only mode, no model compute),
+- examples: live demo engines.
+
+Accounting-only mode (`with_model=False`) runs the full block-manager +
+event path without device compute; model mode runs real prefill/decode with
+the paged cache on whatever backend JAX has.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from llm_d_kv_cache_manager_tpu.engine.block_manager import (
+    BlockManager,
+    BlockManagerConfig,
+    SequenceState,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.events import EventBatch
+from llm_d_kv_cache_manager_tpu.kvevents.publisher import Publisher, make_topic
+
+
+@dataclass
+class EnginePodConfig:
+    pod_id: str = "pod-0"
+    model_name: str = "test-model"
+    zmq_endpoint: Optional[str] = None  # None -> direct event_sink only
+    n_pages: int = 512
+    page_size: int = 16
+    hash_seed: str = ""
+    device_tier: Optional[str] = None
+    max_pages_per_seq: int = 32
+    with_model: bool = False
+    model_config: Optional[object] = None  # models.llama.LlamaConfig
+
+
+class EnginePod:
+    def __init__(
+        self,
+        config: EnginePodConfig,
+        event_sink: Optional[Callable[[EventBatch], None]] = None,
+        params=None,
+    ):
+        self.config = config
+        self._publisher: Optional[Publisher] = None
+        if config.zmq_endpoint:
+            self._publisher = Publisher(
+                config.zmq_endpoint, make_topic(config.pod_id, config.model_name)
+            )
+        self._extra_sink = event_sink
+
+        self.block_manager = BlockManager(
+            BlockManagerConfig(
+                n_pages=config.n_pages,
+                page_size=config.page_size,
+                hash_seed=config.hash_seed,
+                device_tier=config.device_tier,
+            ),
+            event_sink=self._emit,
+        )
+
+        self._model = None
+        if config.with_model:
+            import jax
+            import jax.numpy as jnp
+
+            from llm_d_kv_cache_manager_tpu.models import llama
+
+            mc = config.model_config or llama.LlamaConfig()
+            self._model = llama
+            self._model_config = mc
+            self.params = params if params is not None else llama.init_params(
+                mc, jax.random.PRNGKey(0)
+            )
+            self.k_pages, self.v_pages = llama.make_kv_pages(
+                mc, config.n_pages, config.page_size
+            )
+            self._jnp = jnp
+
+    # -- events --------------------------------------------------------------
+
+    def _emit(self, batch: EventBatch) -> None:
+        if self._publisher is not None:
+            self._publisher.publish(batch)
+        if self._extra_sink is not None:
+            self._extra_sink(batch)
+
+    # -- serving -------------------------------------------------------------
+
+    def prefill(self, tokens: List[int]) -> Tuple[SequenceState, int]:
+        """Admit a sequence: allocate (with prefix reuse), compute the
+        uncached suffix, commit pages + events. Returns (state, cached_tokens)."""
+        state = self.block_manager.allocate(tokens)
+        n_cached = state.num_cached_tokens
+        if n_cached >= len(tokens):
+            # Fully cached (modulo partial tail): recompute only the last
+            # position for logits in model mode; no page writes needed.
+            n_cached = min(n_cached, len(tokens) - 1)
+
+        if self._model is not None:
+            jnp = self._jnp
+            block_table = self._padded_table(state)
+            new_tokens = jnp.asarray(tokens[n_cached:], dtype=jnp.int32)
+            self.k_pages, self.v_pages, self.last_logits = self._model.prefill(
+                self._model_config,
+                self.params,
+                self.k_pages,
+                self.v_pages,
+                new_tokens,
+                block_table,
+                n_cached,
+            )
+
+        self.block_manager.commit_prefill(state)
+        return state, state.num_cached_tokens
+
+    def decode_append(self, state: SequenceState, token: int) -> None:
+        """Accounting-only decode: record one generated token."""
+        self.block_manager.append_token(state, token)
+
+    def decode_step(self, state: SequenceState) -> int:
+        """Model decode: greedy-sample one token for this sequence."""
+        if self._model is None:
+            raise RuntimeError("decode_step requires with_model=True")
+        jnp = self._jnp
+        pos = len(state.tokens) - 1
+        last_token = jnp.asarray([state.tokens[-1]], dtype=jnp.int32)
+        # The last token's K/V were already written by prefill/previous step;
+        # decode_step writes at seq_lens, so pass position of the new token.
+        self.k_pages, self.v_pages, logits = self._model.decode_step(
+            self._model_config,
+            self.params,
+            self.k_pages,
+            self.v_pages,
+            last_token,
+            self._padded_table(state)[None],
+            jnp.asarray([pos], dtype=jnp.int32),
+        )
+        token = int(jnp.argmax(logits[0]))
+        self.block_manager.append_token(state, token)
+        return token
+
+    def free(self, state: SequenceState) -> None:
+        self.block_manager.free(state)
+
+    def close(self) -> None:
+        if self._publisher is not None:
+            self._publisher.close()
+
+    # -- helpers -------------------------------------------------------------
+
+    def _padded_table(self, state: SequenceState):
+        if len(state.block_table) > self.config.max_pages_per_seq:
+            raise ValueError(
+                f"sequence needs {len(state.block_table)} pages > "
+                f"max_pages_per_seq={self.config.max_pages_per_seq}; truncating "
+                "would silently corrupt K/V pages"
+            )
+        # Bucket the padded length (next power of two covering the need) so
+        # short prompts don't pay attention compute over the maximal static
+        # shape; jit specializes per bucket.
+        need = max(len(state.block_table), 1)
+        bucket = 1
+        while bucket < need:
+            bucket *= 2
+        bucket = min(bucket, self.config.max_pages_per_seq)
+        jnp_or_np = self._jnp if self._model is not None else np
+        table = np.zeros((bucket,), dtype=np.int32)
+        table[: len(state.block_table)] = state.block_table
+        return jnp_or_np.asarray(table)

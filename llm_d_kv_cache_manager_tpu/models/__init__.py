@@ -1,0 +1,9 @@
+from llm_d_kv_cache_manager_tpu.models.llama import (
+    LlamaConfig,
+    init_params,
+    prefill,
+    decode_step,
+    train_step,
+)
+
+__all__ = ["LlamaConfig", "init_params", "prefill", "decode_step", "train_step"]

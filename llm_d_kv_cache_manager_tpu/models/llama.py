@@ -1,0 +1,300 @@
+"""Flagship model: Llama-3-style decoder with a paged KV cache, pure JAX.
+
+This is the in-repo stand-in for a vLLM-TPU engine's model executor: RMSNorm,
+RoPE, grouped-query attention, SwiGLU MLP — all shapes static, all control
+flow compiler-friendly, bfloat16 activations on the MXU.
+
+Two serving paths share one paged KV cache (pages in HBM, block tables on
+host, identical to what the control plane indexes):
+- `prefill`: one sequence, chunk-at-once causal attention that also attends
+  to an already-cached prefix (prefix-cache hits skip recompute entirely),
+  writing new K/V into pages via `ops.write_kv_pages`.
+- `decode_step`: batched single-token step through the Pallas flash-decoding
+  `ops.paged_attention` kernel.
+
+`train_step` (next-token CE + SGD update) exists to exercise the full
+dp x tp sharded compilation path on a device mesh (see parallel/mesh.py and
+__graft_entry__.dryrun_multichip).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from llm_d_kv_cache_manager_tpu.ops.paged_attention import (
+    paged_attention,
+    paged_attention_reference,
+    write_kv_pages,
+)
+
+Params = Dict
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 2048
+    d_model: int = 256
+    n_layers: int = 2
+    n_q_heads: int = 8
+    n_kv_heads: int = 4
+    head_dim: int = 128
+    d_ff: int = 512
+    rope_theta: float = 500_000.0
+    rms_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def q_dim(self) -> int:
+        return self.n_q_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.n_kv_heads * self.head_dim
+
+
+def init_params(config: LlamaConfig, key: jax.Array) -> Params:
+    """Normal(0.02) init, layers stacked on a leading axis for lax.scan."""
+    k_embed, k_layers, k_out = jax.random.split(key, 3)
+    c = config
+    init = jax.nn.initializers.normal(0.02)
+
+    def layer_params(k) -> Dict:
+        ks = jax.random.split(k, 7)
+        return {
+            "attn_norm": jnp.ones((c.d_model,), c.dtype),
+            "wq": init(ks[0], (c.d_model, c.q_dim), c.dtype),
+            "wk": init(ks[1], (c.d_model, c.kv_dim), c.dtype),
+            "wv": init(ks[2], (c.d_model, c.kv_dim), c.dtype),
+            "wo": init(ks[3], (c.q_dim, c.d_model), c.dtype),
+            "mlp_norm": jnp.ones((c.d_model,), c.dtype),
+            "w_gate": init(ks[4], (c.d_model, c.d_ff), c.dtype),
+            "w_up": init(ks[5], (c.d_model, c.d_ff), c.dtype),
+            "w_down": init(ks[6], (c.d_ff, c.d_model), c.dtype),
+        }
+
+    layer_keys = jax.random.split(k_layers, c.n_layers)
+    layers = jax.vmap(layer_params)(layer_keys)
+    return {
+        "embed": init(k_embed, (c.vocab_size, c.d_model), c.dtype),
+        "layers": layers,
+        "final_norm": jnp.ones((c.d_model,), c.dtype),
+        "out": init(k_out, (c.d_model, c.vocab_size), c.dtype),
+    }
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding. x: [..., seq, heads, head_dim], positions: [..., seq]."""
+    head_dim = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = positions[..., :, None].astype(jnp.float32) * freqs  # [..., seq, hd/2]
+    cos = jnp.cos(angles)[..., :, None, :]  # broadcast over heads
+    sin = jnp.sin(angles)[..., :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rotated.astype(x.dtype)
+
+
+def _mlp(layer: Dict, x: jax.Array) -> jax.Array:
+    gate = jax.nn.silu(x @ layer["w_gate"])
+    return (gate * (x @ layer["w_up"])) @ layer["w_down"]
+
+
+# ---------------------------------------------------------------------------
+# Dense path (training / prefill math)
+# ---------------------------------------------------------------------------
+
+
+def _dense_attention(
+    q: jax.Array,  # [B, L, n_q, hd]
+    k: jax.Array,  # [B, S, n_kv, hd]
+    v: jax.Array,
+    causal_offset: jax.Array | int,  # q position i attends k positions <= offset+i
+) -> jax.Array:
+    b, l, n_q, hd = q.shape
+    n_kv = k.shape[2]
+    group = n_q // n_kv
+    qg = q.reshape(b, l, n_kv, group, hd)
+    scores = jnp.einsum(
+        "blhgd,bshd->bhgls", qg.astype(jnp.float32), k.astype(jnp.float32)
+    ) / (hd**0.5)
+    q_pos = jnp.arange(l)[:, None]
+    k_pos = jnp.arange(k.shape[1])[None, :]
+    mask = k_pos <= (q_pos + causal_offset)
+    scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgls,bshd->blhgd", weights, v.astype(jnp.float32))
+    return out.reshape(b, l, n_q, hd).astype(q.dtype)
+
+
+def forward_dense(config: LlamaConfig, params: Params, tokens: jax.Array) -> jax.Array:
+    """Plain causal forward (no cache) — the training path. tokens: [B, L]."""
+    c = config
+    b, l = tokens.shape
+    x = params["embed"][tokens]
+    positions = jnp.broadcast_to(jnp.arange(l), (b, l))
+
+    def layer_fn(x, layer):
+        h = rms_norm(x, layer["attn_norm"], c.rms_eps)
+        q = (h @ layer["wq"]).reshape(b, l, c.n_q_heads, c.head_dim)
+        k = (h @ layer["wk"]).reshape(b, l, c.n_kv_heads, c.head_dim)
+        v = (h @ layer["wv"]).reshape(b, l, c.n_kv_heads, c.head_dim)
+        q = _rope(q, positions, c.rope_theta)
+        k = _rope(k, positions, c.rope_theta)
+        attn = _dense_attention(q, k, v, 0)
+        x = x + attn.reshape(b, l, c.q_dim) @ layer["wo"]
+        h = rms_norm(x, layer["mlp_norm"], c.rms_eps)
+        x = x + _mlp(layer, h)
+        return x, None
+
+    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], c.rms_eps)
+    return x @ params["out"]  # [B, L, vocab] logits
+
+
+def loss_fn(config: LlamaConfig, params: Params, tokens: jax.Array) -> jax.Array:
+    """Next-token cross-entropy."""
+    logits = forward_dense(config, params, tokens).astype(jnp.float32)
+    targets = tokens[:, 1:]
+    logprobs = jax.nn.log_softmax(logits[:, :-1])
+    nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+def train_step(
+    config: LlamaConfig, params: Params, tokens: jax.Array, lr: float = 1e-3
+) -> Tuple[Params, jax.Array]:
+    """One SGD step; jit this under a mesh with parallel.mesh shardings."""
+    loss, grads = jax.value_and_grad(lambda p: loss_fn(config, p, tokens))(params)
+    new_params = jax.tree_util.tree_map(
+        lambda p, g: (p.astype(jnp.float32) - lr * g.astype(jnp.float32)).astype(p.dtype),
+        params,
+        grads,
+    )
+    return new_params, loss
+
+
+# ---------------------------------------------------------------------------
+# Paged-cache serving paths
+# ---------------------------------------------------------------------------
+
+
+def make_kv_pages(
+    config: LlamaConfig, n_pages: int, page_size: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-layer KV page pools: [n_layers, n_kv, n_pages, page, hd]."""
+    c = config
+    shape = (c.n_layers, c.n_kv_heads, n_pages, page_size, c.head_dim)
+    return jnp.zeros(shape, c.dtype), jnp.zeros(shape, c.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("config",), donate_argnums=(2, 3))
+def prefill(
+    config: LlamaConfig,
+    params: Params,
+    k_pages: jax.Array,  # [n_layers, n_kv, n_pages, page, hd]
+    v_pages: jax.Array,
+    tokens: jax.Array,  # [L] one sequence's NEW (non-cached) tokens
+    block_table: jax.Array,  # [pages_per_seq] int32
+    start_pos,  # int32: number of already-cached tokens (prefix-cache hit)
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Prefill new tokens, attending to the cached prefix; returns
+    (k_pages, v_pages, last_token_logits)."""
+    c = config
+    page_size = k_pages.shape[3]
+    l = tokens.shape[0]
+    x = params["embed"][tokens][None]  # [1, L, d]
+    positions = (start_pos + jnp.arange(l))[None]  # [1, L]
+    max_ctx = block_table.shape[0] * page_size
+
+    def layer_fn(carry, inputs):
+        x, = carry
+        layer, kp, vp = inputs
+        h = rms_norm(x, layer["attn_norm"], c.rms_eps)
+        q = (h @ layer["wq"]).reshape(1, l, c.n_q_heads, c.head_dim)
+        k = (h @ layer["wk"]).reshape(1, l, c.n_kv_heads, c.head_dim)
+        v = (h @ layer["wv"]).reshape(1, l, c.n_kv_heads, c.head_dim)
+        q = _rope(q, positions, c.rope_theta)
+        k = _rope(k, positions, c.rope_theta)
+
+        kp, vp = write_kv_pages(kp, vp, block_table, k[0], v[0], start_pos)
+
+        # Attend to everything cached so far (prefix + new), causally.
+        k_all = kp[:, block_table].reshape(c.n_kv_heads, max_ctx, c.head_dim)
+        v_all = vp[:, block_table].reshape(c.n_kv_heads, max_ctx, c.head_dim)
+        k_all = jnp.swapaxes(k_all, 0, 1)[None]  # [1, max_ctx, n_kv, hd]
+        v_all = jnp.swapaxes(v_all, 0, 1)[None]
+        attn = _dense_attention(q, k_all, v_all, start_pos)
+        x = x + attn.reshape(1, l, c.q_dim) @ layer["wo"]
+        h = rms_norm(x, layer["mlp_norm"], c.rms_eps)
+        x = x + _mlp(layer, h)
+        return (x,), (kp, vp)
+
+    (x,), (k_pages, v_pages) = jax.lax.scan(
+        layer_fn, (x,), (params["layers"], k_pages, v_pages)
+    )
+    x = rms_norm(x, params["final_norm"], c.rms_eps)
+    logits = x[:, -1] @ params["out"]  # [1, vocab]
+    return k_pages, v_pages, logits[0]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("config", "use_kernel"), donate_argnums=(2, 3)
+)
+def decode_step(
+    config: LlamaConfig,
+    params: Params,
+    k_pages: jax.Array,  # [n_layers, n_kv, n_pages, page, hd]
+    v_pages: jax.Array,
+    tokens: jax.Array,  # [B] current token per sequence
+    block_tables: jax.Array,  # [B, pages_per_seq]
+    seq_lens: jax.Array,  # [B] tokens already cached (position of new token)
+    use_kernel: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One batched decode step; returns (k_pages, v_pages, logits [B, vocab])."""
+    c = config
+    page_size = k_pages.shape[3]
+    b = tokens.shape[0]
+    x = params["embed"][tokens][:, None]  # [B, 1, d]
+    positions = seq_lens[:, None]  # [B, 1]
+
+    attend = paged_attention if use_kernel else paged_attention_reference
+
+    def layer_fn(carry, inputs):
+        x, = carry
+        layer, kp, vp = inputs
+        h = rms_norm(x, layer["attn_norm"], c.rms_eps)
+        q = (h @ layer["wq"]).reshape(b, 1, c.n_q_heads, c.head_dim)
+        k = (h @ layer["wk"]).reshape(b, 1, c.n_kv_heads, c.head_dim)
+        v = (h @ layer["wv"]).reshape(b, 1, c.n_kv_heads, c.head_dim)
+        q = _rope(q, positions, c.rope_theta)
+        k = _rope(k, positions, c.rope_theta)
+
+        # Scatter each sequence's new K/V row into its page.
+        page_ids = jnp.take_along_axis(
+            block_tables, (seq_lens // page_size)[:, None], axis=1
+        )[:, 0]
+        slots = seq_lens % page_size
+        kp = kp.at[:, page_ids, slots, :].set(jnp.swapaxes(k[:, 0], 0, 1))
+        vp = vp.at[:, page_ids, slots, :].set(jnp.swapaxes(v[:, 0], 0, 1))
+
+        attn = attend(q[:, 0], kp, vp, block_tables, seq_lens + 1)
+        x = x + attn.reshape(b, 1, c.q_dim) @ layer["wo"]
+        h = rms_norm(x, layer["mlp_norm"], c.rms_eps)
+        x = x + _mlp(layer, h)
+        return (x,), (kp, vp)
+
+    (x,), (k_pages, v_pages) = jax.lax.scan(
+        layer_fn, (x,), (params["layers"], k_pages, v_pages)
+    )
+    x = rms_norm(x, params["final_norm"], c.rms_eps)
+    return k_pages, v_pages, (x[:, 0] @ params["out"])

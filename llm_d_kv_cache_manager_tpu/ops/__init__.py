@@ -1,0 +1,7 @@
+from llm_d_kv_cache_manager_tpu.ops.paged_attention import (
+    paged_attention,
+    paged_attention_reference,
+    write_kv_pages,
+)
+
+__all__ = ["paged_attention", "paged_attention_reference", "write_kv_pages"]

@@ -1,0 +1,220 @@
+"""Paged attention for TPU: Pallas flash-decoding kernel over a block table.
+
+This is the device-side counterpart of the control plane: the engine's KV
+cache lives in fixed-size *pages* in HBM, indexed by a per-sequence block
+table — the same pages whose create/evict events the control plane ingests
+(BlockStored/BlockRemoved carry the hashes of these pages' token chunks).
+
+TPU-first design:
+- KV pages are laid out head-major `[n_kv_heads, n_pages, page_size, head_dim]`
+  so one grid step streams one (head, page) tile — contiguous, lane-aligned
+  DMA with page_size and head_dim both at the 128-lane sweet spot.
+- The block table and sequence lengths ride `PrefetchScalarGridSpec` scalar
+  prefetch: the pipeline uses them in BlockSpec index_maps to DMA exactly the
+  pages each sequence references — the gather never materializes.
+- Online-softmax accumulators (m, l, acc) live in VMEM scratch and persist
+  across the page-grid dimension (flash-decoding); grouped-query heads are
+  padded to the 8-sublane minimum tile.
+
+A jnp reference implementation (`paged_attention_reference`) provides the
+semantics on any backend and is the test oracle; `paged_attention` dispatches
+to the kernel on TPU (or interpret mode elsewhere when requested).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_GROUP_PAD = 8  # sublane minimum for f32 tiles
+
+
+def paged_attention_reference(
+    q: jax.Array,  # [batch, n_q_heads, head_dim]
+    k_pages: jax.Array,  # [n_kv_heads, n_pages, page_size, head_dim]
+    v_pages: jax.Array,  # [n_kv_heads, n_pages, page_size, head_dim]
+    block_tables: jax.Array,  # [batch, pages_per_seq] int32
+    seq_lens: jax.Array,  # [batch] int32
+) -> jax.Array:
+    """Gather-based paged attention; oracle for the Pallas kernel."""
+    n_kv_heads, _, page_size, head_dim = k_pages.shape
+    batch, n_q_heads, _ = q.shape
+    group = n_q_heads // n_kv_heads
+    scale = 1.0 / (head_dim**0.5)
+
+    # [batch, n_kv, pages, page, hd] -> [batch, n_kv, L, hd]
+    k = k_pages[:, block_tables]  # [n_kv, batch, pages, page, hd]
+    v = v_pages[:, block_tables]
+    k = jnp.moveaxis(k, 1, 0).reshape(batch, n_kv_heads, -1, head_dim)
+    v = jnp.moveaxis(v, 1, 0).reshape(batch, n_kv_heads, -1, head_dim)
+
+    qg = q.reshape(batch, n_kv_heads, group, head_dim)
+    scores = jnp.einsum("bhgd,bhld->bhgl", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    max_len = k.shape[2]
+    pos = jnp.arange(max_len)[None, None, None, :]
+    mask = pos < seq_lens[:, None, None, None]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgl,bhld->bhgd", weights, v.astype(jnp.float32))
+    return out.reshape(batch, n_q_heads, head_dim).astype(q.dtype)
+
+
+def _decode_kernel(
+    block_tables_ref,  # SMEM [batch, pages_per_seq]
+    seq_lens_ref,  # SMEM [batch]
+    q_ref,  # VMEM (1, 1, GROUP_PAD, head_dim)
+    k_ref,  # VMEM (1, 1, page_size, head_dim) - this (b,h,i)'s page
+    v_ref,  # VMEM (1, 1, page_size, head_dim)
+    o_ref,  # VMEM (1, 1, GROUP_PAD, head_dim)
+    m_scratch,  # VMEM (GROUP_PAD, 128) f32
+    l_scratch,  # VMEM (GROUP_PAD, 128) f32
+    acc_scratch,  # VMEM (GROUP_PAD, head_dim) f32
+    *,
+    page_size: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+    seq_len = seq_lens_ref[b]
+    start = i * page_size
+
+    @pl.when(i == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, -jnp.inf)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+        # seq_len == 0 rows (padded batch slots) never enter _attend, so the
+        # output block must not be left as uninitialized VMEM garbage.
+        o_ref[0, 0] = jnp.zeros_like(o_ref[0, 0])
+
+    @pl.when(start < seq_len)
+    def _attend():
+        q = q_ref[0, 0].astype(jnp.float32)  # (GROUP_PAD, hd)
+        k = k_ref[0, 0].astype(jnp.float32)  # (page, hd)
+        v = v_ref[0, 0].astype(jnp.float32)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # (GROUP_PAD, page)
+        pos = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < seq_len, s, -jnp.inf)
+
+        m_prev = m_scratch[:, :1]  # (GROUP_PAD, 1)
+        l_prev = l_scratch[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)  # (GROUP_PAD, page)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+
+        acc_scratch[:] = acc_scratch[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scratch[:] = jnp.broadcast_to(m_new, m_scratch.shape)
+        l_scratch[:] = jnp.broadcast_to(l_new, l_scratch.shape)
+
+        # Last valid page for this sequence: emit normalized output.
+        @pl.when(start + page_size >= seq_len)
+        def _emit():
+            l_final = l_scratch[:, :1]
+            o_ref[0, 0] = (acc_scratch[:] / jnp.where(l_final == 0, 1.0, l_final)
+                           ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_attention(
+    q: jax.Array,  # [batch, n_q_heads, head_dim]
+    k_pages: jax.Array,  # [n_kv_heads, n_pages, page_size, head_dim]
+    v_pages: jax.Array,
+    block_tables: jax.Array,  # [batch, pages_per_seq] int32
+    seq_lens: jax.Array,  # [batch] int32
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash-decoding paged attention (Pallas TPU kernel)."""
+    n_kv_heads, _n_pages, page_size, head_dim = k_pages.shape
+    batch, n_q_heads, _ = q.shape
+    group = n_q_heads // n_kv_heads
+    if group * n_kv_heads != n_q_heads:
+        raise ValueError(f"n_q_heads {n_q_heads} not divisible by n_kv_heads {n_kv_heads}")
+    pages_per_seq = block_tables.shape[1]
+    scale = 1.0 / (head_dim**0.5)
+
+    # Pad grouped-query heads up to the 8-sublane tile minimum.
+    qg = q.reshape(batch, n_kv_heads, group, head_dim)
+    if group < _GROUP_PAD:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, _GROUP_PAD - group), (0, 0)))
+    group_pad = qg.shape[2]
+
+    grid = (batch, n_kv_heads, pages_per_seq)
+    kernel = functools.partial(_decode_kernel, page_size=page_size, scale=scale)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, group_pad, head_dim),
+                    lambda b, h, i, bt, sl: (b, h, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, page_size, head_dim),
+                    lambda b, h, i, bt, sl: (h, bt[b, i], 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, page_size, head_dim),
+                    lambda b, h, i, bt, sl: (h, bt[b, i], 0, 0),
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, group_pad, head_dim),
+                lambda b, h, i, bt, sl: (b, h, 0, 0),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((group_pad, 128), jnp.float32),
+                pltpu.VMEM((group_pad, 128), jnp.float32),
+                pltpu.VMEM((group_pad, head_dim), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (batch, n_kv_heads, group_pad, head_dim), q.dtype
+        ),
+        interpret=interpret,
+    )(block_tables, seq_lens, qg, k_pages, v_pages)
+
+    return out[:, :, :group, :].reshape(batch, n_q_heads, head_dim)
+
+
+def write_kv_pages(
+    k_pages: jax.Array,  # [n_kv_heads, n_pages, page_size, head_dim]
+    v_pages: jax.Array,
+    block_table: jax.Array,  # [pages_per_seq] int32
+    k_new: jax.Array,  # [seq, n_kv_heads, head_dim]
+    v_new: jax.Array,
+    start_pos,  # int32 scalar: sequence position of k_new[0]
+):
+    """Scatter new K/V rows into their pages via the block table.
+
+    Functional update (donates nothing itself; jit callers should donate the
+    page buffers). Positions are `start_pos + arange(seq)`; each maps to
+    page `block_table[pos // page_size]`, slot `pos % page_size`.
+    """
+    _n_kv, _n_pages, page_size, _hd = k_pages.shape
+    seq = k_new.shape[0]
+    pos = start_pos + jnp.arange(seq)
+    page_ids = block_table[pos // page_size]  # [seq]
+    slots = pos % page_size  # [seq]
+
+    k_rows = jnp.swapaxes(k_new, 0, 1)  # [n_kv, seq, hd]
+    v_rows = jnp.swapaxes(v_new, 0, 1)
+    k_pages = k_pages.at[:, page_ids, slots, :].set(k_rows)
+    v_pages = v_pages.at[:, page_ids, slots, :].set(v_rows)
+    return k_pages, v_pages

@@ -1,0 +1,8 @@
+from llm_d_kv_cache_manager_tpu.parallel.mesh import (
+    make_mesh,
+    param_shardings,
+    shard_params,
+)
+from llm_d_kv_cache_manager_tpu.parallel.ring_attention import ring_attention
+
+__all__ = ["make_mesh", "param_shardings", "shard_params", "ring_attention"]

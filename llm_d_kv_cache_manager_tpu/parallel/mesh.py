@@ -1,0 +1,82 @@
+"""Device mesh + sharding rules for the flagship model.
+
+TPU-first scaling: pick a mesh, annotate shardings, let XLA/GSPMD insert the
+collectives over ICI. Axes:
+
+- "dp": data parallel — batch dimension of activations.
+- "tp": tensor parallel — attention heads and MLP hidden dimension
+  (Megatron-style: column-parallel wq/wk/wv/w_gate/w_up, row-parallel
+  wo/w_down, vocab-parallel output projection). With these specs the
+  per-layer communication under jit reduces to the canonical two
+  all-reduces (post-wo, post-w_down) riding ICI.
+- "sp": sequence parallel for long context — handled separately by
+  parallel.ring_attention (shard_map + ppermute), not by these specs.
+
+The reference control plane has no in-framework parallelism (SURVEY.md §2.5);
+this module exists for the engine side of the TPU build.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    dp: int = 1,
+    tp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (dp, tp) mesh from the first dp*tp available devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    need = dp * tp
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices for dp={dp} x tp={tp}, have {len(devices)}")
+    grid = np.array(devices[:need]).reshape(dp, tp)
+    return Mesh(grid, axis_names=("dp", "tp"))
+
+
+# PartitionSpecs for one decoder layer's stacked params ([n_layers, ...]).
+_LAYER_SPECS: Dict[str, P] = {
+    "attn_norm": P(None, None),
+    "wq": P(None, None, "tp"),
+    "wk": P(None, None, "tp"),
+    "wv": P(None, None, "tp"),
+    "wo": P(None, "tp", None),
+    "mlp_norm": P(None, None),
+    "w_gate": P(None, None, "tp"),
+    "w_up": P(None, None, "tp"),
+    "w_down": P(None, "tp", None),
+}
+
+
+def param_specs() -> Dict:
+    """PartitionSpec pytree matching models.llama.init_params structure."""
+    return {
+        "embed": P(None, None),  # replicated; activations gather from it
+        "layers": dict(_LAYER_SPECS),
+        "final_norm": P(None),
+        "out": P(None, "tp"),  # vocab-parallel logits
+    }
+
+
+def param_shardings(mesh: Mesh) -> Dict:
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        param_specs(),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_params(params: Dict, mesh: Mesh) -> Dict:
+    """Place a host-resident param pytree onto the mesh."""
+    return jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(p, s), params, param_shardings(mesh)
+    )
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("dp", None))

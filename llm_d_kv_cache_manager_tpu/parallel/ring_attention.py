@@ -1,0 +1,91 @@
+"""Ring attention: exact causal attention over sequence-sharded inputs.
+
+Long-context support for the engine side of the TPU build: the sequence is
+sharded across an "sp" mesh axis; each device holds a local Q/K/V chunk and
+K/V blocks rotate around the ring via `lax.ppermute` (ICI neighbor exchange)
+while every device accumulates online-softmax partial results for its local
+queries. After sp steps every query has attended to every key — exact
+attention, O(L/sp) memory per device, communication overlapped by XLA.
+
+Causality is enforced per (query-chunk, key-chunk) pair: a device at ring
+position i fully attends chunks j < i, applies the triangular mask at j == i,
+and skips j > i (their contribution is masked to -inf, preserving static
+shapes for the compiler).
+
+Use under shard_map, e.g.:
+
+    mesh = Mesh(devices, ("sp",))
+    attn = shard_map(
+        functools.partial(ring_attention, axis_name="sp"),
+        mesh=mesh,
+        in_specs=(P(None, "sp", None, None),) * 3,
+        out_specs=P(None, "sp", None, None),
+    )
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def ring_attention(
+    q: jax.Array,  # [B, L_local, n_heads, head_dim]
+    k: jax.Array,  # [B, L_local, n_heads, head_dim]
+    v: jax.Array,
+    axis_name: str = "sp",
+) -> jax.Array:
+    """Exact causal attention with K/V rotating around the `axis_name` ring."""
+    n_shards = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, l_local, n_heads, head_dim = q.shape
+    scale = 1.0 / (head_dim**0.5)
+
+    qf = jnp.swapaxes(q, 1, 2).astype(jnp.float32)  # [B, H, Lq, D]
+
+    q_pos = jnp.arange(l_local)[:, None]  # local positions within a chunk
+    k_pos = jnp.arange(l_local)[None, :]
+
+    def step(carry, _):
+        k_blk, v_blk, m, l, acc, src = carry
+        # src = ring position the current K/V block originated from.
+        kf = jnp.swapaxes(k_blk, 1, 2).astype(jnp.float32)  # [B, H, Lk, D]
+        vf = jnp.swapaxes(v_blk, 1, 2).astype(jnp.float32)
+
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+
+        # Causal mask across chunks: full if src < my_idx, triangular if
+        # equal, all-masked if src > my_idx.
+        same = src == my_idx
+        before = src < my_idx
+        mask = jnp.where(same, k_pos <= q_pos, before)  # [Lq, Lk] bool
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        # All-masked rows keep m = -inf; guard the exp against inf - inf.
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - m_safe)
+        p = jnp.where(mask[None, None], p, 0.0)
+        alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p, vf)
+
+        # Rotate K/V to the next device on the ring.
+        perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        src_next = (src - 1) % n_shards
+
+        return (k_next, v_next, m_new, l_new, acc_new, src_next), None
+
+    # Derive accumulators from qf so they carry the same device-varying type
+    # as the rotating K/V blocks (shard_map manual-axes typing).
+    m0 = jnp.full_like(qf[..., :1], -jnp.inf)
+    l0 = jnp.zeros_like(qf[..., :1])
+    acc0 = jnp.zeros_like(qf)
+
+    carry, _ = jax.lax.scan(step, (k, v, m0, l0, acc0, my_idx), None, length=n_shards)
+    _k, _v, _m, l_fin, acc, _src = carry
+
+    out = acc / jnp.where(l_fin == 0, 1.0, l_fin)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)  # [B, L_local, H, D]

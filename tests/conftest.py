@@ -16,12 +16,12 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-if "jax" in sys.modules:
-    # A plugin imported jax before us; the XLA backend is still uninitialized
-    # at collection time, so routing to CPU via the config API still works.
-    import jax
+# The axon TPU plugin ignores the JAX_PLATFORMS env var in this image; the
+# config API is authoritative. The XLA backend is still uninitialized at
+# collection time, so this reliably routes tests to the 8 virtual CPUs.
+import jax  # noqa: E402
 
-    jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
