@@ -1,0 +1,20 @@
+# Build/test entry points (parity with the reference's Makefile targets:
+# build/test/bench — /root/reference/Makefile).
+
+.PHONY: native test bench clean proto
+
+native:
+	cd native && python setup.py build_ext
+	cd kv_connectors/cpp && $(MAKE)
+
+test: native
+	python -m pytest tests/ -q
+
+bench: native
+	python bench.py
+
+proto:
+	protoc --python_out=. llm_d_kv_cache_manager_tpu/api/indexer.proto
+
+clean:
+	rm -rf build native/build kv_connectors/cpp/*.so llm_d_kv_cache_manager_tpu/*.so

@@ -1,0 +1,243 @@
+// kv_connectors: pod-to-pod KV block transfer engine (DCN path).
+//
+// The reference reserves kv_connectors/ for a native data plane that ships
+// KV blocks between pods (/root/reference/kv_connectors/ is empty; the
+// Makefile's clang target anticipates C++/CUDA sources there). This is the
+// TPU build's implementation of the cross-pod leg: a C++ block server that
+// exports a pod's host-staged KV pages over TCP (DCN), plus a client fetch.
+// Intra-slice transfers ride ICI via JAX collectives (see
+// llm_d_kv_cache_manager_tpu/kv_connectors/connector.py); this engine covers
+// the cross-slice / cross-pod hop where ICI does not reach.
+//
+// Wire protocol (all little-endian):
+//   request:  u32 magic 'KVTB', u64 block_hash
+//   response: u32 magic, u8 status (0=ok, 1=missing), u64 length, payload
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image).
+
+#include <arpa/inet.h>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <set>
+#include <string>
+#include <sys/socket.h>
+#include <thread>
+#include <unistd.h>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x4B565442;  // 'KVTB'
+
+struct BlockStore {
+  std::mutex mu;
+  std::unordered_map<uint64_t, std::vector<uint8_t>> blocks;
+};
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::thread accept_thread;
+  BlockStore store;
+  // Live-connection tracking so stop() can tear down established
+  // connections and wait for their threads before the Server is freed.
+  std::mutex conn_mu;
+  std::condition_variable conn_cv;
+  std::set<int> conn_fds;
+  int conn_count = 0;
+  bool stopping = false;
+};
+
+bool read_exact(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t got = ::recv(fd, p, n, 0);
+    if (got <= 0) return false;
+    p += got;
+    n -= static_cast<size_t>(got);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t sent = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (sent <= 0) return false;
+    p += sent;
+    n -= static_cast<size_t>(sent);
+  }
+  return true;
+}
+
+void serve_conn(Server* server, int fd) {
+  for (;;) {
+    uint32_t magic = 0;
+    uint64_t hash = 0;
+    if (!read_exact(fd, &magic, 4) || magic != kMagic) break;
+    if (!read_exact(fd, &hash, 8)) break;
+
+    std::vector<uint8_t> payload;
+    uint8_t status = 1;
+    {
+      std::lock_guard<std::mutex> lock(server->store.mu);
+      auto it = server->store.blocks.find(hash);
+      if (it != server->store.blocks.end()) {
+        payload = it->second;  // copy out under lock
+        status = 0;
+      }
+    }
+    uint64_t length = payload.size();
+    if (!write_exact(fd, &kMagic, 4) || !write_exact(fd, &status, 1) ||
+        !write_exact(fd, &length, 8))
+      break;
+    if (length > 0 && !write_exact(fd, payload.data(), length)) break;
+  }
+  ::close(fd);
+  {
+    std::lock_guard<std::mutex> lock(server->conn_mu);
+    server->conn_fds.erase(fd);
+    server->conn_count--;
+  }
+  server->conn_cv.notify_all();
+}
+
+void accept_loop(Server* server) {
+  for (;;) {
+    int fd = ::accept(server->listen_fd, nullptr, nullptr);
+    if (fd < 0) return;  // listen socket closed -> shutdown
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    {
+      std::lock_guard<std::mutex> lock(server->conn_mu);
+      if (server->stopping) {
+        ::close(fd);
+        continue;
+      }
+      server->conn_fds.insert(fd);
+      server->conn_count++;
+    }
+    std::thread(serve_conn, server, fd).detach();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Starts a block server; returns an opaque handle (0 on failure).
+// Binds 0.0.0.0:port; port 0 picks an ephemeral port (query kvt_server_port).
+void* kvt_server_start(int port) {
+  auto* server = new Server();
+  server->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (server->listen_fd < 0) {
+    delete server;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(server->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(server->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) < 0 ||
+      ::listen(server->listen_fd, 64) < 0) {
+    ::close(server->listen_fd);
+    delete server;
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(server->listen_fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  server->port = ntohs(addr.sin_port);
+  server->accept_thread = std::thread(accept_loop, server);
+  return server;
+}
+
+int kvt_server_port(void* handle) {
+  return handle ? static_cast<Server*>(handle)->port : -1;
+}
+
+// Registers (or replaces) a block in the server's host-RAM store.
+int kvt_server_put(void* handle, uint64_t hash, const uint8_t* data,
+                   uint64_t len) {
+  if (!handle) return -1;
+  auto* server = static_cast<Server*>(handle);
+  std::lock_guard<std::mutex> lock(server->store.mu);
+  server->store.blocks[hash].assign(data, data + len);
+  return 0;
+}
+
+int kvt_server_remove(void* handle, uint64_t hash) {
+  if (!handle) return -1;
+  auto* server = static_cast<Server*>(handle);
+  std::lock_guard<std::mutex> lock(server->store.mu);
+  return server->store.blocks.erase(hash) ? 0 : 1;
+}
+
+uint64_t kvt_server_block_count(void* handle) {
+  if (!handle) return 0;
+  auto* server = static_cast<Server*>(handle);
+  std::lock_guard<std::mutex> lock(server->store.mu);
+  return server->store.blocks.size();
+}
+
+void kvt_server_stop(void* handle) {
+  if (!handle) return;
+  auto* server = static_cast<Server*>(handle);
+  ::shutdown(server->listen_fd, SHUT_RDWR);
+  ::close(server->listen_fd);
+  if (server->accept_thread.joinable()) server->accept_thread.join();
+  // Force established connections down and wait for their threads to exit
+  // before freeing the Server (connection threads dereference it).
+  {
+    std::unique_lock<std::mutex> lock(server->conn_mu);
+    server->stopping = true;
+    for (int fd : server->conn_fds) ::shutdown(fd, SHUT_RDWR);
+    server->conn_cv.wait(lock, [server] { return server->conn_count == 0; });
+  }
+  delete server;
+}
+
+// Fetches a block from a remote pod. Returns payload length (>= 0, empty
+// blocks included), -2 if the block is missing remotely, or -1 on transport
+// error. `out` must hold `cap` bytes.
+int64_t kvt_fetch(const char* host, int port, uint64_t hash, uint8_t* out,
+                  uint64_t cap) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
+      ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+
+  int64_t result = -1;
+  uint32_t magic = kMagic;
+  uint8_t status = 1;
+  uint64_t length = 0;
+  if (write_exact(fd, &magic, 4) && write_exact(fd, &hash, 8) &&
+      read_exact(fd, &magic, 4) && magic == kMagic &&
+      read_exact(fd, &status, 1) && read_exact(fd, &length, 8)) {
+    if (status != 0) {
+      result = -2;  // missing (distinct from a present-but-empty block)
+    } else if (length <= cap) {
+      if (length == 0 || read_exact(fd, out, length))
+        result = static_cast<int64_t>(length);
+    }
+  }
+  ::close(fd);
+  return result;
+}
+
+}  // extern "C"

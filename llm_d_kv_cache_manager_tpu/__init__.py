@@ -8,15 +8,22 @@ candidate pods for incoming prompts by longest consecutive prefix of
 already-cached KV blocks.
 
 Layer map (mirrors reference SURVEY.md §1, re-designed Python/JAX/C++-native):
-  - kvcache/        orchestrator (Indexer.get_pod_scores), scorer, kvblock index
-  - kvevents/       msgpack KVEvents ingestion: ZMQ subscriber + sharded pool
-  - tokenization/   cached tokenizers + chunked prefix-token store + pool
-  - preprocessing/  chat-template rendering
+  - kvcache/        orchestrator (Indexer.get_pod_scores), scorer, kvblock
+                    index backends (in-memory, cost-aware, Redis/Valkey RESP)
+  - kvevents/       msgpack KVEvents: ZMQ subscriber/publisher + sharded pool
+  - tokenization/   cached tokenizers + prefix-token stores + pool + UDS client
+  - preprocessing/  chat-template rendering (transformers-parity)
   - metrics/        Prometheus collectors + instrumented index decorator
-  - api/            gRPC + HTTP scoring services
+  - api/            gRPC + HTTP scoring services (the container entrypoint)
   - models/ ops/ parallel/ engine/   TPU-side: Pallas paged attention, a
     paged-KV JAX engine that emits KVEvents (the in-repo vLLM-TPU stand-in),
-    mesh/sharding utilities and the kv_connectors data plane.
+    dp/tp mesh shardings and sp ring attention
+  - kv_connectors/  KV-block data plane: host staging tier + C++ DCN transfer
+    engine (kv_connectors/cpp) + ICI moves via sharding changes
+
+Native components: native/fnvcbor.c (chained CBOR+FNV hash core, ~70x the
+pure-Python path) and kv_connectors/cpp/kv_transfer.cpp (block server) —
+build both with `make native`. Sidecar: services/uds_tokenizer/.
 """
 
 __version__ = "0.1.0"

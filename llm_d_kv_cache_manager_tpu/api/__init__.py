@@ -1,0 +1,6 @@
+from llm_d_kv_cache_manager_tpu.api.grpc_server import (
+    IndexerGrpcClient,
+    serve_grpc,
+)
+
+__all__ = ["IndexerGrpcClient", "serve_grpc"]

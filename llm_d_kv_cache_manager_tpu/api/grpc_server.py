@@ -1,0 +1,94 @@
+"""gRPC scoring service.
+
+Parity target: the reference's gRPC service wrapper
+(/root/reference/examples/kv_cache_index_service/server/server.go:70-96) over
+api/indexer.proto. Message classes are protoc-generated (indexer_pb2); the
+service is wired with grpcio generic handlers (no grpc_tools codegen needed
+in this environment), exposing `kvtpu.api.v1.IndexerService/GetPodScores`.
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+from typing import Dict, Optional
+
+import grpc
+
+from llm_d_kv_cache_manager_tpu.api import indexer_pb2 as pb
+from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
+
+logger = kvlog.get_logger("api.grpc")
+
+SERVICE_NAME = "kvtpu.api.v1.IndexerService"
+METHOD_GET_POD_SCORES = "GetPodScores"
+
+
+def _make_handler(indexer):
+    def get_pod_scores(
+        request: pb.GetPodScoresRequest, context: grpc.ServicerContext
+    ) -> pb.GetPodScoresResponse:
+        try:
+            scores: Dict[str, float] = indexer.get_pod_scores(
+                request.prompt, request.model_name, list(request.pod_identifiers)
+            )
+        except Exception as e:  # noqa: BLE001 - surface as gRPC status
+            logger.warning("GetPodScores failed: %s", e)
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+            return pb.GetPodScoresResponse()
+        response = pb.GetPodScoresResponse()
+        for pod, score in sorted(scores.items(), key=lambda kv: -kv[1]):
+            response.scores.append(pb.PodScore(pod_identifier=pod, score=score))
+        return response
+
+    rpc_handlers = {
+        METHOD_GET_POD_SCORES: grpc.unary_unary_rpc_method_handler(
+            get_pod_scores,
+            request_deserializer=pb.GetPodScoresRequest.FromString,
+            response_serializer=pb.GetPodScoresResponse.SerializeToString,
+        )
+    }
+    return grpc.method_handlers_generic_handler(SERVICE_NAME, rpc_handlers)
+
+
+def serve_grpc(
+    indexer,
+    address: str = "[::]:50051",
+    max_workers: int = 8,
+) -> grpc.Server:
+    """Start (non-blocking) a gRPC server wrapping the indexer."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((_make_handler(indexer),))
+    server.add_insecure_port(address)
+    server.start()
+    logger.info("gRPC IndexerService listening on %s", address)
+    return server
+
+
+class IndexerGrpcClient:
+    """Minimal client for IndexerService (mirrors the reference's example
+    client, /root/reference/examples/kv_cache_index_service/client/main.go)."""
+
+    def __init__(self, target: str, timeout_s: float = 5.0):
+        self._channel = grpc.insecure_channel(target)
+        self._timeout = timeout_s
+        self._call = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/{METHOD_GET_POD_SCORES}",
+            request_serializer=pb.GetPodScoresRequest.SerializeToString,
+            response_deserializer=pb.GetPodScoresResponse.FromString,
+        )
+
+    def get_pod_scores(
+        self, prompt: str, model_name: str, pod_identifiers=()
+    ) -> Dict[str, float]:
+        response = self._call(
+            pb.GetPodScoresRequest(
+                prompt=prompt,
+                model_name=model_name,
+                pod_identifiers=list(pod_identifiers),
+            ),
+            timeout=self._timeout,
+        )
+        return {s.pod_identifier: s.score for s in response.scores}
+
+    def close(self) -> None:
+        self._channel.close()

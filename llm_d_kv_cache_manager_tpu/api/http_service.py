@@ -1,0 +1,183 @@
+"""HTTP scoring service — the shipped container entrypoint.
+
+Parity target: /root/reference/examples/kv_events/online/main.go (the
+reference's Dockerfile entrypoint): one process wiring the Indexer read path,
+the ZMQ KVEvents write plane, and Prometheus metrics behind HTTP:
+
+  POST /score_completions       {"prompt", "model", "pods"?} -> {"podScores"}
+  POST /score_chat_completions  {"messages"/"conversations", "model",
+                                 "chat_template"?, "pods"?}
+                                -> {"podScores", "templated_messages"}
+  GET  /metrics                 Prometheus exposition
+  GET  /health                  liveness
+
+Env config mirrors the reference's variable set (online/main.go:41-58):
+ZMQ_ENDPOINT, ZMQ_TOPIC, POOL_CONCURRENCY, PYTHONHASHSEED (hash seed!),
+BLOCK_SIZE, HTTP_PORT, HF_TOKEN, LOCAL_TOKENIZER_DIR.
+
+Run: python -m llm_d_kv_cache_manager_tpu.api.http_service
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+from typing import Optional
+
+from aiohttp import web
+
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import IndexConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.pool import EventPool, EventPoolConfig
+from llm_d_kv_cache_manager_tpu.preprocessing.chat_completions import (
+    ChatTemplatingProcessor,
+    RenderRequest,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.pool import TokenizersPoolConfig
+from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
+
+logger = kvlog.get_logger("api.http")
+
+
+def config_from_env() -> dict:
+    return {
+        "zmq_endpoint": os.environ.get("ZMQ_ENDPOINT", "tcp://*:5557"),
+        "zmq_topic": os.environ.get("ZMQ_TOPIC", "kv@"),
+        "pool_concurrency": int(os.environ.get("POOL_CONCURRENCY", "4")),
+        "hash_seed": os.environ.get("PYTHONHASHSEED", ""),
+        "block_size": int(os.environ.get("BLOCK_SIZE", "16")),
+        "http_port": int(os.environ.get("HTTP_PORT", "8080")),
+        "hf_token": os.environ.get("HF_TOKEN"),
+        "enable_hf": os.environ.get("ENABLE_HF_TOKENIZER", "") == "1",
+        "enable_metrics": os.environ.get("ENABLE_METRICS", "1") == "1",
+    }
+
+
+class ScoringService:
+    """Owns the Indexer (read path) + EventPool (write plane)."""
+
+    def __init__(self, env: Optional[dict] = None, indexer: Optional[Indexer] = None):
+        env = env or config_from_env()
+        self.env = env
+        self.templating = ChatTemplatingProcessor()
+
+        if indexer is not None:  # injected (tests / embedding)
+            self.indexer = indexer
+            self.event_pool = EventPool(
+                EventPoolConfig(
+                    zmq_endpoint=env["zmq_endpoint"],
+                    topic_filter=env["zmq_topic"],
+                    concurrency=env["pool_concurrency"],
+                ),
+                self.indexer.kv_block_index,
+                self.indexer.token_processor,
+            )
+            return
+
+        indexer_config = IndexerConfig(
+            token_processor_config=TokenProcessorConfig(
+                block_size=env["block_size"], hash_seed=env["hash_seed"]
+            ),
+            kv_block_index_config=IndexConfig.default(),
+            tokenizers_pool_config=TokenizersPoolConfig(
+                enable_local=True,
+                enable_hf=env["enable_hf"],
+                hf_auth_token=env.get("hf_token"),
+            ),
+        )
+        indexer_config.kv_block_index_config.enable_metrics = env["enable_metrics"]
+        self.indexer = Indexer(config=indexer_config, chat_templating=self.templating)
+        self.event_pool = EventPool(
+            EventPoolConfig(
+                zmq_endpoint=env["zmq_endpoint"],
+                topic_filter=env["zmq_topic"],
+                concurrency=env["pool_concurrency"],
+            ),
+            self.indexer.kv_block_index,
+            self.indexer.token_processor,
+        )
+
+    def start(self, with_subscriber: bool = True) -> None:
+        self.indexer.run()
+        self.event_pool.start(with_subscriber=with_subscriber)
+
+    def stop(self) -> None:
+        self.event_pool.shutdown()
+        self.indexer.shutdown()
+
+    # -- handlers ------------------------------------------------------------
+
+    async def handle_score_completions(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            prompt = body["prompt"]
+            model = body["model"]
+        except (json.JSONDecodeError, KeyError, TypeError) as e:
+            return web.json_response(
+                {"error": f"invalid request: {e}"}, status=400
+            )
+        pods = body.get("pods", [])
+        try:
+            scores = await asyncio.to_thread(
+                self.indexer.get_pod_scores, prompt, model, pods
+            )
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"error": str(e)}, status=500)
+        return web.json_response({"podScores": scores})
+
+    async def handle_score_chat_completions(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            model = body["model"]
+            render_request = RenderRequest.from_dict(body)
+        except (json.JSONDecodeError, KeyError, TypeError) as e:
+            return web.json_response({"error": f"invalid request: {e}"}, status=400)
+        try:
+            rendered = await asyncio.to_thread(self.templating.render, render_request)
+            scores = await asyncio.to_thread(
+                self.indexer.get_pod_scores, rendered, model, body.get("pods", [])
+            )
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"error": str(e)}, status=500)
+        return web.json_response(
+            {"podScores": scores, "templated_messages": rendered}
+        )
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        from prometheus_client import REGISTRY, generate_latest
+
+        return web.Response(
+            body=generate_latest(REGISTRY), content_type="text/plain"
+        )
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/score_completions", self.handle_score_completions)
+        app.router.add_post(
+            "/score_chat_completions", self.handle_score_chat_completions
+        )
+        app.router.add_get("/metrics", self.handle_metrics)
+        app.router.add_get("/health", self.handle_health)
+        return app
+
+
+def main() -> None:
+    kvlog.setup()
+    env = config_from_env()
+    service = ScoringService(env)
+    service.start()
+    try:
+        web.run_app(service.make_app(), port=env["http_port"])
+    finally:
+        service.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,13 @@
+from llm_d_kv_cache_manager_tpu.kv_connectors.connector import (
+    BlockTransferServer,
+    KVConnector,
+    KVConnectorConfig,
+    fetch_block,
+)
+
+__all__ = [
+    "BlockTransferServer",
+    "KVConnector",
+    "KVConnectorConfig",
+    "fetch_block",
+]

@@ -41,10 +41,15 @@ class RenderRequest:
     model_name: Optional[str] = None
 
     @classmethod
-    def from_json(cls, payload: str) -> "RenderRequest":
-        data = json.loads(payload)
+    def from_dict(cls, data: Dict[str, Any]) -> "RenderRequest":
+        """The one place the JSON body contract maps to fields — shared by
+        the HTTP service, the UDS sidecar, and from_json."""
+        if "conversations" in data:
+            conversations = data["conversations"]
+        else:
+            conversations = [data["messages"]]
         return cls(
-            conversations=data["conversations"],
+            conversations=conversations,
             chat_template=data.get("chat_template"),
             tools=data.get("tools"),
             documents=data.get("documents"),
@@ -53,6 +58,10 @@ class RenderRequest:
             template_vars=data.get("template_vars", {}),
             model_name=data.get("model"),
         )
+
+    @classmethod
+    def from_json(cls, payload: str) -> "RenderRequest":
+        return cls.from_dict(json.loads(payload))
 
 
 class ChatTemplatingProcessor:

@@ -1,0 +1,156 @@
+/* Native hash core for the TPU KV-cache manager.
+ *
+ * Implements the chained block-key derivation --
+ * FNV-64a(canonical_CBOR([parent_u64, [token_u32...], null])) -- as a CPython
+ * extension. This is the read path's hot loop (every GetPodScores call hashes
+ * prompt_len / block_size chunks) and the write plane's request-key
+ * recomputation. Semantically identical to the pure-Python implementation in
+ * llm_d_kv_cache_manager_tpu/kvcache/kvblock/hashing.py (the test oracle);
+ * ~100x faster on long prompts.
+ *
+ * The reference gets the equivalent speed from Go + a Rust tokenizer core;
+ * this build keeps Python as the control-plane language and drops to C for
+ * the hashing kernel, mirroring the reference's native-where-hot design
+ * (/root/reference/pkg/kvcache/kvblock/token_processor.go:94-112).
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+#define FNV64_OFFSET 0xcbf29ce484222325ULL
+#define FNV64_PRIME 0x100000001b3ULL
+
+static uint64_t fnv1a64(const uint8_t *data, size_t n, uint64_t h) {
+    for (size_t i = 0; i < n; i++) {
+        h ^= (uint64_t)data[i];
+        h *= FNV64_PRIME;
+    }
+    return h;
+}
+
+/* Shortest-form CBOR head (RFC 8949 canonical). Returns bytes written. */
+static size_t cbor_head(uint8_t *out, uint8_t major, uint64_t value) {
+    uint8_t mt = (uint8_t)(major << 5);
+    if (value < 24) {
+        out[0] = mt | (uint8_t)value;
+        return 1;
+    } else if (value <= 0xff) {
+        out[0] = mt | 24;
+        out[1] = (uint8_t)value;
+        return 2;
+    } else if (value <= 0xffff) {
+        out[0] = mt | 25;
+        out[1] = (uint8_t)(value >> 8);
+        out[2] = (uint8_t)value;
+        return 3;
+    } else if (value <= 0xffffffffULL) {
+        out[0] = mt | 26;
+        out[1] = (uint8_t)(value >> 24);
+        out[2] = (uint8_t)(value >> 16);
+        out[3] = (uint8_t)(value >> 8);
+        out[4] = (uint8_t)value;
+        return 5;
+    }
+    out[0] = mt | 27;
+    for (int i = 0; i < 8; i++) out[1 + i] = (uint8_t)(value >> (56 - 8 * i));
+    return 9;
+}
+
+/* prefix_hashes(parent: int, tokens: sequence[int], block_size: int) -> list[int]
+ * Chunks tokens into full blocks and chain-hashes them. */
+static PyObject *prefix_hashes(PyObject *self, PyObject *args) {
+    unsigned long long parent;
+    PyObject *tokens_obj;
+    Py_ssize_t block_size;
+    if (!PyArg_ParseTuple(args, "KOn", &parent, &tokens_obj, &block_size))
+        return NULL;
+    if (block_size <= 0) {
+        PyErr_SetString(PyExc_ValueError, "block_size must be positive");
+        return NULL;
+    }
+
+    PyObject *seq = PySequence_Fast(tokens_obj, "tokens must be a sequence");
+    if (!seq) return NULL;
+    Py_ssize_t n_tokens = PySequence_Fast_GET_SIZE(seq);
+    Py_ssize_t n_blocks = n_tokens / block_size;
+
+    PyObject *result = PyList_New(n_blocks);
+    if (!result) {
+        Py_DECREF(seq);
+        return NULL;
+    }
+    if (n_blocks == 0) {
+        Py_DECREF(seq);
+        return result;
+    }
+
+    /* Worst case per block: 9 (parent) + 9 (array head) + 9*block + 2. */
+    size_t buf_cap = 20 + 9 * (size_t)block_size;
+    uint8_t *buf = (uint8_t *)PyMem_Malloc(buf_cap);
+    if (!buf) {
+        Py_DECREF(seq);
+        Py_DECREF(result);
+        return PyErr_NoMemory();
+    }
+
+    uint64_t h = (uint64_t)parent;
+    PyObject **items = PySequence_Fast_ITEMS(seq);
+    for (Py_ssize_t b = 0; b < n_blocks; b++) {
+        size_t pos = 0;
+        buf[pos++] = 0x83; /* array(3) */
+        pos += cbor_head(buf + pos, 0, h);
+        pos += cbor_head(buf + pos, 4, (uint64_t)block_size);
+        for (Py_ssize_t i = 0; i < block_size; i++) {
+            PyObject *item = items[b * block_size + i];
+            unsigned long long tok = PyLong_AsUnsignedLongLong(item);
+            if (tok == (unsigned long long)-1 && PyErr_Occurred()) {
+                PyMem_Free(buf);
+                Py_DECREF(seq);
+                Py_DECREF(result);
+                return NULL;
+            }
+            pos += cbor_head(buf + pos, 0, (uint64_t)tok);
+        }
+        buf[pos++] = 0xf6; /* null */
+
+        h = fnv1a64(buf, pos, FNV64_OFFSET);
+        PyObject *val = PyLong_FromUnsignedLongLong(h);
+        if (!val) {
+            PyMem_Free(buf);
+            Py_DECREF(seq);
+            Py_DECREF(result);
+            return NULL;
+        }
+        PyList_SET_ITEM(result, b, val);
+    }
+
+    PyMem_Free(buf);
+    Py_DECREF(seq);
+    return result;
+}
+
+/* fnv64a(data: bytes, h: int = offset) -> int */
+static PyObject *fnv64a_py(PyObject *self, PyObject *args) {
+    Py_buffer view;
+    unsigned long long h = FNV64_OFFSET;
+    if (!PyArg_ParseTuple(args, "y*|K", &view, &h)) return NULL;
+    uint64_t out = fnv1a64((const uint8_t *)view.buf, (size_t)view.len, h);
+    PyBuffer_Release(&view);
+    return PyLong_FromUnsignedLongLong(out);
+}
+
+static PyMethodDef methods[] = {
+    {"prefix_hashes", prefix_hashes, METH_VARARGS,
+     "Chained CBOR+FNV-64a block hashes over full token blocks."},
+    {"fnv64a", fnv64a_py, METH_VARARGS, "FNV-64a of a bytes-like object."},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef module = {
+    PyModuleDef_HEAD_INIT, "_kvtpu_native",
+    "Native hash core (chained CBOR+FNV-64a).", -1, methods,
+};
+
+PyMODINIT_FUNC PyInit__kvtpu_native(void) { return PyModule_Create(&module); }

@@ -1,0 +1,38 @@
+"""Build the native hash core: python native/setup.py build_ext (or `make native`).
+
+Installs _kvtpu_native.so into the llm_d_kv_cache_manager_tpu package dir,
+where kvcache/kvblock/hashing.py picks it up (pure-Python fallback otherwise).
+"""
+
+import os
+import shutil
+import sys
+from setuptools import Extension, setup
+from setuptools.command.build_ext import build_ext
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+PKG_DIR = os.path.join(HERE, "..", "llm_d_kv_cache_manager_tpu")
+
+
+class BuildInPackage(build_ext):
+    def run(self):
+        super().run()
+        for output in self.get_outputs():
+            target = os.path.join(PKG_DIR, os.path.basename(output))
+            shutil.copy2(output, target)
+            print(f"installed {target}")
+
+
+setup(
+    name="kvtpu-native",
+    version="0.1.0",
+    ext_modules=[
+        Extension(
+            "_kvtpu_native",
+            sources=[os.path.join(HERE, "fnvcbor.c")],
+            extra_compile_args=["-O3"],
+        )
+    ],
+    cmdclass={"build_ext": BuildInPackage},
+    script_args=sys.argv[1:] or ["build_ext"],
+)

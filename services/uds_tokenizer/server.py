@@ -1,0 +1,132 @@
+"""UDS tokenizer sidecar service.
+
+Parity target: /root/reference/services/uds_tokenizer/server.py — an aiohttp
+app listening on a Unix domain socket (plus a TCP probe port for k8s
+liveness), offloading tokenization and chat-template rendering from the
+indexer process:
+
+  POST /tokenize       {"prompt", "model", "add_special_tokens"?}
+                       -> {"input_ids", "offset_mapping"}
+  POST /chat-template  RenderRequest JSON -> {"rendered"}
+  GET  /config         current config    POST /config  hot-reload
+  GET  /health         liveness
+
+The indexer-side client is llm_d_kv_cache_manager_tpu/tokenization/uds_client.py.
+
+Run: python services/uds_tokenizer/server.py [--socket PATH] [--probe-port N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import sys
+
+from aiohttp import web
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from services.uds_tokenizer.tokenizer_service import TokenizerService  # noqa: E402
+
+logger = logging.getLogger("uds_tokenizer")
+
+DEFAULT_SOCKET = "/tmp/tokenizer/tokenizer-uds.socket"
+DEFAULT_PROBE_PORT = 8080
+
+
+def make_app(service: TokenizerService) -> web.Application:
+    async def tokenize(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            prompt, model = body["prompt"], body["model"]
+        except (json.JSONDecodeError, KeyError) as e:
+            return web.json_response({"error": f"invalid request: {e}"}, status=400)
+        try:
+            ids, offsets = await asyncio.to_thread(
+                service.encode, prompt, model, body.get("add_special_tokens", True)
+            )
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"error": str(e)}, status=500)
+        return web.json_response({"input_ids": ids, "offset_mapping": offsets})
+
+    async def chat_template(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError as e:
+            return web.json_response({"error": f"invalid request: {e}"}, status=400)
+        try:
+            rendered = await asyncio.to_thread(service.render_chat_template, body)
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"error": str(e)}, status=500)
+        return web.json_response({"rendered": rendered})
+
+    async def get_config(request: web.Request) -> web.Response:
+        return web.json_response(service.config)
+
+    async def post_config(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError as e:
+            return web.json_response({"error": f"invalid request: {e}"}, status=400)
+        service.update_config(body)
+        return web.json_response(service.config)
+
+    async def health(request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    app = web.Application()
+    app.router.add_post("/tokenize", tokenize)
+    app.router.add_post("/chat-template", chat_template)
+    app.router.add_get("/config", get_config)
+    app.router.add_post("/config", post_config)
+    app.router.add_get("/health", health)
+    return app
+
+
+async def run_server(
+    socket_path: str = DEFAULT_SOCKET,
+    probe_port: int = DEFAULT_PROBE_PORT,
+    service: TokenizerService | None = None,
+) -> None:
+    service = service or TokenizerService()
+    app = make_app(service)
+    runner = web.AppRunner(app)
+    await runner.setup()
+
+    os.makedirs(os.path.dirname(socket_path) or ".", exist_ok=True)
+    if os.path.exists(socket_path):
+        os.unlink(socket_path)
+    uds_site = web.UnixSite(runner, socket_path)
+    await uds_site.start()
+    logger.info("UDS tokenizer listening on %s", socket_path)
+
+    if probe_port > 0:
+        tcp_site = web.TCPSite(runner, "0.0.0.0", probe_port)
+        await tcp_site.start()
+        logger.info("TCP probe on :%d", probe_port)
+
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    finally:
+        await runner.cleanup()
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--socket", default=os.environ.get("UDS_SOCKET", DEFAULT_SOCKET))
+    parser.add_argument(
+        "--probe-port",
+        type=int,
+        default=int(os.environ.get("PROBE_PORT", DEFAULT_PROBE_PORT)),
+    )
+    args = parser.parse_args()
+    asyncio.run(run_server(args.socket, args.probe_port))
+
+
+if __name__ == "__main__":
+    main()

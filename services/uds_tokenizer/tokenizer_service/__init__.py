@@ -1,0 +1,3 @@
+from services.uds_tokenizer.tokenizer_service.tokenizer import TokenizerService
+
+__all__ = ["TokenizerService"]

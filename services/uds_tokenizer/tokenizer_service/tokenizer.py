@@ -1,0 +1,86 @@
+"""Tokenizer service backing the UDS sidecar.
+
+Parity target: /root/reference/services/uds_tokenizer/tokenizer_service/
+tokenizer.py — loads tokenizers per model (local dirs or hub downloads when
+allowed), encodes with offsets, renders chat templates, supports config
+hot-reload.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class TokenizerService:
+    def __init__(self, config: Optional[dict] = None):
+        self._config = {
+            "local_tokenizer_dir": os.environ.get("LOCAL_TOKENIZER_DIR", ""),
+            "allow_remote": os.environ.get("ALLOW_REMOTE_DOWNLOAD", "") == "1",
+            "tokenizer_filename": "tokenizer.json",
+        }
+        if config:
+            self._config.update(config)
+        self._tokenizers: Dict[str, object] = {}
+        self._mu = threading.Lock()
+        # One processor for the service lifetime: its per-model template
+        # cache must survive across requests.
+        from llm_d_kv_cache_manager_tpu.preprocessing.chat_completions import (
+            ChatTemplatingProcessor,
+        )
+
+        self._templating = ChatTemplatingProcessor()
+
+    @property
+    def config(self) -> dict:
+        return dict(self._config)
+
+    def update_config(self, updates: dict) -> None:
+        with self._mu:
+            self._config.update(updates)
+            self._tokenizers.clear()  # hot-reload: drop loaded tokenizers
+
+    # -- tokenization ----------------------------------------------------------
+
+    def _get_tokenizer(self, model: str):
+        with self._mu:
+            tok = self._tokenizers.get(model)
+        if tok is not None:
+            return tok
+        from tokenizers import Tokenizer as HFTokenizer
+
+        from llm_d_kv_cache_manager_tpu.tokenization.tokenizer import (
+            discover_local_tokenizers,
+        )
+
+        local = discover_local_tokenizers(
+            self._config["local_tokenizer_dir"], self._config["tokenizer_filename"]
+        )
+        if model in local:
+            tok = HFTokenizer.from_file(local[model])
+        elif self._config["allow_remote"]:
+            tok = HFTokenizer.from_pretrained(model)
+        else:
+            raise FileNotFoundError(
+                f"model {model!r} not found locally and remote download disabled"
+            )
+        with self._mu:
+            self._tokenizers[model] = tok
+        return tok
+
+    def encode(
+        self, prompt: str, model: str, add_special_tokens: bool = True
+    ) -> Tuple[List[int], List[List[int]]]:
+        tok = self._get_tokenizer(model)
+        encoding = tok.encode(prompt, add_special_tokens=add_special_tokens)
+        return list(encoding.ids), [list(o) for o in encoding.offsets]
+
+    # -- chat templating -------------------------------------------------------
+
+    def render_chat_template(self, body: dict) -> str:
+        from llm_d_kv_cache_manager_tpu.preprocessing.chat_completions import (
+            RenderRequest,
+        )
+
+        return self._templating.render(RenderRequest.from_dict(body))

@@ -1,0 +1,167 @@
+"""API layer tests: gRPC service + client roundtrip, HTTP scoring service."""
+
+import asyncio
+import socket
+import time
+
+import pytest
+
+from tests.conftest import TEST_MODEL_NAME, TEST_TOKENIZER_JSON
+from llm_d_kv_cache_manager_tpu.api.grpc_server import IndexerGrpcClient, serve_grpc
+from llm_d_kv_cache_manager_tpu.api.http_service import ScoringService
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPool,
+    TokenizersPoolConfig,
+)
+
+BLOCK_SIZE = 4
+PROMPT = "The quick brown fox jumps over the lazy dog. " * 3
+
+
+def _make_indexer():
+    indexer = Indexer(
+        config=IndexerConfig(
+            token_processor_config=TokenProcessorConfig(block_size=BLOCK_SIZE),
+        ),
+        tokenization_pool=TokenizationPool(
+            TokenizersPoolConfig(
+                workers=2, local_tokenizer_files={TEST_MODEL_NAME: TEST_TOKENIZER_JSON}
+            ),
+        ),
+    )
+    indexer.run()
+    return indexer
+
+
+def _seed_index(indexer, pod="pod-grpc"):
+    """Pretend `pod` cached the prompt's full prefix."""
+    enc = indexer.tokenizers_pool.tokenizer.encode(PROMPT, TEST_MODEL_NAME)
+    keys = indexer.token_processor.tokens_to_kv_block_keys(
+        None, enc.tokens, TEST_MODEL_NAME
+    )
+    engine_keys = [Key(TEST_MODEL_NAME, 10_000 + i) for i in range(len(keys))]
+    indexer.kv_block_index.add(engine_keys, keys, [PodEntry(pod, "hbm")])
+    return len(keys)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestGrpc:
+    def test_roundtrip_scores(self):
+        indexer = _make_indexer()
+        n_blocks = _seed_index(indexer)
+        port = _free_port()
+        server = serve_grpc(indexer, f"127.0.0.1:{port}")
+        try:
+            client = IndexerGrpcClient(f"127.0.0.1:{port}")
+            scores = client.get_pod_scores(PROMPT, TEST_MODEL_NAME)
+            assert scores.get("pod-grpc") == float(n_blocks)
+            # Filtered query excludes the pod.
+            assert client.get_pod_scores(PROMPT, TEST_MODEL_NAME, ["other"]) == {}
+            client.close()
+        finally:
+            server.stop(grace=0)
+            indexer.shutdown()
+
+    def test_unknown_model_maps_to_internal_error(self):
+        import grpc
+
+        indexer = _make_indexer()
+        port = _free_port()
+        server = serve_grpc(indexer, f"127.0.0.1:{port}")
+        try:
+            client = IndexerGrpcClient(f"127.0.0.1:{port}")
+            with pytest.raises(grpc.RpcError) as err:
+                client.get_pod_scores("hello world " * 10, "no-such-model")
+            assert err.value.code() == grpc.StatusCode.INTERNAL
+            client.close()
+        finally:
+            server.stop(grace=0)
+            indexer.shutdown()
+
+
+class TestHttp:
+    def _service(self):
+        env = {
+            "zmq_endpoint": "tcp://*:0",
+            "zmq_topic": "kv@",
+            "pool_concurrency": 1,
+            "hash_seed": "",
+            "block_size": BLOCK_SIZE,
+            "http_port": 0,
+            "enable_metrics": False,
+        }
+        return ScoringService(env, indexer=_make_indexer())
+
+    def test_score_completions_and_health(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        service = self._service()
+        n_blocks = _seed_index(service.indexer, pod="pod-http")
+
+        async def run():
+            async with TestClient(TestServer(service.make_app())) as client:
+                resp = await client.post(
+                    "/score_completions",
+                    json={"prompt": PROMPT, "model": TEST_MODEL_NAME},
+                )
+                assert resp.status == 200
+                data = await resp.json()
+                assert data["podScores"]["pod-http"] == float(n_blocks)
+
+                resp = await client.get("/health")
+                assert (await resp.json())["status"] == "ok"
+
+                # Malformed request: 400 with an error body.
+                resp = await client.post("/score_completions", json={"model": "x"})
+                assert resp.status == 400
+
+                resp = await client.get("/metrics")
+                assert resp.status == 200
+
+        try:
+            asyncio.run(run())
+        finally:
+            service.indexer.shutdown()
+
+    def test_score_chat_completions_renders_template(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        service = self._service()
+        template = (
+            "{% for m in messages %}[{{ m.role }}] {{ m.content }} {% endfor %}"
+            "{% if add_generation_prompt %}[assistant]{% endif %}"
+        )
+
+        async def run():
+            async with TestClient(TestServer(service.make_app())) as client:
+                resp = await client.post(
+                    "/score_chat_completions",
+                    json={
+                        "model": TEST_MODEL_NAME,
+                        "messages": [
+                            {"role": "user", "content": "The quick brown fox"}
+                        ],
+                        "chat_template": template,
+                    },
+                )
+                assert resp.status == 200
+                data = await resp.json()
+                assert data["templated_messages"] == (
+                    "[user] The quick brown fox [assistant]"
+                )
+                assert data["podScores"] == {}
+
+        try:
+            asyncio.run(run())
+        finally:
+            service.indexer.shutdown()

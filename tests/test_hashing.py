@@ -83,6 +83,45 @@ class TestChaining:
         assert len(fast) == 6  # partial tail block dropped
 
 
+class TestNativeExtensionParity:
+    def test_native_matches_pure_python(self):
+        native = hashing._native
+        if native is None:
+            import subprocess, sys, os
+
+            subprocess.run(
+                [sys.executable, "setup.py", "build_ext"],
+                cwd=os.path.join(os.path.dirname(__file__), "..", "native"),
+                check=True, capture_output=True,
+            )
+            import importlib
+
+            importlib.reload(hashing)
+            native = hashing._native
+        assert native is not None, "native hash core failed to build"
+        import random
+
+        rng = random.Random(0)
+        for block_size in (1, 4, 16, 64):
+            tokens = [rng.randrange(2**31) for _ in range(block_size * 7 + 3)]
+            for seed in ("", "42"):
+                root = hashing.init_hash(seed)
+                chunks = [
+                    tokens[i : i + block_size]
+                    for i in range(0, (len(tokens) // block_size) * block_size, block_size)
+                ]
+                assert list(native.prefix_hashes(root, tokens, block_size)) == (
+                    hashing.prefix_hashes(root, chunks)
+                )
+
+    def test_native_fnv_vector(self):
+        if hashing._native is None:
+            import pytest
+
+            pytest.skip("native extension not built")
+        assert hashing._native.fnv64a(b"foobar") == 0x85944171F73967E8
+
+
 class TestChunkedTokenDatabase:
     def test_partial_blocks_dropped(self):
         db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=16))

@@ -1,0 +1,239 @@
+"""kv_connectors data-plane tests: C++ transfer engine + connector tiers.
+
+Covers the component the reference leaves empty (kv_connectors/): host
+staging with control-plane events, cross-pod DCN fetch, and the two-tier
+scoring effect (hbm vs host weights) end to end.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+from llm_d_kv_cache_manager_tpu.kv_connectors import connector as conn_mod
+from llm_d_kv_cache_manager_tpu.kv_connectors.connector import (
+    BlockTransferServer,
+    KVConnector,
+    KVConnectorConfig,
+    fetch_block,
+    native_available,
+)
+
+
+def _ensure_lib():
+    if not native_available():
+        subprocess.run(
+            ["make", "-C", os.path.join(REPO, "kv_connectors", "cpp")], check=True
+        )
+        conn_mod._lib = conn_mod._load_lib()
+    assert native_available()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built_lib():
+    _ensure_lib()
+
+
+class TestTransferEngine:
+    def test_put_fetch_roundtrip(self):
+        server = BlockTransferServer()
+        try:
+            data = os.urandom(4096)
+            server.put(0xDEADBEEF, data)
+            got = fetch_block("127.0.0.1", server.port, 0xDEADBEEF, 8192)
+            assert got == data
+        finally:
+            server.close()
+
+    def test_empty_block_is_present_not_missing(self):
+        server = BlockTransferServer()
+        try:
+            server.put(3, b"")
+            assert fetch_block("127.0.0.1", server.port, 3, 64) == b""
+            assert fetch_block("127.0.0.1", server.port, 4, 64) is None
+        finally:
+            server.close()
+
+    def test_stop_with_open_connection_is_safe(self):
+        # Regression: stop() must wait for live connection threads (UAF).
+        import socket as pysock
+
+        server = BlockTransferServer()
+        server.put(1, b"x" * 10)
+        conn = pysock.create_connection(("127.0.0.1", server.port))
+        conn.sendall((0x4B565442).to_bytes(4, "little") + (1).to_bytes(8, "little"))
+        conn.recv(13)  # read header, keep connection open
+        server.close()  # must not crash / hang
+        conn.close()
+
+    def test_missing_block_returns_none(self):
+        server = BlockTransferServer()
+        try:
+            assert fetch_block("127.0.0.1", server.port, 42, 1024) is None
+        finally:
+            server.close()
+
+    def test_remove(self):
+        server = BlockTransferServer()
+        try:
+            server.put(7, b"x" * 100)
+            assert server.block_count() == 1
+            assert server.remove(7)
+            assert server.block_count() == 0
+            assert not server.remove(7)
+        finally:
+            server.close()
+
+    def test_cross_pod_fetch(self):
+        pod_a = BlockTransferServer()
+        pod_b = BlockTransferServer()
+        try:
+            pod_a.put(1, b"a-block")
+            pod_b.put(2, b"b-block" * 2)
+            assert fetch_block("127.0.0.1", pod_a.port, 1, 64) == b"a-block"
+            assert fetch_block("127.0.0.1", pod_b.port, 2, 64) == b"b-block" * 2
+            # Cross-lookup misses.
+            assert fetch_block("127.0.0.1", pod_a.port, 2, 64) is None
+        finally:
+            pod_a.close()
+            pod_b.close()
+
+    def test_transport_error_raises(self):
+        with pytest.raises(OSError):
+            fetch_block("127.0.0.1", 1, 1, 64)  # nothing listens on port 1
+
+    def test_large_block(self):
+        server = BlockTransferServer()
+        try:
+            data = os.urandom(2 * 1024 * 1024)  # a real page pair is ~MBs
+            server.put(99, data)
+            assert fetch_block("127.0.0.1", server.port, 99, len(data)) == data
+        finally:
+            server.close()
+
+
+class TestKVConnector:
+    def test_offload_restore_roundtrip(self):
+        import jax.numpy as jnp
+
+        events = []
+        connector = KVConnector(KVConnectorConfig(), event_sink=events.append)
+        try:
+            k = jnp.arange(16 * 8, dtype=jnp.float32).reshape(16, 8)
+            v = k + 1
+            connector.offload(123, k, v, token_ids=list(range(16)), block_size=16)
+            ev = events[-1].events[0]
+            assert ev.medium == "host"  # staged tier
+            assert ev.block_hashes == [123]
+
+            got = connector.restore(123, np.asarray(k), np.asarray(v))
+            assert got is not None
+            np.testing.assert_array_equal(got[0], np.asarray(k))
+            np.testing.assert_array_equal(got[1], np.asarray(v))
+        finally:
+            connector.close()
+
+    def test_onboard_from_remote_pod(self):
+        import jax.numpy as jnp
+
+        events_a, events_b = [], []
+        pod_a = KVConnector(event_sink=events_a.append)
+        pod_b = KVConnector(event_sink=events_b.append)
+        try:
+            k = jnp.ones((4, 4), jnp.float32) * 3
+            v = jnp.ones((4, 4), jnp.float32) * 5
+            pod_a.offload(55, k, v, token_ids=[1, 2, 3, 4], block_size=4)
+
+            got = pod_b.onboard(
+                "127.0.0.1", pod_a.port, 55, np.asarray(k), np.asarray(v),
+                token_ids=[1, 2, 3, 4], block_size=4,
+            )
+            assert got is not None
+            np.testing.assert_array_equal(got[0], np.asarray(k))
+            assert events_b[-1].events[0].medium == "hbm"  # landed in HBM tier
+        finally:
+            pod_a.close()
+            pod_b.close()
+
+    def test_drop_emits_removed(self):
+        import jax.numpy as jnp
+
+        events = []
+        connector = KVConnector(event_sink=events.append)
+        try:
+            k = jnp.zeros((2, 2)); v = jnp.zeros((2, 2))
+            connector.offload(9, k, v, token_ids=[1, 2], block_size=2)
+            connector.drop(9)
+            from llm_d_kv_cache_manager_tpu.kvevents.events import BlockRemoved
+
+            assert isinstance(events[-1].events[0], BlockRemoved)
+            assert connector.restore(9, np.zeros((2, 2)), np.zeros((2, 2))) is None
+        finally:
+            connector.close()
+
+
+class TestTwoTierScoring:
+    def test_host_tier_scores_below_hbm(self):
+        """Offload events make the indexer score host-resident blocks at the
+        host-tier weight — the two-tier HBM+host config from BASELINE.json."""
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+            InMemoryIndex,
+            InMemoryIndexConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+            ChunkedTokenDatabase,
+            TokenProcessorConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.kvcache.scorer import new_kv_block_scorer
+        from llm_d_kv_cache_manager_tpu.kvevents.pool import (
+            EventPool,
+            EventPoolConfig,
+            Message,
+        )
+
+        index = InMemoryIndex(InMemoryIndexConfig(size=100, pod_cache_size=4))
+        processor = ChunkedTokenDatabase(TokenProcessorConfig(block_size=4))
+        pool = EventPool(EventPoolConfig(concurrency=1), index, processor)
+        pool.start(with_subscriber=False)
+
+        def sink_for(pod):
+            def sink(batch):
+                pool.add_task(Message(
+                    topic=f"kv@{pod}@m", payload=batch.to_msgpack(), seq=0,
+                    pod_identifier=pod, model_name="m",
+                ))
+            return sink
+
+        import jax.numpy as jnp
+
+        conn_host = KVConnector(event_sink=sink_for("pod-host-tier"))
+        try:
+            tokens = [1, 2, 3, 4]
+            keys = processor.tokens_to_kv_block_keys(None, tokens, "m")
+            block_hash = keys[0].chunk_hash
+
+            # pod-host-tier staged the block to host RAM.
+            conn_host.offload(
+                block_hash, jnp.zeros((2, 2)), jnp.zeros((2, 2)),
+                token_ids=tokens, block_size=4,
+            )
+            # pod-hbm holds the same block in HBM (direct event).
+            from llm_d_kv_cache_manager_tpu.kvevents.events import (
+                BlockStored, EventBatch,
+            )
+            sink_for("pod-hbm")(EventBatch(ts=0.0, events=[
+                BlockStored([block_hash], None, tokens, 4, medium="hbm")
+            ]))
+            pool.drain()
+
+            scorer = new_kv_block_scorer()
+            scores = scorer.score(keys, index.lookup(keys, set()))
+            assert scores["pod-hbm"] == 1.0
+            assert scores["pod-host-tier"] == 0.8
+        finally:
+            conn_host.close()
+            pool.shutdown()

@@ -1,0 +1,118 @@
+"""Chat-templating preprocessing tests.
+
+Mirrors the intent of the reference's cgo/Python templating suite
+(/root/reference/pkg/preprocessing/chat_completions/cgo_functions_test.go):
+render correctness (via transformers' render_jinja_template — vLLM parity),
+template fetching from local model dirs, per-model caching.
+"""
+
+import json
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.preprocessing.chat_completions import (
+    ChatTemplatingProcessor,
+    RenderRequest,
+)
+
+SIMPLE_TEMPLATE = (
+    "{% for m in messages %}<|{{ m.role }}|>{{ m.content }}{% endfor %}"
+    "{% if add_generation_prompt %}<|assistant|>{% endif %}"
+)
+
+
+class TestRender:
+    def test_basic_render(self):
+        proc = ChatTemplatingProcessor()
+        out = proc.render(
+            RenderRequest(
+                conversations=[[{"role": "user", "content": "hi"}]],
+                chat_template=SIMPLE_TEMPLATE,
+            )
+        )
+        assert out == "<|user|>hi<|assistant|>"
+
+    def test_multi_turn_no_generation_prompt(self):
+        proc = ChatTemplatingProcessor()
+        out = proc.render(
+            RenderRequest(
+                conversations=[
+                    [
+                        {"role": "system", "content": "be brief"},
+                        {"role": "user", "content": "hi"},
+                        {"role": "assistant", "content": "hello"},
+                    ]
+                ],
+                chat_template=SIMPLE_TEMPLATE,
+                add_generation_prompt=False,
+            )
+        )
+        assert out == "<|system|>be brief<|user|>hi<|assistant|>hello"
+
+    def test_from_json_contract(self):
+        payload = json.dumps(
+            {
+                "conversations": [[{"role": "user", "content": "x"}]],
+                "chat_template": SIMPLE_TEMPLATE,
+                "add_generation_prompt": True,
+            }
+        )
+        req = RenderRequest.from_json(payload)
+        assert ChatTemplatingProcessor().render(req) == "<|user|>x<|assistant|>"
+
+    def test_missing_template_raises(self):
+        proc = ChatTemplatingProcessor()
+        with pytest.raises(ValueError, match="no chat template"):
+            proc.render(
+                RenderRequest(conversations=[[{"role": "user", "content": "x"}]])
+            )
+
+
+class TestFetch:
+    def test_fetch_from_tokenizer_config(self, tmp_path):
+        model_dir = tmp_path / "org" / "model"
+        model_dir.mkdir(parents=True)
+        (model_dir / "tokenizer_config.json").write_text(
+            json.dumps({"chat_template": SIMPLE_TEMPLATE})
+        )
+        proc = ChatTemplatingProcessor()
+        template = proc.fetch_chat_template("org/model", local_dir=str(tmp_path))
+        assert template == SIMPLE_TEMPLATE
+
+    def test_fetch_from_jinja_file_wins(self, tmp_path):
+        model_dir = tmp_path / "m"
+        model_dir.mkdir()
+        (model_dir / "chat_template.jinja").write_text("JINJA{{ messages }}")
+        (model_dir / "tokenizer_config.json").write_text(
+            json.dumps({"chat_template": "CONFIG"})
+        )
+        proc = ChatTemplatingProcessor()
+        assert proc.fetch_chat_template("m", local_dir=str(tmp_path)).startswith("JINJA")
+
+    def test_fetch_caches_per_model(self, tmp_path):
+        model_dir = tmp_path / "m"
+        model_dir.mkdir()
+        cfg = model_dir / "tokenizer_config.json"
+        cfg.write_text(json.dumps({"chat_template": "V1"}))
+        proc = ChatTemplatingProcessor()
+        assert proc.fetch_chat_template("m", local_dir=str(tmp_path)) == "V1"
+        cfg.write_text(json.dumps({"chat_template": "V2"}))
+        # Cached: still V1 until caches are cleared.
+        assert proc.fetch_chat_template("m", local_dir=str(tmp_path)) == "V1"
+        proc.clear_caches()
+        assert proc.fetch_chat_template("m", local_dir=str(tmp_path)) == "V2"
+
+    def test_render_uses_fetched_template(self, tmp_path):
+        model_dir = tmp_path / "m"
+        model_dir.mkdir()
+        (model_dir / "tokenizer_config.json").write_text(
+            json.dumps({"chat_template": SIMPLE_TEMPLATE})
+        )
+        proc = ChatTemplatingProcessor()
+        proc.fetch_chat_template("m", local_dir=str(tmp_path))
+        out = proc.render(
+            RenderRequest(
+                conversations=[[{"role": "user", "content": "y"}]], model_name="m"
+            )
+        )
+        assert out == "<|user|>y<|assistant|>"

@@ -1,0 +1,122 @@
+"""UDS sidecar integration: real Unix socket, real client, real tokenizer.
+
+Mirrors the reference's sidecar integration runner
+(/root/reference/services/uds_tokenizer/run_integration_tests.py): start the
+aiohttp app on a Unix socket, drive it through the indexer-side UDSTokenizer
+client, verify tokenize/chat-template/config endpoints and the composite
+fallback wiring.
+"""
+
+import asyncio
+import os
+import threading
+import time
+
+import pytest
+
+from tests.conftest import FIXTURES_DIR, TEST_MODEL_NAME
+from llm_d_kv_cache_manager_tpu.tokenization.uds_client import UDSTokenizer
+from services.uds_tokenizer.server import make_app
+from services.uds_tokenizer.tokenizer_service import TokenizerService
+
+
+@pytest.fixture
+def sidecar(tmp_path):
+    """Run the sidecar on a Unix socket in a background thread."""
+    socket_path = str(tmp_path / "tok.sock")
+    service = TokenizerService(
+        {"local_tokenizer_dir": FIXTURES_DIR, "allow_remote": False}
+    )
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    runner_holder = {}
+
+    async def start():
+        from aiohttp import web
+
+        app = make_app(service)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.UnixSite(runner, socket_path)
+        await site.start()
+        runner_holder["runner"] = runner
+        started.set()
+
+    def run_loop():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(start())
+        loop.run_forever()
+
+    thread = threading.Thread(target=run_loop, daemon=True)
+    thread.start()
+    assert started.wait(10)
+    yield socket_path
+    loop.call_soon_threadsafe(loop.stop)
+    thread.join(timeout=5)
+
+
+class TestUDSSidecar:
+    def test_tokenize_roundtrip(self, sidecar):
+        client = UDSTokenizer(socket_path=sidecar)
+        prompt = "The quick brown fox"
+        result = client.encode(prompt, TEST_MODEL_NAME)
+        assert result.tokens
+        assert len(result.offsets) == len(result.tokens)
+        # Byte offsets end at the prompt's byte length.
+        assert result.offsets[-1][1] == len(prompt.encode("utf-8"))
+
+    def test_matches_local_tokenizer(self, sidecar):
+        from llm_d_kv_cache_manager_tpu.tokenization.tokenizer import (
+            CachedLocalTokenizer,
+        )
+
+        local = CachedLocalTokenizer(
+            tokenizer_files={
+                TEST_MODEL_NAME: os.path.join(FIXTURES_DIR, "test-model", "tokenizer.json")
+            }
+        )
+        client = UDSTokenizer(socket_path=sidecar)
+        prompt = "KV cache aware routing with prefix reuse"
+        assert client.encode(prompt, TEST_MODEL_NAME).tokens == local.encode(
+            prompt, TEST_MODEL_NAME
+        ).tokens
+
+    def test_chat_template_render(self, sidecar):
+        from llm_d_kv_cache_manager_tpu.preprocessing.chat_completions import (
+            RenderRequest,
+        )
+
+        client = UDSTokenizer(socket_path=sidecar)
+        out = client.render_chat_template(
+            RenderRequest(
+                conversations=[[{"role": "user", "content": "ping"}]],
+                chat_template="{% for m in messages %}{{ m.role }}:{{ m.content }}{% endfor %}",
+            )
+        )
+        assert out == "user:ping"
+
+    def test_unknown_model_errors_cleanly(self, sidecar):
+        client = UDSTokenizer(socket_path=sidecar, retries=0)
+        with pytest.raises(RuntimeError, match="500"):
+            client.encode("hi", "missing-model")
+
+    def test_unreachable_socket_retries_then_fails(self, tmp_path):
+        client = UDSTokenizer(
+            socket_path=str(tmp_path / "nope.sock"), timeout_s=0.2, retries=1
+        )
+        t0 = time.time()
+        with pytest.raises(RuntimeError, match="after 2 attempts"):
+            client.encode("hi", TEST_MODEL_NAME)
+        assert time.time() - t0 < 5
+
+    def test_composite_falls_back_to_uds(self, sidecar):
+        from llm_d_kv_cache_manager_tpu.tokenization.tokenizer import (
+            CachedLocalTokenizer,
+            CompositeTokenizer,
+        )
+
+        # Local backend knows no models; composite must fall through to UDS.
+        composite = CompositeTokenizer(
+            [CachedLocalTokenizer(tokenizer_files={}), UDSTokenizer(socket_path=sidecar)]
+        )
+        assert composite.encode("fallback to sidecar", TEST_MODEL_NAME).tokens
