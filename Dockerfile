@@ -1,0 +1,46 @@
+# Container image for the KV-cache-manager scoring service.
+#
+# Parity target: /root/reference/Dockerfile (Go builder + UBI runtime with
+# libtokenizers/libzmq baked in; entrypoint = the online scoring service).
+# This build: Python runtime + the two native components compiled in-image
+# (hash core, kv_connectors transfer engine); entrypoint = the HTTP scoring
+# service (api/http_service.py), which wires the indexer read path, the ZMQ
+# KVEvents plane and /metrics.
+
+FROM python:3.12-slim AS builder
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make libzmq3-dev && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /src
+COPY pyproject.toml ./
+COPY llm_d_kv_cache_manager_tpu ./llm_d_kv_cache_manager_tpu
+COPY native ./native
+COPY kv_connectors ./kv_connectors
+COPY services ./services
+
+RUN pip install --no-cache-dir \
+        msgpack xxhash pyzmq tokenizers prometheus-client aiohttp \
+        "transformers>=4.40" grpcio protobuf \
+    && cd native && python setup.py build_ext \
+    && cd ../kv_connectors/cpp && make
+
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        libzmq5 && rm -rf /var/lib/apt/lists/* \
+    && useradd --uid 10001 --create-home kvtpu
+
+COPY --from=builder /usr/local/lib/python3.12/site-packages /usr/local/lib/python3.12/site-packages
+COPY --from=builder /src/llm_d_kv_cache_manager_tpu /app/llm_d_kv_cache_manager_tpu
+COPY --from=builder /src/kv_connectors/cpp/libkvtransfer.so /app/kv_connectors/cpp/libkvtransfer.so
+COPY --from=builder /src/services /app/services
+
+WORKDIR /app
+USER 10001
+
+# Env contract (see api/http_service.py): ZMQ_ENDPOINT, ZMQ_TOPIC,
+# POOL_CONCURRENCY, PYTHONHASHSEED, BLOCK_SIZE, HTTP_PORT, HF_TOKEN,
+# LOCAL_TOKENIZER_DIR, ENABLE_HF_TOKENIZER, ENABLE_METRICS.
+EXPOSE 8080 5557
+ENTRYPOINT ["python", "-m", "llm_d_kv_cache_manager_tpu.api.http_service"]

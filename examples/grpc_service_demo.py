@@ -1,0 +1,59 @@
+"""gRPC scoring service demo: server + client round trip.
+
+TPU-native equivalent of /root/reference/examples/kv_cache_index_service/
+(server + client). Starts the IndexerService, seeds the index, queries it
+over the wire.
+
+Run: python examples/grpc_service_demo.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from llm_d_kv_cache_manager_tpu.api.grpc_server import IndexerGrpcClient, serve_grpc
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPool,
+    TokenizersPoolConfig,
+)
+
+MODEL = "test-model"
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "..", "tests", "fixtures", "test-model", "tokenizer.json"
+)
+
+
+def main():
+    indexer = Indexer(
+        config=IndexerConfig(token_processor_config=TokenProcessorConfig(block_size=4)),
+        tokenization_pool=TokenizationPool(
+            TokenizersPoolConfig(workers=2, local_tokenizer_files={MODEL: FIXTURE})
+        ),
+    )
+    indexer.run()
+    server = serve_grpc(indexer, "127.0.0.1:50951")
+
+    prompt = "KV cache aware routing over a fleet of vLLM TPU pods. " * 2
+    enc = indexer.tokenizers_pool.tokenizer.encode(prompt, MODEL)
+    keys = indexer.token_processor.tokens_to_kv_block_keys(None, enc.tokens, MODEL)
+    indexer.kv_block_index.add(
+        [Key(MODEL, 70 + i) for i in range(len(keys))], keys, [PodEntry("pod-z", "hbm")]
+    )
+
+    client = IndexerGrpcClient("127.0.0.1:50951")
+    print(f"[1] scores over gRPC: {client.get_pod_scores(prompt, MODEL)}")
+    print(f"[2] filtered: {client.get_pod_scores(prompt, MODEL, ['nobody'])}")
+
+    client.close()
+    server.stop(grace=0)
+    indexer.shutdown()
+
+
+if __name__ == "__main__":
+    main()

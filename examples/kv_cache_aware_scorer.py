@@ -1,0 +1,69 @@
+"""Scheduler-plugin integration sketch: KV-cache-aware scorer for an EPP.
+
+TPU-native equivalent of /root/reference/examples/kv_cache_aware_scorer/
+kvcache_aware_scorer.go (build-tag-excluded in the reference): shows how an
+inference-scheduler endpoint-picker plugin wraps Indexer.get_pod_scores and
+normalizes the raw longest-prefix scores into the [0, 1] range schedulers
+expect, with unscored candidate pods at 0.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+
+class KVCacheAwareScorer:
+    """EPP-style scorer: normalize indexer scores over candidate pods."""
+
+    def __init__(self, indexer, model_name: str):
+        self.indexer = indexer
+        self.model_name = model_name
+
+    def score(self, prompt: str, candidate_pods: Sequence[str]) -> Dict[str, float]:
+        raw = self.indexer.get_pod_scores(prompt, self.model_name, list(candidate_pods))
+        max_score = max(raw.values(), default=0.0)
+        if max_score <= 0:
+            return {pod: 0.0 for pod in candidate_pods}
+        return {pod: raw.get(pod, 0.0) / max_score for pod in candidate_pods}
+
+
+if __name__ == "__main__":
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+        TokenProcessorConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+        TokenizationPool,
+        TokenizersPoolConfig,
+    )
+
+    fixture = os.path.join(
+        os.path.dirname(__file__), "..", "tests", "fixtures", "test-model",
+        "tokenizer.json",
+    )
+    indexer = Indexer(
+        config=IndexerConfig(token_processor_config=TokenProcessorConfig(block_size=4)),
+        tokenization_pool=TokenizationPool(
+            TokenizersPoolConfig(workers=2, local_tokenizer_files={"test-model": fixture})
+        ),
+    )
+    indexer.run()
+    prompt = "lazy dog jumps over the quick brown fox " * 4
+    enc = indexer.tokenizers_pool.tokenizer.encode(prompt, "test-model")
+    keys = indexer.token_processor.tokens_to_kv_block_keys(None, enc.tokens, "test-model")
+    indexer.kv_block_index.add(
+        [Key("test-model", i) for i in range(len(keys))], keys,
+        [PodEntry("10.0.0.1", "hbm")],
+    )
+    indexer.kv_block_index.add(
+        [Key("test-model", 100 + i) for i in range(len(keys) // 2)],
+        keys[: len(keys) // 2], [PodEntry("10.0.0.2", "host")],
+    )
+    scorer = KVCacheAwareScorer(indexer, "test-model")
+    print(scorer.score(prompt, ["10.0.0.1", "10.0.0.2", "10.0.0.3"]))
+    indexer.shutdown()

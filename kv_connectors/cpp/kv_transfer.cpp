@@ -98,13 +98,17 @@ void serve_conn(Server* server, int fd) {
       break;
     if (length > 0 && !write_exact(fd, payload.data(), length)) break;
   }
-  ::close(fd);
   {
+    // Erase before close (an fd recycled by another thread must not be
+    // shut down by stop()), and notify while still holding the lock: the
+    // moment conn_count hits 0, stop() may delete the Server, so touching
+    // conn_cv after unlocking would be use-after-free.
     std::lock_guard<std::mutex> lock(server->conn_mu);
     server->conn_fds.erase(fd);
+    ::close(fd);
     server->conn_count--;
+    server->conn_cv.notify_all();
   }
-  server->conn_cv.notify_all();
 }
 
 void accept_loop(Server* server) {

@@ -10,7 +10,7 @@ in this environment), exposing `kvtpu.api.v1.IndexerService/GetPodScores`.
 from __future__ import annotations
 
 from concurrent import futures
-from typing import Dict, Optional
+from typing import Dict
 
 import grpc
 

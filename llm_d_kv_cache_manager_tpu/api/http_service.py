@@ -67,30 +67,23 @@ class ScoringService:
 
         if indexer is not None:  # injected (tests / embedding)
             self.indexer = indexer
-            self.event_pool = EventPool(
-                EventPoolConfig(
-                    zmq_endpoint=env["zmq_endpoint"],
-                    topic_filter=env["zmq_topic"],
-                    concurrency=env["pool_concurrency"],
+        else:
+            indexer_config = IndexerConfig(
+                token_processor_config=TokenProcessorConfig(
+                    block_size=env["block_size"], hash_seed=env["hash_seed"]
                 ),
-                self.indexer.kv_block_index,
-                self.indexer.token_processor,
+                kv_block_index_config=IndexConfig.default(),
+                tokenizers_pool_config=TokenizersPoolConfig(
+                    enable_local=True,
+                    enable_hf=env["enable_hf"],
+                    hf_auth_token=env.get("hf_token"),
+                ),
             )
-            return
+            indexer_config.kv_block_index_config.enable_metrics = env["enable_metrics"]
+            self.indexer = Indexer(
+                config=indexer_config, chat_templating=self.templating
+            )
 
-        indexer_config = IndexerConfig(
-            token_processor_config=TokenProcessorConfig(
-                block_size=env["block_size"], hash_seed=env["hash_seed"]
-            ),
-            kv_block_index_config=IndexConfig.default(),
-            tokenizers_pool_config=TokenizersPoolConfig(
-                enable_local=True,
-                enable_hf=env["enable_hf"],
-                hf_auth_token=env.get("hf_token"),
-            ),
-        )
-        indexer_config.kv_block_index_config.enable_metrics = env["enable_metrics"]
-        self.indexer = Indexer(config=indexer_config, chat_templating=self.templating)
         self.event_pool = EventPool(
             EventPoolConfig(
                 zmq_endpoint=env["zmq_endpoint"],

@@ -136,7 +136,7 @@ def fetch_block(host: str, port: int, block_hash: int, max_size: int) -> Optiona
         return None
     if n < 0:
         raise OSError(f"kvt_fetch from {host}:{port} failed")
-    return bytes(bytearray(buf)[:n])
+    return ctypes.string_at(buf, n)
 
 
 @dataclass

@@ -4,5 +4,12 @@ from llm_d_kv_cache_manager_tpu.parallel.mesh import (
     shard_params,
 )
 from llm_d_kv_cache_manager_tpu.parallel.ring_attention import ring_attention
+from llm_d_kv_cache_manager_tpu.parallel.pipeline import pipeline_forward
 
-__all__ = ["make_mesh", "param_shardings", "shard_params", "ring_attention"]
+__all__ = [
+    "make_mesh",
+    "param_shardings",
+    "shard_params",
+    "ring_attention",
+    "pipeline_forward",
+]

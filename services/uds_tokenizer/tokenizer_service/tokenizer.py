@@ -23,6 +23,7 @@ class TokenizerService:
         if config:
             self._config.update(config)
         self._tokenizers: Dict[str, object] = {}
+        self._config_generation = 0
         self._mu = threading.Lock()
         # One processor for the service lifetime: its per-model template
         # cache must survive across requests.
@@ -40,12 +41,15 @@ class TokenizerService:
         with self._mu:
             self._config.update(updates)
             self._tokenizers.clear()  # hot-reload: drop loaded tokenizers
+            self._config_generation += 1
 
     # -- tokenization ----------------------------------------------------------
 
     def _get_tokenizer(self, model: str):
         with self._mu:
             tok = self._tokenizers.get(model)
+            generation = self._config_generation
+            config = dict(self._config)
         if tok is not None:
             return tok
         from tokenizers import Tokenizer as HFTokenizer
@@ -55,18 +59,24 @@ class TokenizerService:
         )
 
         local = discover_local_tokenizers(
-            self._config["local_tokenizer_dir"], self._config["tokenizer_filename"]
+            config["local_tokenizer_dir"], config["tokenizer_filename"]
         )
         if model in local:
             tok = HFTokenizer.from_file(local[model])
-        elif self._config["allow_remote"]:
+        elif config["allow_remote"]:
             tok = HFTokenizer.from_pretrained(model)
         else:
             raise FileNotFoundError(
                 f"model {model!r} not found locally and remote download disabled"
             )
         with self._mu:
-            self._tokenizers[model] = tok
+            # A config hot-reload may have landed while we were loading; do
+            # not cache or serve a tokenizer built from the old config.
+            stale = self._config_generation != generation
+            if not stale:
+                self._tokenizers[model] = tok
+        if stale:
+            return self._get_tokenizer(model)
         return tok
 
     def encode(

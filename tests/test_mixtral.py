@@ -1,0 +1,95 @@
+"""Mixtral MoE model family tests: routing math, training, ep-sharded step."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_d_kv_cache_manager_tpu.models.mixtral import (
+    MixtralConfig,
+    _moe_mlp,
+    forward_dense,
+    init_params,
+    loss_fn,
+    shard_params,
+    train_step,
+)
+
+CFG = MixtralConfig(
+    vocab_size=128, d_model=32, n_layers=2, n_q_heads=4, n_kv_heads=2,
+    head_dim=16, d_ff=64, n_experts=4, top_k=2, dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+class TestMoE:
+    def test_gating_matches_manual_topk(self, params):
+        layer = jax.tree_util.tree_map(lambda p: p[0], params["layers"])
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 6, CFG.d_model))
+        out = _moe_mlp(CFG, layer, x)
+
+        # Manual per-token reference.
+        logits = np.asarray(x @ layer["router"], dtype=np.float32)
+        expected = np.zeros((2, 6, CFG.d_model), np.float32)
+        for b in range(2):
+            for t in range(6):
+                top = np.argsort(-logits[b, t])[: CFG.top_k]
+                gates = np.exp(logits[b, t, top] - logits[b, t, top].max())
+                gates = gates / gates.sum()
+                for g, e in zip(gates, top):
+                    xe = np.asarray(x[b, t])
+                    hidden = (
+                        np.asarray(jax.nn.silu(xe @ layer["w_gate"][e]))
+                        * (xe @ np.asarray(layer["w_up"][e]))
+                    )
+                    expected[b, t] += g * (hidden @ np.asarray(layer["w_down"][e]))
+        np.testing.assert_allclose(np.asarray(out), expected, atol=1e-4)
+
+    def test_forward_shapes(self, params):
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 10), 0, CFG.vocab_size)
+        logits = forward_dense(CFG, params, tokens)
+        assert logits.shape == (2, 10, CFG.vocab_size)
+        assert not np.any(np.isnan(np.asarray(logits, dtype=np.float32)))
+
+    def test_loss_decreases(self, params):
+        batch = jax.random.randint(jax.random.PRNGKey(3), (4, 12), 0, CFG.vocab_size)
+        step = jax.jit(functools.partial(train_step, CFG))
+        p = params
+        first = None
+        for _ in range(5):
+            p, loss = step(p, batch)
+            first = first if first is not None else float(loss)
+        assert float(loss) < first
+
+
+class TestExpertParallel:
+    def test_ep_sharded_train_step(self):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        cfg = MixtralConfig(
+            vocab_size=128, d_model=32, n_layers=2, n_q_heads=4, n_kv_heads=2,
+            head_dim=16, d_ff=64, n_experts=8, top_k=2, dtype=jnp.float32,
+        )
+        devices = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+        mesh = Mesh(devices, ("dp", "tp", "ep"))
+        params = shard_params(init_params(cfg, jax.random.PRNGKey(4)), mesh)
+        batch = jax.device_put(
+            jax.random.randint(jax.random.PRNGKey(5), (4, 16), 0, cfg.vocab_size),
+            NamedSharding(mesh, P("dp", None)),
+        )
+        step = jax.jit(functools.partial(train_step, cfg))
+        new_params, loss = step(params, batch)
+        assert float(loss) > 0
+        # Experts stayed ep-sharded after the update.
+        spec = new_params["layers"]["w_gate"].sharding.spec
+        assert "ep" in str(spec)
+        # Sharded loss equals host reference.
+        host = jax.tree_util.tree_map(np.asarray, params)
+        ref = loss_fn(cfg, host, np.asarray(batch))
+        np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)

@@ -1,0 +1,47 @@
+"""Pipeline-parallel (pp) tests: GPipe schedule exactness vs dense layers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from llm_d_kv_cache_manager_tpu.models.llama import LlamaConfig, init_params
+from llm_d_kv_cache_manager_tpu.parallel.pipeline import (
+    _apply_local_layers,
+    pipeline_forward,
+)
+
+CFG = LlamaConfig(
+    vocab_size=128, d_model=32, n_layers=4, n_q_heads=4, n_kv_heads=2,
+    head_dim=16, d_ff=64, dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (6, 10), 0, CFG.vocab_size)
+    x = params["embed"][tokens]
+    ref = _apply_local_layers(CFG, params["layers"], x)
+    return params, x, ref
+
+
+@pytest.mark.parametrize("n_stages,n_micro", [(2, 2), (4, 3), (4, 6)])
+def test_matches_dense(setup, n_stages, n_micro):
+    params, x, ref = setup
+    assert CFG.n_layers % n_stages == 0
+    mesh = Mesh(np.array(jax.devices()[:n_stages]), ("pp",))
+    mb = x.shape[0] // n_micro
+    x_micro = x.reshape(n_micro, mb, *x.shape[1:])
+    out = pipeline_forward(CFG, params["layers"], x_micro, mesh)
+    np.testing.assert_allclose(
+        np.asarray(out.reshape(x.shape)), np.asarray(ref), atol=1e-4
+    )
+
+
+def test_single_stage_degenerates_to_dense(setup):
+    params, x, ref = setup
+    mesh = Mesh(np.array(jax.devices()[:1]), ("pp",))
+    out = pipeline_forward(CFG, params["layers"], x[None], mesh)
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ref), atol=1e-4)
