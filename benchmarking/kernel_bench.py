@@ -1,0 +1,116 @@
+"""Device-side microbenchmarks on real TPU hardware.
+
+Measures the Pallas flash-decoding paged-attention kernel against the jnp
+gather oracle at serving-relevant shapes, full decode-step latency for the
+flagship model, and the native hash core. Run on a TPU host:
+
+    python benchmarking/kernel_bench.py
+
+(The fleet-level benchmark — the headline metric — is bench.py at the repo
+root; this file quantifies the device building blocks underneath it.)
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+
+
+def timeit(fn, *args, iters=30, warmup=3):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_paged_attention():
+    from llm_d_kv_cache_manager_tpu.ops.paged_attention import (
+        paged_attention,
+        paged_attention_reference,
+    )
+
+    print("paged attention decode (n_q=8 n_kv=4 hd=128, page=128, bf16):")
+    print(f"{'batch':>6} {'ctx':>6} | {'pallas us':>10} {'gather us':>10} {'speedup':>8}")
+    for batch, ctx_pages in [(1, 8), (4, 8), (8, 8), (8, 32), (16, 16), (32, 8)]:
+        n_pages = max(batch * ctx_pages + 1, 64)
+        keys = jax.random.split(jax.random.PRNGKey(0), 4)
+        q = jax.random.normal(keys[0], (batch, 8, 128), jnp.bfloat16)
+        kp = jax.random.normal(keys[1], (4, n_pages, 128, 128), jnp.bfloat16)
+        vp = jax.random.normal(keys[2], (4, n_pages, 128, 128), jnp.bfloat16)
+        bt = jax.random.permutation(keys[3], n_pages)[: batch * ctx_pages]
+        bt = bt.reshape(batch, ctx_pages).astype(jnp.int32)
+        seq_lens = jnp.full((batch,), ctx_pages * 128 - 5, jnp.int32)
+
+        t_kernel = timeit(paged_attention, q, kp, vp, bt, seq_lens)
+        t_ref = timeit(paged_attention_reference, q, kp, vp, bt, seq_lens)
+        print(
+            f"{batch:>6} {ctx_pages * 128:>6} | {t_kernel * 1e6:>10.0f} "
+            f"{t_ref * 1e6:>10.0f} {t_ref / t_kernel:>7.2f}x"
+        )
+
+
+def bench_decode_step():
+    from llm_d_kv_cache_manager_tpu.models import llama
+
+    cfg = llama.LlamaConfig(
+        vocab_size=32768, d_model=1024, n_layers=8, n_q_heads=8, n_kv_heads=4,
+        head_dim=128, d_ff=4096, dtype=jnp.bfloat16,
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    n_pages, page = 512, 128
+    kp, vp = llama.make_kv_pages(cfg, n_pages, page)
+    batch, pages_per_seq = 8, 16
+    bt = jnp.arange(batch * pages_per_seq, dtype=jnp.int32).reshape(batch, pages_per_seq)
+    toks = jnp.zeros((batch,), jnp.int32)
+    seq_lens = jnp.full((batch,), pages_per_seq * page - 7, jnp.int32)
+
+    print(f"\nflagship decode step (d={cfg.d_model}, L={cfg.n_layers}, "
+          f"batch={batch}, ctx={pages_per_seq * page}):")
+    for use_kernel in (False, True):
+        # Thread the donated page buffers through successive steps — the
+        # real serving loop, no per-iteration allocation in the timing.
+        kp_t, vp_t = llama.make_kv_pages(cfg, n_pages, page)
+        for _ in range(3):  # warmup/compile
+            kp_t, vp_t, _ = llama.decode_step(
+                cfg, params, kp_t, vp_t, toks, bt, seq_lens, use_kernel=use_kernel
+            )
+        jax.block_until_ready(kp_t)
+        iters = 30
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            kp_t, vp_t, logits = llama.decode_step(
+                cfg, params, kp_t, vp_t, toks, bt, seq_lens, use_kernel=use_kernel
+            )
+        jax.block_until_ready(logits)
+        t = (time.perf_counter() - t0) / iters
+        label = "pallas kernel" if use_kernel else "jnp reference"
+        print(f"  {label}: {t * 1e3:.2f} ms/step ({batch / t:.0f} tok/s)")
+
+
+def bench_hash_core():
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock import hashing
+
+    tokens = list(range(8192))
+    root = hashing.init_hash("42")
+    t0 = time.perf_counter()
+    for _ in range(200):
+        hashing.prefix_hashes_fast(root, tokens, 16)
+    t = (time.perf_counter() - t0) / 200
+    native = "native" if hashing._native is not None else "pure-python"
+    print(f"\nhash core ({native}): 8192-token prompt -> {t * 1e6:.0f} us "
+          f"({8192 / t / 1e6:.1f}M tokens/s)")
+
+
+if __name__ == "__main__":
+    print(f"devices: {jax.devices()}")
+    bench_paged_attention()
+    bench_decode_step()
+    bench_hash_core()

@@ -29,7 +29,10 @@ def _make_handler(indexer):
     ) -> pb.GetPodScoresResponse:
         try:
             scores: Dict[str, float] = indexer.get_pod_scores(
-                request.prompt, request.model_name, list(request.pod_identifiers)
+                request.prompt,
+                request.model_name,
+                list(request.pod_identifiers),
+                lora_id=request.lora_id if request.HasField("lora_id") else None,
             )
         except Exception as e:  # noqa: BLE001 - surface as gRPC status
             logger.warning("GetPodScores failed: %s", e)
@@ -78,16 +81,16 @@ class IndexerGrpcClient:
         )
 
     def get_pod_scores(
-        self, prompt: str, model_name: str, pod_identifiers=()
+        self, prompt: str, model_name: str, pod_identifiers=(), lora_id=None
     ) -> Dict[str, float]:
-        response = self._call(
-            pb.GetPodScoresRequest(
-                prompt=prompt,
-                model_name=model_name,
-                pod_identifiers=list(pod_identifiers),
-            ),
-            timeout=self._timeout,
+        request = pb.GetPodScoresRequest(
+            prompt=prompt,
+            model_name=model_name,
+            pod_identifiers=list(pod_identifiers),
         )
+        if lora_id is not None:
+            request.lora_id = lora_id
+        response = self._call(request, timeout=self._timeout)
         return {s.pod_identifier: s.score for s in response.scores}
 
     def close(self) -> None:

@@ -114,9 +114,10 @@ class ScoringService:
                 {"error": f"invalid request: {e}"}, status=400
             )
         pods = body.get("pods", [])
+        lora_id = body.get("lora_id")
         try:
             scores = await asyncio.to_thread(
-                self.indexer.get_pod_scores, prompt, model, pods
+                self.indexer.get_pod_scores, prompt, model, pods, None, lora_id
             )
         except Exception as e:  # noqa: BLE001
             return web.json_response({"error": str(e)}, status=500)

@@ -57,6 +57,7 @@ class SequenceState:
     block_table: List[int]
     num_cached_tokens: int  # prefix-cache hit length at allocation time
     n_hashed_pages: int  # pages already committed (hashed + event emitted)
+    lora_id: Optional[int] = None  # adapter scoping for block hashes
 
 
 class _Page:
@@ -100,19 +101,22 @@ class BlockManager:
 
     # -- allocation ----------------------------------------------------------
 
-    def allocate(self, tokens: Sequence[int]) -> SequenceState:
+    def allocate(
+        self, tokens: Sequence[int], lora_id: Optional[int] = None
+    ) -> SequenceState:
         """Allocate pages for a new sequence, reusing cached prefix pages.
 
         Returns the sequence state; `num_cached_tokens` tells the caller how
         many leading tokens need no recompute. Raises OutOfPagesError if the
-        pool cannot cover the request (caller should retry later).
+        pool cannot cover the request (caller should retry later). A
+        `lora_id` scopes prefix reuse to that adapter's blocks.
         """
         tokens = list(tokens)
         n_pages_needed = (len(tokens) + self.config.page_size - 1) // self.config.page_size
 
         block_table: List[int] = []
         hashes = (
-            self.token_db.tokens_to_kv_block_keys(None, tokens, "")
+            self.token_db.tokens_to_kv_block_keys(None, tokens, "", lora_id=lora_id)
             if self.config.enable_prefix_caching
             else []
         )
@@ -144,6 +148,7 @@ class BlockManager:
             block_table=block_table,
             num_cached_tokens=n_cached_pages * self.config.page_size,
             n_hashed_pages=n_cached_pages,
+            lora_id=lora_id,
         )
         self._seq_counter += 1
         self._sequences[state.seq_id] = state
@@ -253,7 +258,9 @@ class BlockManager:
             from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key
 
             parent_key = Key("", parent_hash)
-        keys = self.token_db.tokens_to_kv_block_keys(parent_key, new_tokens, "")
+        keys = self.token_db.tokens_to_kv_block_keys(
+            parent_key, new_tokens, "", lora_id=state.lora_id
+        )
 
         new_hashes: List[int] = []
         for offset, key in enumerate(keys):
@@ -272,6 +279,7 @@ class BlockManager:
                     parent_block_hash=parent_hash,
                     token_ids=new_tokens,
                     block_size=self.config.page_size,
+                    lora_id=state.lora_id,
                     medium=self.config.device_tier,
                 )
             ])

@@ -106,16 +106,18 @@ class Indexer:
         model_name: str,
         pod_identifiers: Sequence[str],
         render_request=None,
+        lora_id=None,
     ) -> Dict[str, float]:
         """Score pods by cached-prefix length for `prompt`.
 
         Empty `pod_identifiers` means all known pods are relevant. Returns
-        {pod_identifier: score}; pods without hits are absent.
+        {pod_identifier: score}; pods without hits are absent. `lora_id`
+        scopes the lookup to blocks cached under that adapter.
         """
         tokens = self.tokenizers_pool.tokenize(render_request, prompt, model_name)
 
         block_keys = self.token_processor.tokens_to_kv_block_keys(
-            None, tokens, model_name
+            None, tokens, model_name, lora_id=lora_id
         )
         if not block_keys:
             kvlog.trace(logger, "no block keys for prompt, returning empty scores")

@@ -8,10 +8,12 @@ scheme of the reference token processor
 mirrors vLLM's block hashing. The hash seed must equal the engine fleet's
 PYTHONHASHSEED or every score silently becomes 0.
 
-The canonical CBOR subset implemented here covers the only payload shape the
-scheme ever encodes — `[uint, [uint...], None]` — per RFC 8949 §4.2.1
-(shortest-form integer encodings). A C fast path (native/) is used when built;
-this file is the always-available pure-Python reference implementation.
+The canonical CBOR subset implemented here covers the two payload shapes the
+scheme encodes — `[uint, [uint...], None]` (base) and
+`[uint, [uint...], [uint...]]` (with extra keys, e.g. a LoRA adapter id) —
+per RFC 8949 §4.2.1 (shortest-form integer encodings). A C fast path
+(native/) handles the common extra=None case when built; this file is the
+always-available pure-Python reference implementation for both shapes.
 """
 
 from __future__ import annotations
@@ -58,15 +60,28 @@ def _cbor_uint_head(major: int, value: int, out: bytearray) -> None:
         out += value.to_bytes(8, "big")
 
 
-def cbor_hash_payload(parent: int, tokens: Sequence[int]) -> bytes:
-    """Canonical CBOR for the 3-element payload [parent, tokens, null]."""
+def cbor_hash_payload(
+    parent: int, tokens: Sequence[int], extra: Optional[Sequence[int]] = None
+) -> bytes:
+    """Canonical CBOR for the 3-element payload [parent, tokens, extra].
+
+    `extra` carries per-block discriminators beyond the token stream — the
+    LoRA adapter id, for instance (vLLM mixes "extra keys" into its block
+    hashes the same way). None encodes as CBOR null, preserving the base
+    scheme byte-for-byte; a sequence encodes as an array of uints.
+    """
     out = bytearray()
     out.append(0x83)  # array(3)
     _cbor_uint_head(0, parent, out)
     _cbor_uint_head(4, len(tokens), out)
     for t in tokens:
         _cbor_uint_head(0, int(t), out)
-    out.append(0xF6)  # null
+    if extra is None:
+        out.append(0xF6)  # null
+    else:
+        _cbor_uint_head(4, len(extra), out)
+        for e in extra:
+            _cbor_uint_head(0, int(e), out)
     return bytes(out)
 
 
@@ -75,17 +90,23 @@ def init_hash(seed: str) -> int:
     return fnv64a(seed.encode("utf-8"))
 
 
-def chunk_hash(parent: int, tokens: Sequence[int]) -> int:
+def chunk_hash(
+    parent: int, tokens: Sequence[int], extra: Optional[Sequence[int]] = None
+) -> int:
     """One link of the chain: FNV-64a over the canonical-CBOR payload."""
-    return fnv64a(cbor_hash_payload(parent, tokens))
+    return fnv64a(cbor_hash_payload(parent, tokens, extra))
 
 
-def prefix_hashes(parent: int, token_chunks: Iterable[Sequence[int]]) -> List[int]:
+def prefix_hashes(
+    parent: int,
+    token_chunks: Iterable[Sequence[int]],
+    extra: Optional[Sequence[int]] = None,
+) -> List[int]:
     """Chained hashes for consecutive token chunks."""
     hashes: List[int] = []
     h = parent
     for chunk in token_chunks:
-        h = chunk_hash(h, chunk)
+        h = chunk_hash(h, chunk, extra)
         hashes.append(h)
     return hashes
 
@@ -99,15 +120,21 @@ except ImportError:
     _native = None
 
 
-def prefix_hashes_fast(parent: int, tokens: Sequence[int], block_size: int) -> List[int]:
+def prefix_hashes_fast(
+    parent: int,
+    tokens: Sequence[int],
+    block_size: int,
+    extra: Optional[Sequence[int]] = None,
+) -> List[int]:
     """Chunk `tokens` into full blocks of `block_size` and chain-hash them.
 
-    Uses the C extension when available; pure Python otherwise.
+    Uses the C extension when available (the common extra=None path);
+    pure Python otherwise.
     """
     n_full = len(tokens) // block_size
     if n_full == 0:
         return []
-    if _native is not None:
+    if _native is not None and extra is None:
         return list(_native.prefix_hashes(parent, list(tokens), block_size))
     chunks = [tokens[i * block_size:(i + 1) * block_size] for i in range(n_full)]
-    return prefix_hashes(parent, chunks)
+    return prefix_hashes(parent, chunks, extra)

@@ -51,8 +51,19 @@ class ChunkedTokenDatabase:
         parent_key: Optional[Key],
         tokens: Sequence[int],
         model_name: str,
+        lora_id: Optional[int] = None,
     ) -> List[Key]:
-        """Chain-hash full blocks of tokens into Keys; [] if no full block."""
+        """Chain-hash full blocks of tokens into Keys; [] if no full block.
+
+        `lora_id` mixes the adapter identity into every block hash (vLLM
+        "extra keys" semantics), so the same tokens served through different
+        LoRA adapters occupy distinct index entries. The reference parses the
+        event's LoraID but drops it (pool.go BlockStored handling; its LoRA
+        parity test is a skipped TODO) — here it is first-class.
+        """
         parent_hash = parent_key.chunk_hash if parent_key is not None else self._init_hash
-        hashes = hashing.prefix_hashes_fast(parent_hash, tokens, self.config.block_size)
+        extra = None if lora_id is None else [int(lora_id)]
+        hashes = hashing.prefix_hashes_fast(
+            parent_hash, tokens, self.config.block_size, extra
+        )
         return [Key(model_name, h) for h in hashes]

@@ -184,7 +184,7 @@ class EventPool:
             parent_request_key = self.index.get_request_key(parent_engine_key)
 
         request_keys = self.token_processor.tokens_to_kv_block_keys(
-            parent_request_key, ev.token_ids, model_name
+            parent_request_key, ev.token_ids, model_name, lora_id=ev.lora_id
         )
 
         if engine_keys:

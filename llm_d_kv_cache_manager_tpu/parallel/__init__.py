@@ -5,6 +5,10 @@ from llm_d_kv_cache_manager_tpu.parallel.mesh import (
 )
 from llm_d_kv_cache_manager_tpu.parallel.ring_attention import ring_attention
 from llm_d_kv_cache_manager_tpu.parallel.pipeline import pipeline_forward
+from llm_d_kv_cache_manager_tpu.parallel.multihost import (
+    initialize_distributed,
+    make_hybrid_mesh,
+)
 
 __all__ = [
     "make_mesh",
@@ -12,4 +16,6 @@ __all__ = [
     "shard_params",
     "ring_attention",
     "pipeline_forward",
+    "initialize_distributed",
+    "make_hybrid_mesh",
 ]

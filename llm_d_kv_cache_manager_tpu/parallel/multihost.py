@@ -1,0 +1,91 @@
+"""Multi-host (multi-slice) mesh construction: ICI inside, DCN across.
+
+The reference's distributed story is fleet-level (N independent engine pods
+over ZMQ/Redis — SURVEY.md §2.6); the TPU build adds the device-level story:
+scale one engine across hosts/slices with a hybrid mesh where the fast axes
+(tp/sp) stay inside a slice riding ICI and the outer axis (dp, or pp stages)
+crosses slices over DCN. XLA then places all-reduces per axis on the right
+fabric automatically — the "How to Scale Your Model" recipe.
+
+On a single process this degenerates gracefully (dcn axis size 1), so the
+same code path runs everywhere; under a real multi-host launch call
+`initialize_distributed()` first (one controller per host).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+_initialized = False
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize jax.distributed (idempotent; no-op when single-process
+    with no coordinator configured).
+
+    Must run before any JAX computation/backend use — so the guard is a
+    module flag, NOT jax.process_count() (which would itself initialize the
+    local backend and break the multi-host case this function exists for).
+    """
+    global _initialized
+    if _initialized:
+        return
+    if coordinator_address is None:
+        import os
+
+        coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if coordinator_address is None:
+        return  # single-host run
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+
+
+def make_hybrid_mesh(
+    ici_axes: dict,
+    dcn_axes: Optional[dict] = None,
+) -> Mesh:
+    """Build a mesh with `ici_axes` inside each slice and `dcn_axes` across
+    slices/hosts, e.g. make_hybrid_mesh({"tp": 4, "sp": 2}, {"dp": 2}).
+
+    Single-slice fallback: if only one host/slice is present, DCN axes of
+    size 1 are still materialized so downstream PartitionSpecs work
+    unchanged.
+    """
+    dcn_axes = dict(dcn_axes or {})
+    ici_axes = dict(ici_axes)
+    axis_names = tuple(dcn_axes) + tuple(ici_axes)
+    shape = tuple(dcn_axes.values()) + tuple(ici_axes.values())
+    n_needed = int(np.prod(shape)) if shape else 1
+
+    devices = jax.devices()
+    if len(devices) < n_needed:
+        raise ValueError(
+            f"hybrid mesh {dict(zip(axis_names, shape))} needs {n_needed} "
+            f"devices, have {len(devices)}"
+        )
+
+    if jax.process_count() > 1 and dcn_axes:
+        from jax.experimental import mesh_utils
+
+        grid = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=tuple(ici_axes.values()),
+            dcn_mesh_shape=tuple(dcn_axes.values()),
+        )
+        # create_hybrid_device_mesh returns shape dcn+ici already.
+        return Mesh(grid, axis_names)
+
+    grid = np.array(devices[:n_needed]).reshape(shape or (1,))
+    return Mesh(grid, axis_names or ("dp",))

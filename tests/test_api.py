@@ -72,6 +72,27 @@ class TestGrpc:
             server.stop(grace=0)
             indexer.shutdown()
 
+    def test_lora_scoped_scores_over_grpc(self):
+        indexer = _make_indexer()
+        # Seed under adapter 5 only.
+        enc = indexer.tokenizers_pool.tokenizer.encode(PROMPT, TEST_MODEL_NAME)
+        keys = indexer.token_processor.tokens_to_kv_block_keys(
+            None, enc.tokens, TEST_MODEL_NAME, lora_id=5
+        )
+        engine_keys = [Key(TEST_MODEL_NAME, 20_000 + i) for i in range(len(keys))]
+        indexer.kv_block_index.add(engine_keys, keys, [PodEntry("pod-lora", "hbm")])
+        port = _free_port()
+        server = serve_grpc(indexer, f"127.0.0.1:{port}")
+        try:
+            client = IndexerGrpcClient(f"127.0.0.1:{port}")
+            assert client.get_pod_scores(PROMPT, TEST_MODEL_NAME) == {}
+            scored = client.get_pod_scores(PROMPT, TEST_MODEL_NAME, lora_id=5)
+            assert scored.get("pod-lora") == float(len(keys))
+            client.close()
+        finally:
+            server.stop(grace=0)
+            indexer.shutdown()
+
     def test_unknown_model_maps_to_internal_error(self):
         import grpc
 
