@@ -117,7 +117,7 @@ class ScoringService:
         lora_id = body.get("lora_id")
         try:
             scores = await asyncio.to_thread(
-                self.indexer.get_pod_scores, prompt, model, pods, None, lora_id
+                self.indexer.get_pod_scores, prompt, model, pods, lora_id=lora_id
             )
         except Exception as e:  # noqa: BLE001
             return web.json_response({"error": str(e)}, status=500)
@@ -133,7 +133,11 @@ class ScoringService:
         try:
             rendered = await asyncio.to_thread(self.templating.render, render_request)
             scores = await asyncio.to_thread(
-                self.indexer.get_pod_scores, rendered, model, body.get("pods", [])
+                self.indexer.get_pod_scores,
+                rendered,
+                model,
+                body.get("pods", []),
+                lora_id=body.get("lora_id"),
             )
         except Exception as e:  # noqa: BLE001
             return web.json_response({"error": str(e)}, status=500)

@@ -139,6 +139,11 @@ class EventPool:
                 if msg is None:
                     return
                 self._process_event(msg)
+            except Exception as e:  # noqa: BLE001 - a worker must never die
+                logger.warning(
+                    "event processing failed (topic=%s): %s",
+                    getattr(msg, "topic", "?"), e,
+                )
             finally:
                 q.task_done()
 
@@ -183,8 +188,17 @@ class EventPool:
                 return
             parent_request_key = self.index.get_request_key(parent_engine_key)
 
+        # lora_id arrives off the untrusted wire: accept only non-negative
+        # ints, otherwise treat the event as non-LoRA rather than poisoning
+        # the hash chain (or the worker).
+        lora_id = ev.lora_id
+        if not isinstance(lora_id, int) or isinstance(lora_id, bool) or lora_id < 0:
+            if lora_id is not None:
+                logger.debug("ignoring invalid lora_id %r in BlockStored", lora_id)
+            lora_id = None
+
         request_keys = self.token_processor.tokens_to_kv_block_keys(
-            parent_request_key, ev.token_ids, model_name, lora_id=ev.lora_id
+            parent_request_key, ev.token_ids, model_name, lora_id=lora_id
         )
 
         if engine_keys:

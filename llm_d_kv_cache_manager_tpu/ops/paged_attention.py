@@ -69,16 +69,17 @@ def _decode_kernel(
     block_tables_ref,  # SMEM [batch, pages_per_seq]
     seq_lens_ref,  # SMEM [batch]
     q_ref,  # VMEM (1, 1, GROUP_PAD, head_dim)
-    k_ref,  # VMEM (1, 1, page_size, head_dim) - this (b,h,i)'s page
-    v_ref,  # VMEM (1, 1, page_size, head_dim)
-    o_ref,  # VMEM (1, 1, GROUP_PAD, head_dim)
-    m_scratch,  # VMEM (GROUP_PAD, 128) f32
-    l_scratch,  # VMEM (GROUP_PAD, 128) f32
-    acc_scratch,  # VMEM (GROUP_PAD, head_dim) f32
-    *,
+    *rest,  # K/V page refs (+ scale refs when quantized), o_ref, scratch
     page_size: int,
     scale: float,
+    quantized: bool,
 ):
+    """Shared flash-decoding body for bf16 and int8-quantized KV pages."""
+    if quantized:
+        kq_ref, ks_ref, vq_ref, vs_ref, o_ref, m_scratch, l_scratch, acc_scratch = rest
+    else:
+        k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch = rest
+
     b = pl.program_id(0)
     i = pl.program_id(2)
     seq_len = seq_lens_ref[b]
@@ -96,8 +97,13 @@ def _decode_kernel(
     @pl.when(start < seq_len)
     def _attend():
         q = q_ref[0, 0].astype(jnp.float32)  # (GROUP_PAD, hd)
-        k = k_ref[0, 0].astype(jnp.float32)  # (page, hd)
-        v = v_ref[0, 0].astype(jnp.float32)
+        if quantized:
+            # Dequantize in VMEM: int8 page * per-row scale (page, 1).
+            k = kq_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0]
+            v = vq_ref[0, 0].astype(jnp.float32) * vs_ref[0, 0]
+        else:
+            k = k_ref[0, 0].astype(jnp.float32)  # (page, hd)
+            v = v_ref[0, 0].astype(jnp.float32)
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -127,18 +133,19 @@ def _decode_kernel(
                            ).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def paged_attention(
-    q: jax.Array,  # [batch, n_q_heads, head_dim]
-    k_pages: jax.Array,  # [n_kv_heads, n_pages, page_size, head_dim]
-    v_pages: jax.Array,
-    block_tables: jax.Array,  # [batch, pages_per_seq] int32
-    seq_lens: jax.Array,  # [batch] int32
+def _paged_attention_call(
+    q: jax.Array,
+    kv_arrays,  # (k, v) or (k_q, k_scale, v_q, v_scale)
+    block_tables: jax.Array,
+    seq_lens: jax.Array,
     *,
-    interpret: bool = False,
+    n_kv_heads: int,
+    page_size: int,
+    head_dim: int,
+    quantized: bool,
+    interpret: bool,
 ) -> jax.Array:
-    """Flash-decoding paged attention (Pallas TPU kernel)."""
-    n_kv_heads, _n_pages, page_size, head_dim = k_pages.shape
+    """Shared pallas_call wiring for both KV storage formats."""
     batch, n_q_heads, _ = q.shape
     group = n_q_heads // n_kv_heads
     if group * n_kv_heads != n_q_heads:
@@ -152,32 +159,31 @@ def paged_attention(
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, _GROUP_PAD - group), (0, 0)))
     group_pad = qg.shape[2]
 
-    grid = (batch, n_kv_heads, pages_per_seq)
-    kernel = functools.partial(_decode_kernel, page_size=page_size, scale=scale)
+    q_spec = pl.BlockSpec(
+        (1, 1, group_pad, head_dim), lambda b, h, i, bt, sl: (b, h, 0, 0)
+    )
+    page_spec = pl.BlockSpec(
+        (1, 1, page_size, head_dim), lambda b, h, i, bt, sl: (h, bt[b, i], 0, 0)
+    )
+    scale_spec = pl.BlockSpec(
+        (1, 1, page_size, 1), lambda b, h, i, bt, sl: (h, bt[b, i], 0, 0)
+    )
+    kv_specs = (
+        [page_spec, scale_spec, page_spec, scale_spec]
+        if quantized
+        else [page_spec, page_spec]
+    )
+    kernel = functools.partial(
+        _decode_kernel, page_size=page_size, scale=scale, quantized=quantized
+    )
 
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec(
-                    (1, 1, group_pad, head_dim),
-                    lambda b, h, i, bt, sl: (b, h, 0, 0),
-                ),
-                pl.BlockSpec(
-                    (1, 1, page_size, head_dim),
-                    lambda b, h, i, bt, sl: (h, bt[b, i], 0, 0),
-                ),
-                pl.BlockSpec(
-                    (1, 1, page_size, head_dim),
-                    lambda b, h, i, bt, sl: (h, bt[b, i], 0, 0),
-                ),
-            ],
-            out_specs=pl.BlockSpec(
-                (1, 1, group_pad, head_dim),
-                lambda b, h, i, bt, sl: (b, h, 0, 0),
-            ),
+            grid=(batch, n_kv_heads, pages_per_seq),
+            in_specs=[q_spec] + kv_specs,
+            out_specs=q_spec,
             scratch_shapes=[
                 pltpu.VMEM((group_pad, 128), jnp.float32),
                 pltpu.VMEM((group_pad, 128), jnp.float32),
@@ -187,10 +193,40 @@ def paged_attention(
         out_shape=jax.ShapeDtypeStruct(
             (batch, n_kv_heads, group_pad, head_dim), q.dtype
         ),
+        compiler_params=pltpu.CompilerParams(
+            # (batch, head) grid dims are independent; only the page dim
+            # carries the online-softmax accumulator and must stay serial.
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
-    )(block_tables, seq_lens, qg, k_pages, v_pages)
+    )(block_tables, seq_lens, qg, *kv_arrays)
 
     return out[:, :, :group, :].reshape(batch, n_q_heads, head_dim)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_attention(
+    q: jax.Array,  # [batch, n_q_heads, head_dim]
+    k_pages: jax.Array,  # [n_kv_heads, n_pages, page_size, head_dim]
+    v_pages: jax.Array,
+    block_tables: jax.Array,  # [batch, pages_per_seq] int32
+    seq_lens: jax.Array,  # [batch] int32
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash-decoding paged attention (Pallas TPU kernel)."""
+    n_kv_heads, _n_pages, page_size, head_dim = k_pages.shape
+    return _paged_attention_call(
+        q,
+        (k_pages, v_pages),
+        block_tables,
+        seq_lens,
+        n_kv_heads=n_kv_heads,
+        page_size=page_size,
+        head_dim=head_dim,
+        quantized=False,
+        interpret=interpret,
+    )
 
 
 def write_kv_pages(

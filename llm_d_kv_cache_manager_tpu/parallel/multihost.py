@@ -28,9 +28,17 @@ def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    auto: bool = False,
 ) -> None:
-    """Initialize jax.distributed (idempotent; no-op when single-process
-    with no coordinator configured).
+    """Initialize jax.distributed (idempotent).
+
+    Three modes:
+    - explicit: pass coordinator_address (and peers) directly;
+    - env: JAX_COORDINATOR_ADDRESS set by the launcher;
+    - auto: `auto=True` or KVTPU_DISTRIBUTED_AUTO=1 calls the argument-less
+      `jax.distributed.initialize()`, which auto-detects the coordinator
+      from TPU pod metadata — the standard Cloud TPU multi-host recipe.
+    With none of these, it is a single-host no-op.
 
     Must run before any JAX computation/backend use — so the guard is a
     module flag, NOT jax.process_count() (which would itself initialize the
@@ -39,11 +47,14 @@ def initialize_distributed(
     global _initialized
     if _initialized:
         return
-    if coordinator_address is None:
-        import os
+    import os
 
+    if coordinator_address is None:
         coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
     if coordinator_address is None:
+        if auto or os.environ.get("KVTPU_DISTRIBUTED_AUTO") == "1":
+            jax.distributed.initialize()  # TPU-metadata auto-detection
+            _initialized = True
         return  # single-host run
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
