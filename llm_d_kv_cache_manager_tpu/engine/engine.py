@@ -43,6 +43,12 @@ class EnginePodConfig:
     max_pages_per_seq: int = 32
     with_model: bool = False
     model_config: Optional[object] = None  # models.llama.LlamaConfig
+    # int8 KV pages: half the HBM per cached token -> double the prefixes a
+    # pod can keep resident (ops/quantized_kv.py).
+    use_quantized_kv: bool = False
+    # Decode through the Pallas flash-decoding kernel (True on TPU; the jnp
+    # oracle path works on any backend and is the test default).
+    use_kernel: bool = False
 
 
 class EnginePod:
@@ -83,9 +89,14 @@ class EnginePod:
             self.params = params if params is not None else llama.init_params(
                 mc, jax.random.PRNGKey(0)
             )
-            self.k_pages, self.v_pages = llama.make_kv_pages(
-                mc, config.n_pages, config.page_size
-            )
+            if config.use_quantized_kv:
+                self.kv_cache = llama.make_kv_pages_quantized(
+                    mc, config.n_pages, config.page_size
+                )
+            else:
+                self.kv_cache = llama.make_kv_pages(
+                    mc, config.n_pages, config.page_size
+                )
             self._jnp = jnp
 
     # -- events --------------------------------------------------------------
@@ -112,11 +123,10 @@ class EnginePod:
             jnp = self._jnp
             block_table = self._padded_table(state)
             new_tokens = jnp.asarray(tokens[n_cached:], dtype=jnp.int32)
-            self.k_pages, self.v_pages, self.last_logits = self._model.prefill(
+            self.kv_cache, self.last_logits = self._model.prefill_cache(
                 self._model_config,
                 self.params,
-                self.k_pages,
-                self.v_pages,
+                self.kv_cache,
                 new_tokens,
                 block_table,
                 n_cached,
@@ -138,14 +148,14 @@ class EnginePod:
         last_token = jnp.asarray([state.tokens[-1]], dtype=jnp.int32)
         # The last token's K/V were already written by prefill/previous step;
         # decode_step writes at seq_lens, so pass position of the new token.
-        self.k_pages, self.v_pages, logits = self._model.decode_step(
+        self.kv_cache, logits = self._model.decode_step_cache(
             self._model_config,
             self.params,
-            self.k_pages,
-            self.v_pages,
+            self.kv_cache,
             last_token,
             self._padded_table(state)[None],
             jnp.asarray([pos], dtype=jnp.int32),
+            self.config.use_kernel,
         )
         token = int(jnp.argmax(logits[0]))
         self.block_manager.append_token(state, token)

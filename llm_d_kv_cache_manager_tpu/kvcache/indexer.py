@@ -114,6 +114,14 @@ class Indexer:
         {pod_identifier: score}; pods without hits are absent. `lora_id`
         scopes the lookup to blocks cached under that adapter.
         """
+        # Same validation as the event-ingest side (kvevents/pool.py): an
+        # invalid adapter id degrades to the base keyspace rather than
+        # hashing into a keyspace no event can ever populate.
+        if not isinstance(lora_id, int) or isinstance(lora_id, bool) or lora_id < 0:
+            if lora_id is not None:
+                kvlog.trace(logger, "ignoring invalid lora_id %r", lora_id)
+            lora_id = None
+
         tokens = self.tokenizers_pool.tokenize(render_request, prompt, model_name)
 
         block_keys = self.token_processor.tokens_to_kv_block_keys(

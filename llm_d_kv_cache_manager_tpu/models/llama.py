@@ -186,6 +186,11 @@ def train_step(
 # ---------------------------------------------------------------------------
 # Paged-cache serving paths
 # ---------------------------------------------------------------------------
+#
+# The cache is either a (k, v) pair of bf16 page pools or an int8-quantized
+# (k_q, k_scale, v_q, v_scale) quadruple (ops/quantized_kv.py). The helpers
+# below dispatch on tuple arity at trace time, so prefill/decode are format-
+# agnostic; the int8 format halves KV HBM, doubling cacheable prefixes.
 
 
 def make_kv_pages(
@@ -197,28 +202,87 @@ def make_kv_pages(
     return jnp.zeros(shape, c.dtype), jnp.zeros(shape, c.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("config",), donate_argnums=(2, 3))
-def prefill(
+def make_kv_pages_quantized(config: LlamaConfig, n_pages: int, page_size: int):
+    """Per-layer int8 pools: (k_q, k_scale, v_q, v_scale), layer-stacked."""
+    c = config
+    q_shape = (c.n_layers, c.n_kv_heads, n_pages, page_size, c.head_dim)
+    s_shape = (c.n_layers, c.n_kv_heads, n_pages, page_size, 1)
+    return (
+        jnp.zeros(q_shape, jnp.int8), jnp.zeros(s_shape, jnp.float32),
+        jnp.zeros(q_shape, jnp.int8), jnp.zeros(s_shape, jnp.float32),
+    )
+
+
+def _cache_write(cache: tuple, block_table, k_new, v_new, start_pos) -> tuple:
+    """Write one layer's new K/V rows into its (bf16 or int8) page slice."""
+    if len(cache) == 2:
+        return write_kv_pages(cache[0], cache[1], block_table, k_new, v_new, start_pos)
+    from llm_d_kv_cache_manager_tpu.ops.quantized_kv import (
+        write_kv_pages_quantized,
+    )
+
+    return write_kv_pages_quantized(*cache, block_table, k_new, v_new, start_pos)
+
+
+def _cache_gather_dense(cache: tuple, block_table, dtype):
+    """Materialize one layer's cached K/V for a block table (prefill path).
+
+    Gathers the referenced pages FIRST, then dequantizes only those — never
+    the whole pool. Returns (k_all, v_all): [1, max_ctx, n_kv, hd]."""
+    if len(cache) == 2:
+        k_gathered = cache[0][:, block_table]  # [n_kv, pages, page, hd]
+        v_gathered = cache[1][:, block_table]
+    else:
+        k_q, k_s, v_q, v_s = cache
+        k_gathered = (
+            k_q[:, block_table].astype(jnp.float32) * k_s[:, block_table]
+        ).astype(dtype)
+        v_gathered = (
+            v_q[:, block_table].astype(jnp.float32) * v_s[:, block_table]
+        ).astype(dtype)
+    n_kv, n_pages_seq, page_size, head_dim = k_gathered.shape
+    max_ctx = n_pages_seq * page_size
+    k_all = k_gathered.reshape(n_kv, max_ctx, head_dim)
+    v_all = v_gathered.reshape(n_kv, max_ctx, head_dim)
+    return jnp.swapaxes(k_all, 0, 1)[None], jnp.swapaxes(v_all, 0, 1)[None]
+
+
+def _cache_attend(cache: tuple, q, block_tables, seq_lens, use_kernel: bool):
+    """Batched decode attention over one layer's cache slice."""
+    if len(cache) == 2:
+        attend = paged_attention if use_kernel else paged_attention_reference
+        return attend(q, cache[0], cache[1], block_tables, seq_lens)
+    from llm_d_kv_cache_manager_tpu.ops.quantized_kv import (
+        paged_attention_quantized,
+        paged_attention_quantized_reference,
+    )
+
+    attend = (
+        paged_attention_quantized if use_kernel
+        else paged_attention_quantized_reference
+    )
+    return attend(q, *cache, block_tables, seq_lens)
+
+
+@functools.partial(jax.jit, static_argnames=("config",), donate_argnums=(2,))
+def prefill_cache(
     config: LlamaConfig,
     params: Params,
-    k_pages: jax.Array,  # [n_layers, n_kv, n_pages, page, hd]
-    v_pages: jax.Array,
+    kv_cache: tuple,  # bf16 (k, v) or int8 (k_q, k_s, v_q, v_s), layer-stacked
     tokens: jax.Array,  # [L] one sequence's NEW (non-cached) tokens
     block_table: jax.Array,  # [pages_per_seq] int32
     start_pos,  # int32: number of already-cached tokens (prefix-cache hit)
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+) -> Tuple[tuple, jax.Array]:
     """Prefill new tokens, attending to the cached prefix; returns
-    (k_pages, v_pages, last_token_logits)."""
+    (kv_cache, last_token_logits)."""
     c = config
-    page_size = k_pages.shape[3]
     l = tokens.shape[0]
     x = params["embed"][tokens][None]  # [1, L, d]
     positions = (start_pos + jnp.arange(l))[None]  # [1, L]
-    max_ctx = block_table.shape[0] * page_size
 
     def layer_fn(carry, inputs):
         x, = carry
-        layer, kp, vp = inputs
+        layer, cache = inputs[0], inputs[1:]
         h = rms_norm(x, layer["attn_norm"], c.rms_eps)
         q = (h @ layer["wq"]).reshape(1, l, c.n_q_heads, c.head_dim)
         k = (h @ layer["wk"]).reshape(1, l, c.n_kv_heads, c.head_dim)
@@ -226,52 +290,51 @@ def prefill(
         q = _rope(q, positions, c.rope_theta)
         k = _rope(k, positions, c.rope_theta)
 
-        kp, vp = write_kv_pages(kp, vp, block_table, k[0], v[0], start_pos)
+        cache = _cache_write(cache, block_table, k[0], v[0], start_pos)
 
         # Attend to everything cached so far (prefix + new), causally.
-        k_all = kp[:, block_table].reshape(c.n_kv_heads, max_ctx, c.head_dim)
-        v_all = vp[:, block_table].reshape(c.n_kv_heads, max_ctx, c.head_dim)
-        k_all = jnp.swapaxes(k_all, 0, 1)[None]  # [1, max_ctx, n_kv, hd]
-        v_all = jnp.swapaxes(v_all, 0, 1)[None]
+        k_all, v_all = _cache_gather_dense(cache, block_table, c.dtype)
         attn = _dense_attention(q, k_all, v_all, start_pos)
         x = x + attn.reshape(1, l, c.q_dim) @ layer["wo"]
         h = rms_norm(x, layer["mlp_norm"], c.rms_eps)
         x = x + _mlp(layer, h)
-        return (x,), (kp, vp)
+        return (x,), cache
 
-    (x,), (k_pages, v_pages) = jax.lax.scan(
-        layer_fn, (x,), (params["layers"], k_pages, v_pages)
+    (x,), kv_cache = jax.lax.scan(
+        layer_fn, (x,), (params["layers"],) + tuple(kv_cache)
     )
     x = rms_norm(x, params["final_norm"], c.rms_eps)
     logits = x[:, -1] @ params["out"]  # [1, vocab]
-    return k_pages, v_pages, logits[0]
+    return kv_cache, logits[0]
 
 
 @functools.partial(
-    jax.jit, static_argnames=("config", "use_kernel"), donate_argnums=(2, 3)
+    jax.jit, static_argnames=("config", "use_kernel"), donate_argnums=(2,)
 )
-def decode_step(
+def decode_step_cache(
     config: LlamaConfig,
     params: Params,
-    k_pages: jax.Array,  # [n_layers, n_kv, n_pages, page, hd]
-    v_pages: jax.Array,
+    kv_cache: tuple,
     tokens: jax.Array,  # [B] current token per sequence
     block_tables: jax.Array,  # [B, pages_per_seq]
     seq_lens: jax.Array,  # [B] tokens already cached (position of new token)
     use_kernel: bool = False,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """One batched decode step; returns (k_pages, v_pages, logits [B, vocab])."""
+) -> Tuple[tuple, jax.Array]:
+    """One batched decode step; returns (kv_cache, logits [B, vocab])."""
     c = config
-    page_size = k_pages.shape[3]
+    page_size = kv_cache[0].shape[3]
     b = tokens.shape[0]
     x = params["embed"][tokens][:, None]  # [B, 1, d]
     positions = seq_lens[:, None]  # [B, 1]
 
-    attend = paged_attention if use_kernel else paged_attention_reference
+    page_ids = jnp.take_along_axis(
+        block_tables, (seq_lens // page_size)[:, None], axis=1
+    )[:, 0]
+    slots = seq_lens % page_size
 
     def layer_fn(carry, inputs):
         x, = carry
-        layer, kp, vp = inputs
+        layer, cache = inputs[0], inputs[1:]
         h = rms_norm(x, layer["attn_norm"], c.rms_eps)
         q = (h @ layer["wq"]).reshape(b, 1, c.n_q_heads, c.head_dim)
         k = (h @ layer["wk"]).reshape(b, 1, c.n_kv_heads, c.head_dim)
@@ -279,22 +342,66 @@ def decode_step(
         q = _rope(q, positions, c.rope_theta)
         k = _rope(k, positions, c.rope_theta)
 
-        # Scatter each sequence's new K/V row into its page.
-        page_ids = jnp.take_along_axis(
-            block_tables, (seq_lens // page_size)[:, None], axis=1
-        )[:, 0]
-        slots = seq_lens % page_size
-        kp = kp.at[:, page_ids, slots, :].set(jnp.swapaxes(k[:, 0], 0, 1))
-        vp = vp.at[:, page_ids, slots, :].set(jnp.swapaxes(v[:, 0], 0, 1))
+        # Scatter each sequence's new K/V row into its page (per format).
+        if len(cache) == 2:
+            kp, vp = cache
+            kp = kp.at[:, page_ids, slots, :].set(jnp.swapaxes(k[:, 0], 0, 1))
+            vp = vp.at[:, page_ids, slots, :].set(jnp.swapaxes(v[:, 0], 0, 1))
+            cache = (kp, vp)
+        else:
+            from llm_d_kv_cache_manager_tpu.ops.quantized_kv import quantize_rows
 
-        attn = attend(q[:, 0], kp, vp, block_tables, seq_lens + 1)
+            kq, ks, vq, vs = cache
+            k_rows, k_s = quantize_rows(jnp.swapaxes(k[:, 0], 0, 1))
+            v_rows, v_s = quantize_rows(jnp.swapaxes(v[:, 0], 0, 1))
+            kq = kq.at[:, page_ids, slots, :].set(k_rows)
+            ks = ks.at[:, page_ids, slots, 0].set(k_s)
+            vq = vq.at[:, page_ids, slots, :].set(v_rows)
+            vs = vs.at[:, page_ids, slots, 0].set(v_s)
+            cache = (kq, ks, vq, vs)
+
+        attn = _cache_attend(cache, q[:, 0], block_tables, seq_lens + 1, use_kernel)
         x = x + attn.reshape(b, 1, c.q_dim) @ layer["wo"]
         h = rms_norm(x, layer["mlp_norm"], c.rms_eps)
         x = x + _mlp(layer, h)
-        return (x,), (kp, vp)
+        return (x,), cache
 
-    (x,), (k_pages, v_pages) = jax.lax.scan(
-        layer_fn, (x,), (params["layers"], k_pages, v_pages)
+    (x,), kv_cache = jax.lax.scan(
+        layer_fn, (x,), (params["layers"],) + tuple(kv_cache)
     )
     x = rms_norm(x, params["final_norm"], c.rms_eps)
-    return k_pages, v_pages, (x[:, 0] @ params["out"])
+    return kv_cache, (x[:, 0] @ params["out"])
+
+
+def prefill(
+    config: LlamaConfig,
+    params: Params,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    tokens: jax.Array,
+    block_table: jax.Array,
+    start_pos,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """bf16-cache convenience wrapper over prefill_cache."""
+    (k_pages, v_pages), logits = prefill_cache(
+        config, params, (k_pages, v_pages), tokens, block_table, start_pos
+    )
+    return k_pages, v_pages, logits
+
+
+def decode_step(
+    config: LlamaConfig,
+    params: Params,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    tokens: jax.Array,
+    block_tables: jax.Array,
+    seq_lens: jax.Array,
+    use_kernel: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """bf16-cache convenience wrapper over decode_step_cache."""
+    (k_pages, v_pages), logits = decode_step_cache(
+        config, params, (k_pages, v_pages), tokens, block_tables, seq_lens,
+        use_kernel,
+    )
+    return k_pages, v_pages, logits

@@ -1,0 +1,82 @@
+"""JSON config round-trip tests (reference: JSON-config-driven deployments)."""
+
+import json
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.config import (
+    config_to_json,
+    indexer_config_from_json,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import IndexerConfig
+
+
+class TestConfigJSON:
+    def test_defaults_round_trip(self):
+        config = IndexerConfig()
+        payload = config_to_json(config)
+        restored = indexer_config_from_json(payload)
+        assert restored == config
+
+    def test_partial_override(self):
+        payload = json.dumps({
+            "token_processor_config": {"block_size": 64, "hash_seed": "42"},
+            "prefix_store_config": {"block_size_bytes": 512},
+        })
+        config = indexer_config_from_json(payload)
+        assert config.token_processor_config.block_size == 64
+        assert config.token_processor_config.hash_seed == "42"
+        assert config.prefix_store_config.block_size_bytes == 512
+        # Untouched sections keep defaults.
+        assert config.tokenizers_pool_config.workers == 5
+
+    def test_backend_configs_list(self):
+        payload = json.dumps({
+            "backend_configs": [
+                {"name": "hbm", "weight": 1.0},
+                {"name": "host", "weight": 0.5},
+            ]
+        })
+        config = indexer_config_from_json(payload)
+        assert config.backend_configs[1].weight == 0.5
+
+    def test_nested_index_backend_selection(self):
+        payload = json.dumps({
+            "kv_block_index_config": {
+                "in_memory_config": None,
+                "cost_aware_config": {"max_size_bytes": "64MiB"},
+            }
+        })
+        config = indexer_config_from_json(payload)
+        assert config.kv_block_index_config.in_memory_config is None
+        assert config.kv_block_index_config.cost_aware_config.max_size_bytes == "64MiB"
+
+    def test_unknown_key_errors_loudly(self):
+        with pytest.raises(ValueError, match="blocksize"):
+            indexer_config_from_json(
+                json.dumps({"token_processor_config": {"blocksize": 64}})
+            )
+
+    def test_built_config_works(self):
+        from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer
+        from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+            TokenizationPool,
+            TokenizersPoolConfig,
+        )
+        from tests.conftest import TEST_MODEL_NAME, TEST_TOKENIZER_JSON
+
+        config = indexer_config_from_json(
+            json.dumps({"token_processor_config": {"block_size": 4}})
+        )
+        indexer = Indexer(
+            config=config,
+            tokenization_pool=TokenizationPool(
+                TokenizersPoolConfig(
+                    workers=1,
+                    local_tokenizer_files={TEST_MODEL_NAME: TEST_TOKENIZER_JSON},
+                )
+            ),
+        )
+        indexer.run()
+        assert indexer.get_pod_scores("hello world test", TEST_MODEL_NAME, []) == {}
+        indexer.shutdown()

@@ -215,6 +215,32 @@ class TestHashParityKeystone:
 
 
 class TestEnginePodWithModel:
+    def test_quantized_kv_generation_close_to_bf16(self):
+        from llm_d_kv_cache_manager_tpu.models.llama import LlamaConfig
+
+        cfg = LlamaConfig(
+            vocab_size=128, d_model=32, n_layers=1, n_q_heads=2, n_kv_heads=2,
+            head_dim=16, d_ff=64, dtype=jnp.float32,
+        )
+        prompt = list(range(10))
+
+        def run(use_quant):
+            pod = EnginePod(
+                EnginePodConfig(
+                    n_pages=32, page_size=4, with_model=True, model_config=cfg,
+                    max_pages_per_seq=16, use_quantized_kv=use_quant,
+                )
+            )
+            state, _ = pod.prefill(prompt)
+            logits = np.asarray(pod.last_logits)
+            pod.free(state)
+            return logits
+
+        full = run(False)
+        quant = run(True)
+        # int8 KV introduces ~1% error but must not change the distribution.
+        assert np.max(np.abs(full - quant)) < 0.15 * max(np.max(np.abs(full)), 1.0)
+
     def test_generation_with_prefix_reuse(self):
         from llm_d_kv_cache_manager_tpu.models.llama import LlamaConfig
 

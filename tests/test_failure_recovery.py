@@ -1,0 +1,71 @@
+"""Failure-detection / recovery behaviors (SURVEY.md §5 parity).
+
+The reference's resilience story: ZMQ subscriber reconnects forever at a
+fixed interval (zmq_subscriber.go:55-77), poison events are dropped without
+killing workers, UDS clients retry with backoff. The pool/UDS cases are
+covered in their own suites; this file exercises the subscriber's
+bind-retry loop with a real contended endpoint.
+"""
+
+import time
+import uuid
+
+import pytest
+import zmq
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+    InMemoryIndex,
+    InMemoryIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvevents import zmq_subscriber as sub_mod
+from llm_d_kv_cache_manager_tpu.kvevents.events import BlockStored, EventBatch
+from llm_d_kv_cache_manager_tpu.kvevents.pool import EventPool, EventPoolConfig
+from llm_d_kv_cache_manager_tpu.kvevents.publisher import Publisher, make_topic
+
+
+def _wait(predicate, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_subscriber_retries_until_endpoint_frees(tmp_path, monkeypatch):
+    monkeypatch.setattr(sub_mod, "RETRY_INTERVAL_S", 0.2)
+    endpoint = f"ipc://{tmp_path}/contended-{uuid.uuid4().hex[:6]}.sock"
+
+    # Occupy the endpoint so the subscriber's bind fails.
+    ctx = zmq.Context.instance()
+    squatter = ctx.socket(zmq.SUB)
+    squatter.bind(endpoint)
+
+    index = InMemoryIndex(InMemoryIndexConfig(size=100, pod_cache_size=4))
+    processor = ChunkedTokenDatabase(TokenProcessorConfig(block_size=4))
+    pool = EventPool(
+        EventPoolConfig(zmq_endpoint=endpoint, concurrency=1), index, processor
+    )
+    pool.start(with_subscriber=True)
+    try:
+        time.sleep(0.5)  # a few failed bind attempts
+        squatter.close(linger=0)  # free the endpoint; next retry succeeds
+
+        publisher = Publisher(endpoint, make_topic("pod-r", "m"))
+        tokens = [1, 2, 3, 4]
+        keys = processor.tokens_to_kv_block_keys(None, tokens, "m")
+
+        def published_and_indexed():
+            publisher.publish(
+                EventBatch(ts=time.time(), events=[BlockStored([9], None, tokens, 4)])
+            )
+            return len(index.lookup(keys, set())) == 1
+
+        assert _wait(published_and_indexed), "subscriber never recovered the endpoint"
+        publisher.close()
+    finally:
+        pool.shutdown()
