@@ -3,5 +3,13 @@ from llm_d_kv_cache_manager_tpu.engine.block_manager import (
     BlockManagerConfig,
 )
 from llm_d_kv_cache_manager_tpu.engine.engine import EnginePod, EnginePodConfig
+from llm_d_kv_cache_manager_tpu.engine.scheduler import Request, Scheduler
 
-__all__ = ["BlockManager", "BlockManagerConfig", "EnginePod", "EnginePodConfig"]
+__all__ = [
+    "BlockManager",
+    "BlockManagerConfig",
+    "EnginePod",
+    "EnginePodConfig",
+    "Request",
+    "Scheduler",
+]

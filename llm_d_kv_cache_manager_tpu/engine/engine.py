@@ -109,10 +109,12 @@ class EnginePod:
 
     # -- serving -------------------------------------------------------------
 
-    def prefill(self, tokens: List[int]) -> Tuple[SequenceState, int]:
+    def prefill(
+        self, tokens: List[int], lora_id: Optional[int] = None
+    ) -> Tuple[SequenceState, int]:
         """Admit a sequence: allocate (with prefix reuse), compute the
         uncached suffix, commit pages + events. Returns (state, cached_tokens)."""
-        state = self.block_manager.allocate(tokens)
+        state = self.block_manager.allocate(tokens, lora_id=lora_id)
         n_cached = state.num_cached_tokens
         if n_cached >= len(tokens):
             # Fully cached (modulo partial tail): recompute only the last
@@ -170,21 +172,24 @@ class EnginePod:
 
     # -- helpers -------------------------------------------------------------
 
-    def _padded_table(self, state: SequenceState):
-        if len(state.block_table) > self.config.max_pages_per_seq:
+    def table_bucket(self, n_pages_needed: int) -> int:
+        """Padded block-table width: next power of two covering the need, so
+        short prompts don't pay attention compute over the maximal static
+        shape; jit specializes per bucket. Single source of truth for both
+        single-sequence and scheduler-batched decode shapes."""
+        if n_pages_needed > self.config.max_pages_per_seq:
             raise ValueError(
-                f"sequence needs {len(state.block_table)} pages > "
+                f"sequence needs {n_pages_needed} pages > "
                 f"max_pages_per_seq={self.config.max_pages_per_seq}; truncating "
                 "would silently corrupt K/V pages"
             )
-        # Bucket the padded length (next power of two covering the need) so
-        # short prompts don't pay attention compute over the maximal static
-        # shape; jit specializes per bucket.
-        need = max(len(state.block_table), 1)
         bucket = 1
-        while bucket < need:
+        while bucket < max(n_pages_needed, 1):
             bucket *= 2
-        bucket = min(bucket, self.config.max_pages_per_seq)
+        return min(bucket, self.config.max_pages_per_seq)
+
+    def _padded_table(self, state: SequenceState):
+        bucket = self.table_bucket(len(state.block_table))
         jnp_or_np = self._jnp if self._model is not None else np
         table = np.zeros((bucket,), dtype=np.int32)
         table[: len(state.block_table)] = state.block_table

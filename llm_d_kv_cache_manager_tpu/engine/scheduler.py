@@ -1,0 +1,219 @@
+"""Continuous-batching scheduler for EnginePod.
+
+The serving loop a vLLM-style engine runs: a waiting queue admits sequences
+as pages free up (prefill one sequence per step — prefill is the serialized
+resource), while all running sequences decode together in one batched
+`decode_step_cache` call per tick. Per-sequence block tables are padded to a
+shared bucket (EnginePod.table_bucket) so the batch has one static shape per
+(batch-size, bucket) pair — a handful of jit specializations, no dynamic
+shapes.
+
+Capacity policy:
+- `submit` rejects deterministically (empty result, `Request.error` set) any
+  request whose prompt + max_new_tokens can never fit the pool or the
+  per-sequence page cap — no stall heuristics.
+- Decode-time page exhaustion preempts a sequence by recompute (vLLM-style):
+  its pages are freed (staying prefix-cached), the request rejoins the
+  waiting queue with its generated tokens folded into the prompt, and the
+  re-prefill mostly hits the cache.
+
+Greedy decoding; sequences finish on max_new_tokens or EOS.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from llm_d_kv_cache_manager_tpu.engine.block_manager import (
+    OutOfPagesError,
+    SequenceState,
+)
+from llm_d_kv_cache_manager_tpu.engine.engine import EnginePod
+
+
+@dataclass
+class Request:
+    req_id: int
+    prompt_tokens: List[int]
+    max_new_tokens: int
+    eos_token: Optional[int] = None
+    lora_id: Optional[int] = None
+    # Filled by the scheduler:
+    state: Optional[SequenceState] = None
+    generated: List[int] = field(default_factory=list)
+    num_cached_tokens: int = 0
+    finished: bool = False
+    error: Optional[str] = None
+
+
+class Scheduler:
+    def __init__(self, pod: EnginePod, max_batch: int = 8):
+        if pod._model is None:
+            raise ValueError("Scheduler requires an EnginePod with with_model=True")
+        self.pod = pod
+        self.max_batch = max_batch
+        self._waiting: deque = deque()
+        self._running: List[Request] = []
+        self._rejected: List[Request] = []
+        self._next_id = 0
+
+    # -- API -----------------------------------------------------------------
+
+    def submit(
+        self,
+        prompt_tokens: List[int],
+        max_new_tokens: int = 16,
+        eos_token: Optional[int] = None,
+        lora_id: Optional[int] = None,
+    ) -> int:
+        req = Request(self._next_id, list(prompt_tokens), max_new_tokens,
+                      eos_token, lora_id)
+        self._next_id += 1
+
+        error = self._validate(req)
+        if error is not None:
+            req.finished = True
+            req.error = error
+            self._rejected.append(req)
+        else:
+            self._waiting.append(req)
+        return req.req_id
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._waiting or self._running or self._rejected)
+
+    def step(self) -> List[Request]:
+        """One scheduler tick: surface rejections, admit (prefill) at most
+        one sequence, then one batched decode across running sequences.
+        Returns newly finished requests (pages freed; cache stays warm)."""
+        finished, self._rejected = self._rejected, []
+        finished += self._admit()
+        finished += self._decode()
+        return finished
+
+    def run(self) -> Dict[int, List[int]]:
+        """Drain everything; returns {req_id: generated_tokens} (empty list
+        for rejected requests — see Request.error on the returned objects of
+        step() for the reason)."""
+        results: Dict[int, List[int]] = {}
+        while self.has_work:
+            for req in self.step():
+                results[req.req_id] = req.generated
+        return results
+
+    # -- internals -------------------------------------------------------------
+
+    def _validate(self, req: Request) -> Optional[str]:
+        if req.max_new_tokens < 1:
+            return f"max_new_tokens must be >= 1, got {req.max_new_tokens}"
+        page_size = self.pod.config.page_size
+        total_tokens = len(req.prompt_tokens) + req.max_new_tokens
+        pages_needed = (total_tokens + page_size - 1) // page_size
+        if pages_needed > self.pod.config.max_pages_per_seq:
+            return (
+                f"request needs {pages_needed} pages > max_pages_per_seq="
+                f"{self.pod.config.max_pages_per_seq}"
+            )
+        if pages_needed > self.pod.config.n_pages:
+            return (
+                f"request needs {pages_needed} pages > pool size "
+                f"{self.pod.config.n_pages}"
+            )
+        return None
+
+    def _preempt(self, req: Request) -> None:
+        """Recompute preemption: release pages (prefix stays cached), fold
+        generated tokens into the prompt, rejoin the queue at the front."""
+        self.pod.free(req.state)
+        req.prompt_tokens = list(req.state.tokens)
+        req.state = None
+        self._waiting.appendleft(req)
+
+    def _admit(self) -> List[Request]:
+        if not self._waiting or len(self._running) >= self.max_batch:
+            return []
+        req = self._waiting[0]
+        try:
+            state, cached = self.pod.prefill(req.prompt_tokens, lora_id=req.lora_id)
+        except OutOfPagesError:
+            return []  # retry next tick once decodes free pages
+        self._waiting.popleft()
+        req.state = state
+        req.num_cached_tokens = cached
+        # Next generated token comes from the prefill logits (for a
+        # re-admitted preempted request this continues its generation).
+        jnp = self.pod._jnp
+        token = int(jnp.argmax(self.pod.last_logits))
+        req.generated.append(token)
+        # A finished sequence never attends again — skip the (possibly
+        # page-allocating) KV write for its final token.
+        if self._done(req, token):
+            req.finished = True
+            self.pod.free(state)
+            return [req]
+        try:
+            self.pod.decode_append(state, token)
+        except OutOfPagesError:
+            self._preempt(req)  # token folds into the recompute prompt
+            return []
+        self._running.append(req)
+        return []
+
+    @staticmethod
+    def _done(req: Request, token: int) -> bool:
+        return len(req.generated) >= req.max_new_tokens or (
+            req.eos_token is not None and token == req.eos_token
+        )
+
+    def _decode(self) -> List[Request]:
+        if not self._running:
+            return []
+        jnp = self.pod._jnp
+
+        # Assemble the batch: shared block-table bucket across sequences.
+        need = max(len(r.state.block_table) for r in self._running)
+        bucket = self.pod.table_bucket(need)
+
+        tables = np.zeros((len(self._running), bucket), dtype=np.int32)
+        tokens = np.zeros((len(self._running),), dtype=np.int32)
+        positions = np.zeros((len(self._running),), dtype=np.int32)
+        for i, req in enumerate(self._running):
+            bt = req.state.block_table
+            tables[i, : len(bt)] = bt
+            tokens[i] = req.state.tokens[-1]
+            positions[i] = len(req.state.tokens) - 1
+
+        self.pod.kv_cache, logits = self.pod._model.decode_step_cache(
+            self.pod._model_config,
+            self.pod.params,
+            self.pod.kv_cache,
+            jnp.asarray(tokens),
+            jnp.asarray(tables),
+            jnp.asarray(positions),
+            self.pod.config.use_kernel,
+        )
+        next_tokens = np.asarray(jnp.argmax(logits, axis=-1))
+
+        finished: List[Request] = []
+        still_running: List[Request] = []
+        for req, token in zip(self._running, next_tokens):
+            token = int(token)
+            req.generated.append(token)
+            if self._done(req, token):
+                req.finished = True
+                self.pod.free(req.state)
+                finished.append(req)
+                continue
+            try:
+                self.pod.decode_append(req.state, token)
+            except OutOfPagesError:
+                self._preempt(req)  # tokens incl. this one fold into prompt
+                continue
+            still_running.append(req)
+        self._running = still_running
+        return finished
