@@ -1,0 +1,100 @@
+"""Engine-side golden-fixture generator for the hash-parity keystone test.
+
+Plays the role of the vLLM-TPU engine in the reference's integration fixtures
+(/root/reference/tests/integration/testdata/kv_event_base.json, generated from
+a live engine's KVEvents): it tokenizes a prompt and computes the per-block
+chained hashes an engine would report in BlockStored events, then writes them
+as JSON in the reference's exact testdata schema
+(/root/reference/tests/integration/prompt_to_block_test.go:36-48, extended
+with `lora_id` since this framework keys LoRA blocks by adapter id).
+
+CRITICAL INDEPENDENCE PROPERTY: this script must never import
+`llm_d_kv_cache_manager_tpu` — the hashing here is written from the published
+scheme (FNV-64a over canonical CBOR [parent, tokens, extra], root =
+FNV-64a(seed bytes); reference token_processor.go:81-112) using the
+independent RFC-8949 codec in tests/independent_cbor.py and a reduce-based
+FNV. The committed fixtures therefore constitute a second implementation:
+if `kvcache/kvblock/hashing.py` ever drifts, tests/test_hash_parity.py fails.
+
+Run from the repo root to regenerate:  python tests/fixtures/generate_fixtures.py
+"""
+
+import functools
+import json
+import pathlib
+import sys
+
+from tokenizers import Tokenizer
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+import independent_cbor  # noqa: E402
+
+FIXTURE_DIR = pathlib.Path(__file__).resolve().parent
+TOKENIZER_JSON = FIXTURE_DIR / "test-model" / "tokenizer.json"
+MODEL_NAME = "fixtures/test-model"
+BLOCK_SIZE = 16
+HASH_SEED = "42"  # matches the reference benchmark fleet config (37-capacity)
+
+PROMPT = (
+    "A cache aware router keeps a live map from block hashes to the pods "
+    "that already hold them, so a new request can land where most of its "
+    "prefix is resident. The index is fed by events that engines publish "
+    "whenever blocks are stored or evicted, and the scorer walks the chain "
+    "of block keys in order, stopping at the first miss. On a TPU fleet the "
+    "same contract holds, with tiers for device memory and host memory, and "
+    "a transfer plane that can move blocks between pods when a remote pod "
+    "owns a longer prefix than any local one."
+)
+
+
+def fnv64a(data: bytes) -> int:
+    return functools.reduce(
+        lambda acc, byte: ((acc ^ byte) * 0x100000001B3) & (2**64 - 1),
+        data,
+        0xCBF29CE484222325,
+    )
+
+
+def engine_block_hashes(token_ids, block_size, seed, lora_id=None):
+    """Chained per-block hashes exactly as the engine event stream reports."""
+    hashes = []
+    parent = fnv64a(seed.encode())
+    extra = None if lora_id is None else [lora_id]
+    for start in range(0, (len(token_ids) // block_size) * block_size, block_size):
+        payload = [parent, list(token_ids[start:start + block_size]), extra]
+        parent = fnv64a(independent_cbor.encode(payload))
+        hashes.append(parent)
+    return hashes
+
+
+def build_fixture(lora_name=None, lora_id=None):
+    token_ids = Tokenizer.from_file(str(TOKENIZER_JSON)).encode(PROMPT).ids
+    n_full = (len(token_ids) // BLOCK_SIZE) * BLOCK_SIZE
+    return {
+        "prompt": PROMPT,
+        "model_name": MODEL_NAME,
+        "lora_path": None,
+        "lora_name": lora_name,
+        "lora_id": lora_id,
+        "event_type": "BlockStored",
+        "block_hashes": engine_block_hashes(token_ids, BLOCK_SIZE, HASH_SEED, lora_id),
+        "parent_block_hash": None,
+        "token_ids": token_ids[:n_full],
+        "block_size": BLOCK_SIZE,
+        "medium": "hbm",
+        "hash_seed": HASH_SEED,
+    }
+
+
+def main():
+    base = build_fixture()
+    assert len(base["block_hashes"]) >= 4, "prompt too short for a useful fixture"
+    lora = build_fixture(lora_name="test-adapter", lora_id=7)
+    assert lora["block_hashes"] != base["block_hashes"], "LoRA id must change hashes"
+    for name, data in (("kv_event_base.json", base), ("kv_event_lora.json", lora)):
+        (FIXTURE_DIR / name).write_text(json.dumps(data, indent=2) + "\n")
+        print(f"wrote {name}: {len(data['block_hashes'])} blocks")
+
+
+if __name__ == "__main__":
+    main()

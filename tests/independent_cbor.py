@@ -1,0 +1,154 @@
+"""Independent canonical CBOR codec, written directly from RFC 8949.
+
+This module intentionally shares NO code or structure with
+`llm_d_kv_cache_manager_tpu.kvcache.kvblock.hashing`: that module builds the
+hash payload with a specialised single-pass byte emitter, while this one is a
+general-purpose recursive encoder/strict decoder over arbitrary Python values.
+The two are developed against the spec independently so that
+`tests/test_hash_parity.py` can fuzz them against each other byte-for-byte —
+the in-repo substitute for the reference's cross-implementation parity test
+(/root/reference/tests/integration/prompt_to_block_test.go:58-99), which
+compares Go hashing output against engine-captured vectors.
+
+Canonical form per RFC 8949 §4.2.1: shortest-form argument encodings,
+definite lengths only, map keys sorted bytewise on their encoded form.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, List, Tuple
+
+
+class NonCanonicalError(ValueError):
+    """Raised by the strict decoder on any non-canonical encoding."""
+
+
+# ---------------------------------------------------------------------------
+# Encoder
+# ---------------------------------------------------------------------------
+
+def _head(major: int, argument: int) -> bytes:
+    """Encode a major type + argument in shortest form (RFC 8949 §4.2.1)."""
+    if argument < 0:
+        raise ValueError("CBOR head argument must be non-negative")
+    if argument <= 23:
+        return struct.pack(">B", (major << 5) | argument)
+    for info, fmt, limit in ((24, ">BB", 1 << 8), (25, ">BH", 1 << 16),
+                             (26, ">BI", 1 << 32), (27, ">BQ", 1 << 64)):
+        if argument < limit:
+            return struct.pack(fmt, (major << 5) | info, argument)
+    raise ValueError("CBOR argument exceeds 64 bits")
+
+
+def encode(value: Any) -> bytes:
+    """Canonical (deterministic) CBOR encoding of a Python value."""
+    if value is None:
+        return b"\xf6"
+    if value is True:
+        return b"\xf5"
+    if value is False:
+        return b"\xf4"
+    if isinstance(value, int):
+        if value >= 0:
+            return _head(0, value)
+        return _head(1, -1 - value)
+    if isinstance(value, bytes):
+        return _head(2, len(value)) + value
+    if isinstance(value, str):
+        raw = value.encode("utf-8")
+        return _head(3, len(raw)) + raw
+    if isinstance(value, (list, tuple)):
+        return _head(4, len(value)) + b"".join(encode(item) for item in value)
+    if isinstance(value, dict):
+        pairs = sorted(
+            (encode(k), encode(v)) for k, v in value.items()
+        )
+        return _head(5, len(pairs)) + b"".join(k + v for k, v in pairs)
+    raise TypeError(f"unsupported CBOR type: {type(value)!r}")
+
+
+# ---------------------------------------------------------------------------
+# Strict decoder — rejects every non-canonical form it can detect
+# ---------------------------------------------------------------------------
+
+def _read_head(data: bytes, pos: int) -> Tuple[int, int, int]:
+    """Return (major, argument, next_pos); enforce shortest-form arguments."""
+    if pos >= len(data):
+        raise NonCanonicalError("truncated CBOR: missing head byte")
+    initial = data[pos]
+    major, info = initial >> 5, initial & 0x1F
+    pos += 1
+    if info <= 23:
+        return major, info, pos
+    if info > 27:
+        raise NonCanonicalError(
+            f"indefinite-length / reserved additional info {info} is not canonical"
+        )
+    width = 1 << (info - 24)
+    if pos + width > len(data):
+        raise NonCanonicalError("truncated CBOR: short argument")
+    argument = int.from_bytes(data[pos:pos + width], "big")
+    pos += width
+    # Shortest-form check: the argument must not have fit a smaller width.
+    floor = 24 if width == 1 else 1 << (8 * (width >> 1))
+    if argument < floor:
+        raise NonCanonicalError(
+            f"non-shortest-form argument {argument} encoded in {width} byte(s)"
+        )
+    return major, argument, pos
+
+
+def _decode_item(data: bytes, pos: int, depth: int = 0) -> Tuple[Any, int]:
+    if depth > 64:
+        raise NonCanonicalError("nesting too deep")
+    major, argument, pos = _read_head(data, pos)
+    if major == 0:
+        return argument, pos
+    if major == 1:
+        return -1 - argument, pos
+    if major == 2:
+        if pos + argument > len(data):
+            raise NonCanonicalError("truncated byte string")
+        return data[pos:pos + argument], pos + argument
+    if major == 3:
+        if pos + argument > len(data):
+            raise NonCanonicalError("truncated text string")
+        try:
+            text = data[pos:pos + argument].decode("utf-8")
+        except UnicodeDecodeError as e:
+            raise NonCanonicalError(f"invalid UTF-8 in text string: {e}") from e
+        return text, pos + argument
+    if major == 4:
+        items: List[Any] = []
+        for _ in range(argument):
+            item, pos = _decode_item(data, pos, depth + 1)
+            items.append(item)
+        return items, pos
+    if major == 5:
+        result = {}
+        prev_key_bytes = None
+        for _ in range(argument):
+            key_start = pos
+            key, pos = _decode_item(data, pos, depth + 1)
+            key_bytes = data[key_start:pos]
+            if prev_key_bytes is not None and key_bytes <= prev_key_bytes:
+                raise NonCanonicalError("map keys not in canonical order")
+            prev_key_bytes = key_bytes
+            value, pos = _decode_item(data, pos, depth + 1)
+            result[key] = value
+        return result, pos
+    if major == 7:
+        simple = {20: False, 21: True, 22: None}
+        if argument in simple:
+            return simple[argument], pos
+        raise NonCanonicalError(f"unsupported simple/float value {argument}")
+    raise NonCanonicalError(f"unsupported major type {major}")
+
+
+def decode(data: bytes) -> Any:
+    """Strict canonical decode; raises NonCanonicalError on any deviation."""
+    value, pos = _decode_item(data, 0)
+    if pos != len(data):
+        raise NonCanonicalError(f"{len(data) - pos} trailing byte(s) after item")
+    return value
