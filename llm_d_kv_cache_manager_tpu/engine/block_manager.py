@@ -37,6 +37,9 @@ from llm_d_kv_cache_manager_tpu.kvevents.events import (
     Event,
     EventBatch,
 )
+from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
+
+logger = kvlog.get_logger("engine.block_manager")
 
 EventSink = Callable[[EventBatch], None]
 
@@ -61,22 +64,49 @@ class SequenceState:
 
 
 class _Page:
-    __slots__ = ("page_id", "ref_count", "chunk_hash")
+    __slots__ = ("page_id", "ref_count", "chunk_hash", "token_ids",
+                 "parent_hash", "lora_id")
 
     def __init__(self, page_id: int):
         self.page_id = page_id
         self.ref_count = 0
         self.chunk_hash: Optional[int] = None  # set when committed (full page)
+        # Block provenance, kept so a reclaimed page can be offloaded to the
+        # host tier with a well-formed BlockStored (the control plane needs
+        # token_ids + parent hash + lora_id to recompute request keys —
+        # dropping lora_id would rekey the block into the base keyspace).
+        self.token_ids: Optional[List[int]] = None
+        self.parent_hash: Optional[int] = None
+        self.lora_id: Optional[int] = None
 
 
 class OutOfPagesError(RuntimeError):
     pass
 
 
+# Hook signatures for the two-tier data plane (engine/tiering.py):
+#  ReclaimHook(chunk_hash, token_ids, parent_hash, page_id, lora_id) — a
+#    committed HBM page is about to be dropped; offload it to the host tier
+#    if desired.
+#  PageLoader(chunk_hash, token_ids, parent_hash, page_id) -> bool — the hash
+#    chain missed in HBM; materialize the block into `page_id` from the host
+#    store or a remote pod and return True, else False.
+ReclaimHook = Callable[[int, List[int], Optional[int], int, Optional[int]], None]
+PageLoader = Callable[[int, List[int], Optional[int], int], bool]
+
+
 class BlockManager:
-    def __init__(self, config: BlockManagerConfig, event_sink: Optional[EventSink] = None):
+    def __init__(
+        self,
+        config: BlockManagerConfig,
+        event_sink: Optional[EventSink] = None,
+        reclaim_hook: Optional[ReclaimHook] = None,
+        page_loader: Optional[PageLoader] = None,
+    ):
         self.config = config
         self.event_sink = event_sink
+        self.reclaim_hook = reclaim_hook
+        self.page_loader = page_loader
         self.token_db = ChunkedTokenDatabase(
             TokenProcessorConfig(block_size=config.page_size, hash_seed=config.hash_seed)
         )
@@ -120,13 +150,22 @@ class BlockManager:
             if self.config.enable_prefix_caching
             else []
         )
-
-        # 1. Reuse cached pages along the hash chain.
+        # 1. Reuse cached pages along the hash chain; on an HBM miss, try the
+        # two-tier data plane (host staging store, then remote pods) before
+        # giving up on the chain.
         n_cached_pages = 0
-        for key in hashes:
+        ps = self.config.page_size
+        for i, key in enumerate(hashes):
             page_id = self._hash_to_page.get(key.chunk_hash)
             if page_id is None:
-                break
+                page_id = self._try_load_page(
+                    key.chunk_hash,
+                    tokens[i * ps:(i + 1) * ps],
+                    hashes[i - 1].chunk_hash if i > 0 else None,
+                    lora_id,
+                )
+                if page_id is None:
+                    break
             page = self._pages[page_id]
             if page.ref_count == 0:
                 self._reclaimable.pop(page_id, None)
@@ -196,7 +235,8 @@ class BlockManager:
         this pod for blocks it no longer holds.
         """
         cached_hashes = list(self._hash_to_page)
-        self.__init__(self.config, self.event_sink)
+        self.__init__(self.config, self.event_sink, self.reclaim_hook,
+                      self.page_loader)
         events: List[Event] = []
         if cached_hashes:
             events.append(
@@ -205,7 +245,62 @@ class BlockManager:
         events.append(AllBlocksCleared())
         self._emit(events)
 
+    def committed_blocks(self, state: SequenceState):
+        """Yield (chunk_hash, token_ids, parent_hash, page_id, lora_id) for
+        each committed page of a sequence — the provenance a data plane
+        needs to export blocks (engine.EnginePod.export_sequence)."""
+        for i in range(state.n_hashed_pages):
+            page = self._pages[state.block_table[i]]
+            if page.chunk_hash is None or page.token_ids is None:
+                continue
+            yield (page.chunk_hash, page.token_ids, page.parent_hash,
+                   page.page_id, page.lora_id)
+
     # -- internals -----------------------------------------------------------
+
+    def _try_load_page(
+        self,
+        chunk_hash: int,
+        token_ids: List[int],
+        parent_hash: Optional[int],
+        lora_id: Optional[int],
+    ) -> Optional[int]:
+        """On an HBM-chain miss, ask the data plane (engine/tiering.py) to
+        materialize the block into a free page. Returns the committed page id
+        on success — the page enters the cache exactly as if prefill had
+        computed it, including the BlockStored event at the device tier."""
+        if self.page_loader is None:
+            return None
+        try:
+            page_id = self._take_free_page()
+        except OutOfPagesError:
+            return None
+        loaded = False
+        try:
+            loaded = self.page_loader(chunk_hash, token_ids, parent_hash, page_id)
+        except Exception as e:  # noqa: BLE001 - a data-plane fault must not
+            logger.debug("page loader failed for %x: %s", chunk_hash, e)
+            # fail the allocation; the chain just stops here.
+        if not loaded:
+            self._free_fresh.append(page_id)
+            return None
+        page = self._pages[page_id]
+        page.chunk_hash = chunk_hash
+        page.token_ids = list(token_ids)
+        page.parent_hash = parent_hash
+        page.lora_id = lora_id
+        self._hash_to_page[chunk_hash] = page_id
+        self._emit([
+            BlockStored(
+                block_hashes=[chunk_hash],
+                parent_block_hash=parent_hash,
+                token_ids=list(token_ids),
+                block_size=self.config.page_size,
+                lora_id=lora_id,
+                medium=self.config.device_tier,
+            )
+        ])
+        return page_id
 
     def _take_free_page(self) -> int:
         if self._free_fresh:
@@ -220,9 +315,21 @@ class BlockManager:
             # evict the live page's index entry.
             if self._hash_to_page.get(page.chunk_hash) == page_id:
                 self._hash_to_page.pop(page.chunk_hash)
+                if self.reclaim_hook is not None and page.token_ids is not None:
+                    try:
+                        self.reclaim_hook(
+                            page.chunk_hash, page.token_ids, page.parent_hash,
+                            page_id, page.lora_id,
+                        )
+                    except Exception as e:  # noqa: BLE001 - offload is best-effort
+                        logger.debug("reclaim offload failed for %x: %s",
+                                     page.chunk_hash, e)
                 self._emit([BlockRemoved(block_hashes=[page.chunk_hash],
                                          medium=self.config.device_tier)])
             page.chunk_hash = None
+            page.token_ids = None
+            page.parent_hash = None
+            page.lora_id = None
             return page_id
         raise OutOfPagesError(
             f"no free pages (pool={self.config.n_pages})"
@@ -266,6 +373,11 @@ class BlockManager:
         for offset, key in enumerate(keys):
             page = self._pages[state.block_table[start_page + offset]]
             page.chunk_hash = key.chunk_hash
+            page.token_ids = new_tokens[
+                offset * self.config.page_size:(offset + 1) * self.config.page_size
+            ]
+            page.parent_hash = parent_hash if offset == 0 else keys[offset - 1].chunk_hash
+            page.lora_id = state.lora_id
             # First registration wins: if another page already holds this
             # hash, leave its mapping intact (this page is duplicate content).
             self._hash_to_page.setdefault(key.chunk_hash, page.page_id)

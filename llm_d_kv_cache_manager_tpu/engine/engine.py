@@ -27,8 +27,82 @@ from llm_d_kv_cache_manager_tpu.engine.block_manager import (
     BlockManagerConfig,
     SequenceState,
 )
+from llm_d_kv_cache_manager_tpu.engine.tiering import PageCodec
 from llm_d_kv_cache_manager_tpu.kvevents.events import EventBatch
 from llm_d_kv_cache_manager_tpu.kvevents.publisher import Publisher, make_topic
+
+_SET_PAGE = None
+
+
+def _set_page(comp, block, page_id):
+    """Jitted, buffer-donating `comp[:, :, page_id] = block` (lazy jax import)."""
+    global _SET_PAGE
+    if _SET_PAGE is None:
+        import jax
+
+        _SET_PAGE = jax.jit(
+            lambda c, b, i: c.at[:, :, i].set(b), donate_argnums=(0,)
+        )
+    return _SET_PAGE(comp, block, page_id)
+
+
+class _DevicePageCodec(PageCodec):
+    """Serializes one logical page across every layer of the pod's KV cache.
+
+    Works for both layouts (bf16 (k, v) pair and int8 quantized 4-tuple):
+    each cache component is [n_layers, n_kv_heads, n_pages, page_size, ...]
+    with the page axis at position 2, so a block's bytes are the
+    concatenation of each component's [:, :, page_id] slice.
+    """
+
+    def __init__(self, pod: "EnginePod"):
+        self.pod = pod
+
+    @staticmethod
+    def _slice_shape(comp) -> tuple:
+        return comp.shape[:2] + comp.shape[3:]
+
+    @staticmethod
+    def _slice_nbytes(comp) -> int:
+        return int(np.prod(_DevicePageCodec._slice_shape(comp))) * np.dtype(
+            comp.dtype
+        ).itemsize
+
+    @property
+    def page_nbytes(self) -> int:
+        return sum(self._slice_nbytes(c) for c in self.pod.kv_cache)
+
+    def extract(self, page_id: int) -> bytes:
+        import jax
+
+        return b"".join(
+            np.asarray(jax.device_get(c[:, :, page_id])).tobytes()
+            for c in self.pod.kv_cache
+        )
+
+    def insert(self, page_id: int, payload: bytes) -> None:
+        if len(payload) != self.page_nbytes:
+            raise ValueError(
+                f"block payload is {len(payload)} bytes, expected "
+                f"{self.page_nbytes}"
+            )
+        import jax.numpy as jnp
+
+        updated = []
+        offset = 0
+        for comp in self.pod.kv_cache:
+            n = self._slice_nbytes(comp)
+            block = np.frombuffer(payload[offset:offset + n], dtype=comp.dtype)
+            # Donated jit update: XLA writes the page slice in place
+            # (dynamic-update-slice) instead of copying the whole pool per
+            # landed block; page_id is traced so one compile per component
+            # shape serves every page.
+            updated.append(_set_page(
+                comp, jnp.asarray(block.reshape(self._slice_shape(comp))),
+                jnp.int32(page_id),
+            ))
+            offset += n
+        self.pod.kv_cache = tuple(updated)
 
 
 @dataclass
@@ -49,6 +123,12 @@ class EnginePodConfig:
     # Decode through the Pallas flash-decoding kernel (True on TPU; the jnp
     # oracle path works on any backend and is the test default).
     use_kernel: bool = False
+    # Two-tier data plane (engine/tiering.py): reclaimed HBM pages offload
+    # to the C++ host staging store instead of vanishing, and allocation
+    # misses restore from host / onboard from peer pods over DCN.
+    enable_host_tier: bool = False
+    host_capacity_blocks: int = 1024
+    transfer_port: int = 0  # 0 -> ephemeral
 
 
 class EnginePod:
@@ -66,6 +146,29 @@ class EnginePod:
             )
         self._extra_sink = event_sink
 
+        self.tier_store = None
+        self.connector = None
+        if config.enable_host_tier:
+            from llm_d_kv_cache_manager_tpu.kv_connectors.connector import (
+                KVConnector,
+                KVConnectorConfig,
+            )
+            from llm_d_kv_cache_manager_tpu.engine.tiering import (
+                NullPageCodec,
+                TieredKVStore,
+            )
+
+            self.connector = KVConnector(
+                KVConnectorConfig(port=config.transfer_port),
+                event_sink=self._emit,
+            )
+            codec = (
+                _DevicePageCodec(self) if config.with_model else NullPageCodec()
+            )
+            self.tier_store = TieredKVStore(
+                self.connector, codec, capacity_blocks=config.host_capacity_blocks
+            )
+
         self.block_manager = BlockManager(
             BlockManagerConfig(
                 n_pages=config.n_pages,
@@ -74,6 +177,8 @@ class EnginePod:
                 device_tier=config.device_tier,
             ),
             event_sink=self._emit,
+            reclaim_hook=self.tier_store.reclaim_hook if self.tier_store else None,
+            page_loader=self.tier_store.page_loader if self.tier_store else None,
         )
 
         self._model = None
@@ -166,9 +271,43 @@ class EnginePod:
     def free(self, state: SequenceState) -> None:
         self.block_manager.free(state)
 
+    # -- data plane -----------------------------------------------------------
+
+    @property
+    def transfer_address(self) -> Optional[Tuple[str, int]]:
+        """(host, port) peers use to fetch this pod's staged blocks."""
+        if self.connector is None:
+            return None
+        return ("127.0.0.1", self.connector.port)
+
+    def set_peer_resolver(self, resolver) -> None:
+        """Install the hash→peer-address resolver (after the fleet's pods and
+        shared index exist — see tiering.IndexBackedPeerResolver)."""
+        if self.tier_store is None:
+            raise RuntimeError("enable_host_tier=False: no data plane to configure")
+        self.tier_store.peer_resolver = resolver
+
+    def export_sequence(self, state: SequenceState) -> int:
+        """Stage every committed page of a live sequence in the transfer
+        server (pages stay in HBM) so peers can onboard them — the
+        prefill/decode-disaggregation push. Returns the number staged."""
+        if self.tier_store is None:
+            raise RuntimeError("enable_host_tier=False: no data plane to export to")
+        n = 0
+        for chunk_hash, token_ids, parent_hash, page_id, lora_id in (
+            self.block_manager.committed_blocks(state)
+        ):
+            self.tier_store.export_block(
+                chunk_hash, token_ids, parent_hash, page_id, lora_id=lora_id,
+            )
+            n += 1
+        return n
+
     def close(self) -> None:
         if self._publisher is not None:
             self._publisher.close()
+        if self.connector is not None:
+            self.connector.close()
 
     # -- helpers -------------------------------------------------------------
 

@@ -1,0 +1,193 @@
+"""Two-tier KV policy: wires kv_connectors into the serving loop.
+
+The reference plans this behavior but never implements it (its
+kv_connectors/ directory is empty; its device tiers "gpu"/"cpu" exist only
+as scoring weights). Here the tiers are real:
+
+- **reclaim → offload**: when the block manager reclaims a committed HBM
+  page under allocation pressure, the page's bytes are staged in the host
+  store (C++ transfer server) instead of vanishing — BlockRemoved(hbm) +
+  BlockStored(host) flow to the control plane, so the scorer keeps ranking
+  this pod for the block at the host-tier weight.
+- **miss → restore/onboard**: when an allocation's hash chain misses in
+  HBM, the block is materialized from the host store, or — if a peer
+  resolver is configured — fetched from another pod's transfer server over
+  DCN, landing as a normal committed page (device-tier BlockStored). Pod B
+  can thereby serve a prefix it never computed.
+- **export**: explicit staging of a live sequence's committed pages
+  (prefill/decode disaggregation push): the pages stay in HBM, a copy
+  becomes fetchable by peers.
+
+The page payload is opaque bytes; `PageCodec` implementations serialize one
+logical page across all layers (bf16 pair or int8 quantized 4-tuple).
+Accounting-only pods use `NullPageCodec` — the full event/scoring behavior
+without device bytes, which is what the fleet bench simulates.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Dict, List, Mapping, Optional, Tuple
+
+from llm_d_kv_cache_manager_tpu.kv_connectors.connector import KVConnector
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key
+from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
+
+logger = kvlog.get_logger("engine.tiering")
+
+# (host, port) of a peer pod's transfer server, or None.
+PeerResolver = Callable[[int], Optional[Tuple[str, int]]]
+
+
+class PageCodec:
+    """Serializes one logical KV page (all layers) to/from opaque bytes."""
+
+    page_nbytes: int = 0
+
+    def extract(self, page_id: int) -> bytes:
+        raise NotImplementedError
+
+    def insert(self, page_id: int, payload: bytes) -> None:
+        raise NotImplementedError
+
+
+class NullPageCodec(PageCodec):
+    """Accounting-only pods: zero-byte payloads, full event behavior."""
+
+    def extract(self, page_id: int) -> bytes:
+        return b""
+
+    def insert(self, page_id: int, payload: bytes) -> None:
+        if payload:
+            raise ValueError("accounting-only pod received a non-empty block")
+
+
+class TieredKVStore:
+    """Per-pod two-tier policy over a KVConnector.
+
+    Bounded host store: staging beyond `capacity_blocks` drops the
+    least-recently-staged block first (BlockRemoved(host) via the
+    connector), so host RAM use is capped like any cache tier.
+    """
+
+    def __init__(
+        self,
+        connector: KVConnector,
+        codec: PageCodec,
+        capacity_blocks: int = 1024,
+        peer_resolver: Optional[PeerResolver] = None,
+    ):
+        self.connector = connector
+        self.codec = codec
+        self.capacity_blocks = capacity_blocks
+        self.peer_resolver = peer_resolver
+        # hash -> None, insertion-ordered: the host store's eviction queue.
+        self._staged: "OrderedDict[int, None]" = OrderedDict()
+        self.stats: Dict[str, int] = {
+            "offloads": 0, "restores": 0, "onboards": 0, "host_evictions": 0,
+        }
+
+    # -- BlockManager hook: reclaim → offload ------------------------------
+
+    def reclaim_hook(
+        self, chunk_hash: int, token_ids: List[int],
+        parent_hash: Optional[int], page_id: int,
+        lora_id: Optional[int] = None,
+    ) -> None:
+        self._stage(chunk_hash, token_ids, parent_hash, page_id, lora_id)
+        self.stats["offloads"] += 1
+
+    # -- P/D disaggregation: stage without reclaiming ----------------------
+
+    def export_block(
+        self, chunk_hash: int, token_ids: List[int],
+        parent_hash: Optional[int], page_id: int,
+        lora_id: Optional[int] = None,
+    ) -> None:
+        self._stage(chunk_hash, token_ids, parent_hash, page_id, lora_id)
+
+    # -- BlockManager hook: miss → restore/onboard -------------------------
+
+    def page_loader(
+        self, chunk_hash: int, token_ids: List[int],
+        parent_hash: Optional[int], page_id: int,
+    ) -> bool:
+        # _staged exactly mirrors the local server's contents, so a miss
+        # there skips the loopback round trip on the allocation hot path.
+        if chunk_hash in self._staged:
+            payload = self.connector.fetch_staged(
+                chunk_hash, max(self.codec.page_nbytes, 1)
+            )
+            if payload is not None:
+                self.codec.insert(page_id, payload)
+                self.stats["restores"] += 1
+                return True
+        if self.peer_resolver is not None:
+            addr = self.peer_resolver(chunk_hash)
+            if addr is not None:
+                payload = self.connector.onboard_payload(
+                    addr[0], addr[1], chunk_hash, max(self.codec.page_nbytes, 1)
+                )
+                if payload is not None:
+                    self.codec.insert(page_id, payload)
+                    self.stats["onboards"] += 1
+                    return True
+        return False
+
+    # -- internals ---------------------------------------------------------
+
+    def _stage(
+        self, chunk_hash: int, token_ids: List[int],
+        parent_hash: Optional[int], page_id: int,
+        lora_id: Optional[int] = None,
+    ) -> None:
+        if chunk_hash in self._staged:
+            self._staged.move_to_end(chunk_hash)
+            return
+        while len(self._staged) >= self.capacity_blocks:
+            victim, _ = self._staged.popitem(last=False)
+            self.connector.drop(victim)
+            self.stats["host_evictions"] += 1
+        self.connector.stage(
+            chunk_hash, self.codec.extract(page_id), token_ids,
+            len(token_ids), parent_hash, lora_id,
+        )
+        self._staged[chunk_hash] = None
+
+    @property
+    def staged_count(self) -> int:
+        return len(self._staged)
+
+
+class IndexBackedPeerResolver:
+    """Resolve a block hash to a peer pod's transfer address through the
+    control-plane index — the routing loop closed over the data plane: the
+    indexer knows which pod holds a block and at which tier; pods whose
+    entry is host-tier have the bytes staged and fetchable."""
+
+    def __init__(
+        self,
+        index,
+        model_name: str,
+        pod_addrs: Mapping[str, Tuple[str, int]],
+        self_pod_id: str,
+        host_tier: str = "host",
+    ):
+        self.index = index
+        self.model_name = model_name
+        self.pod_addrs = pod_addrs
+        self.self_pod_id = self_pod_id
+        self.host_tier = host_tier
+
+    def __call__(self, chunk_hash: int) -> Optional[Tuple[str, int]]:
+        key = Key(self.model_name, chunk_hash)
+        hits = self.index.lookup([key], set())
+        for entry in hits.get(key, []):
+            if entry.pod_identifier == self.self_pod_id:
+                continue
+            if entry.device_tier != self.host_tier:
+                continue  # only staged blocks are fetchable
+            addr = self.pod_addrs.get(entry.pod_identifier)
+            if addr is not None:
+                return addr
+        return None

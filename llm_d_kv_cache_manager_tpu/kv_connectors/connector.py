@@ -193,6 +193,31 @@ class KVConnector:
                              medium=self.config.device_tier_host)
             ]))
 
+    # -- opaque-payload tier API (engine/tiering.py drives these) -------------
+
+    def stage(
+        self, block_hash: int, payload: bytes, token_ids, block_size: int,
+        parent_hash: Optional[int] = None, lora_id: Optional[int] = None,
+    ) -> None:
+        """Stage an already-serialized block in the host store (+ host-tier
+        BlockStored). The payload layout is the engine's business — the data
+        plane treats blocks as opaque bytes named by their hash."""
+        self.server.put(block_hash, payload)
+        self._emit_stored(block_hash, token_ids, block_size, parent_hash,
+                          self.config.device_tier_host, lora_id)
+
+    def fetch_staged(self, block_hash: int, max_size: int) -> Optional[bytes]:
+        """Local host-store lookup; None if the block is not staged."""
+        return fetch_block("127.0.0.1", self.port, block_hash, max_size)
+
+    def onboard_payload(
+        self, host: str, port: int, block_hash: int, max_size: int,
+    ) -> Optional[bytes]:
+        """Pull a block's bytes from a remote pod over DCN; None if absent.
+        The caller lands it in HBM and the block manager emits the
+        device-tier BlockStored, so no event fires here."""
+        return fetch_block(host, port, block_hash, max_size)
+
     # -- cross-pod (DCN) -------------------------------------------------------
 
     def onboard(
@@ -236,13 +261,15 @@ class KVConnector:
         )
         return k_np, v_np
 
-    def _emit_stored(self, block_hash, token_ids, block_size, parent_hash, tier):
+    def _emit_stored(self, block_hash, token_ids, block_size, parent_hash, tier,
+                     lora_id=None):
         self._emit(EventBatch(ts=0.0, events=[
             BlockStored(
                 block_hashes=[block_hash],
                 parent_block_hash=parent_hash,
                 token_ids=list(token_ids),
                 block_size=block_size,
+                lora_id=lora_id,
                 medium=tier,
             )
         ]))
