@@ -233,22 +233,34 @@ class FleetSim:
         tokens = self.indexer.tokenizers_pool.tokenize(None, prompt, MODEL)
         self.total_tokens += len(tokens)
         stats_before = dict(pod.tier_store.stats) if pod.tier_store else None
+
+        def tier_delta():
+            # Blocks re-landed through the data plane are cache hits, but
+            # not free ones: charge them at DMA/DCN bandwidth instead of
+            # recompute — including loads done by an allocate that then
+            # failed, or the high-pressure regime under-reports itself.
+            if stats_before is None:
+                return 0, 0
+            r = pod.tier_store.stats["restores"] - stats_before["restores"]
+            o = pod.tier_store.stats["onboards"] - stats_before["onboards"]
+            self.restored_blocks += r
+            self.onboarded_blocks += o
+            return r, o
+
         try:
             state, cached = pod.prefill(tokens)
         except OutOfPagesError:
             # Sequence larger than the pod's whole free pool: serve uncached
             # (count the full prefill) without touching the cache.
-            return BETA_OVERHEAD_S + ALPHA_PREFILL_S_PER_TOKEN * len(tokens)
+            restored, onboarded = tier_delta()
+            return (
+                BETA_OVERHEAD_S
+                + ALPHA_PREFILL_S_PER_TOKEN * len(tokens)
+                + GAMMA_HOST_RESTORE_S_PER_TOKEN * restored * PAGE_SIZE
+                + DELTA_DCN_ONBOARD_S_PER_TOKEN * onboarded * PAGE_SIZE
+            )
         self.hit_tokens += min(cached, len(tokens))
-
-        # Blocks re-landed through the data plane are cache hits, but not
-        # free ones: charge them at DMA/DCN bandwidth instead of recompute.
-        restored = onboarded = 0
-        if stats_before is not None:
-            restored = pod.tier_store.stats["restores"] - stats_before["restores"]
-            onboarded = pod.tier_store.stats["onboards"] - stats_before["onboards"]
-            self.restored_blocks += restored
-            self.onboarded_blocks += onboarded
+        restored, onboarded = tier_delta()
 
         uncached = max(len(tokens) - cached, 0)
         prefill_s = (

@@ -27,11 +27,15 @@ without device bytes, which is what the fleet bench simulates.
 from __future__ import annotations
 
 from collections import OrderedDict
-from typing import Callable, Dict, List, Mapping, Optional, Tuple
+from typing import TYPE_CHECKING, Callable, Dict, List, Mapping, Optional, Tuple
 
-from llm_d_kv_cache_manager_tpu.kv_connectors.connector import KVConnector
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key
 from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
+
+if TYPE_CHECKING:  # kv_connectors loads the ctypes lib; keep it optional at
+    from llm_d_kv_cache_manager_tpu.kv_connectors.connector import (  # runtime
+        KVConnector,
+    )
 
 logger = kvlog.get_logger("engine.tiering")
 
@@ -72,7 +76,7 @@ class TieredKVStore:
 
     def __init__(
         self,
-        connector: KVConnector,
+        connector: "KVConnector",
         codec: PageCodec,
         capacity_blocks: int = 1024,
         peer_resolver: Optional[PeerResolver] = None,

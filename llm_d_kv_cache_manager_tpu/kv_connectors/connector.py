@@ -174,16 +174,13 @@ class KVConnector:
 
         k_np = np.asarray(jax.device_get(k_page))
         v_np = np.asarray(jax.device_get(v_page))
-        payload = k_np.tobytes() + v_np.tobytes()
-        self.server.put(block_hash, payload)
-        self._emit_stored(block_hash, token_ids, block_size, parent_hash,
-                          self.config.device_tier_host)
+        self.stage(block_hash, k_np.tobytes() + v_np.tobytes(), token_ids,
+                   block_size, parent_hash)
 
     def restore(self, block_hash: int, like_k, like_v) -> Optional[Tuple]:
         """Bring a host-staged block back as (k_page, v_page) arrays shaped
         like the given templates."""
-        payload = fetch_block("127.0.0.1", self.port, block_hash,
-                              like_k.nbytes + like_v.nbytes)
+        payload = self.fetch_staged(block_hash, like_k.nbytes + like_v.nbytes)
         return self._decode(payload, like_k, like_v)
 
     def drop(self, block_hash: int) -> None:
@@ -206,17 +203,17 @@ class KVConnector:
         self._emit_stored(block_hash, token_ids, block_size, parent_hash,
                           self.config.device_tier_host, lora_id)
 
-    def fetch_staged(self, block_hash: int, max_size: int) -> Optional[bytes]:
-        """Local host-store lookup; None if the block is not staged."""
-        return fetch_block("127.0.0.1", self.port, block_hash, max_size)
-
     def onboard_payload(
         self, host: str, port: int, block_hash: int, max_size: int,
     ) -> Optional[bytes]:
-        """Pull a block's bytes from a remote pod over DCN; None if absent.
+        """Pull a block's bytes from a pod's transfer server; None if absent.
         The caller lands it in HBM and the block manager emits the
         device-tier BlockStored, so no event fires here."""
         return fetch_block(host, port, block_hash, max_size)
+
+    def fetch_staged(self, block_hash: int, max_size: int) -> Optional[bytes]:
+        """Local host-store lookup; None if the block is not staged."""
+        return self.onboard_payload("127.0.0.1", self.port, block_hash, max_size)
 
     # -- cross-pod (DCN) -------------------------------------------------------
 

@@ -35,6 +35,10 @@ index_lookup_latency: Optional[Histogram] = None
 tokenization_latency: Optional[Histogram] = None
 tokenized_tokens: Optional[Counter] = None
 render_latency: Optional[Histogram] = None
+# Per-backend labels, mirroring the reference's CompositeTokenizer metrics
+# (/root/reference/pkg/tokenization/tokenizer.go:503-549).
+tokenization_backend_latency: Optional[Histogram] = None
+tokenization_backend_fallbacks: Optional[Counter] = None
 
 _registered = False
 _register_lock = threading.Lock()
@@ -46,6 +50,7 @@ def register_metrics(registry=None) -> None:
     global _registered, index_admissions, index_evictions, index_lookup_requests
     global index_lookup_hits, index_max_pod_hits, index_lookup_latency
     global tokenization_latency, tokenized_tokens, render_latency
+    global tokenization_backend_latency, tokenization_backend_fallbacks
 
     with _register_lock:
         if _registered:
@@ -100,7 +105,45 @@ def register_metrics(registry=None) -> None:
             buckets=_LATENCY_BUCKETS,
             registry=reg,
         )
+        tokenization_backend_latency = Histogram(
+            "kvcache_tokenization_backend_latency_seconds",
+            "Per-backend tokenizer latency",
+            labelnames=("backend", "op"),
+            buckets=_LATENCY_BUCKETS,
+            registry=reg,
+        )
+        tokenization_backend_fallbacks = Counter(
+            "kvcache_tokenization_backend_fallbacks_total",
+            "Per-backend tokenizer failures that triggered fallback",
+            labelnames=("backend", "op"),
+            registry=reg,
+        )
         _registered = True
+
+
+# -- guarded observers (no-ops until register_metrics() has run) -------------
+
+def observe_tokenization(seconds: float, n_tokens: int) -> None:
+    """Record one full tokenization: latency + tokens produced."""
+    if tokenization_latency is not None:
+        tokenization_latency.observe(seconds)
+    if tokenized_tokens is not None:
+        tokenized_tokens.inc(n_tokens)
+
+
+def observe_render(seconds: float) -> None:
+    if render_latency is not None:
+        render_latency.observe(seconds)
+
+
+def observe_backend(backend: str, op: str, seconds: float) -> None:
+    if tokenization_backend_latency is not None:
+        tokenization_backend_latency.labels(backend=backend, op=op).observe(seconds)
+
+
+def count_backend_fallback(backend: str, op: str) -> None:
+    if tokenization_backend_fallbacks is not None:
+        tokenization_backend_fallbacks.labels(backend=backend, op=op).inc()
 
 
 def start_metrics_logging(interval_s: float = 60.0) -> None:

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
@@ -170,13 +171,21 @@ class TokenizationPool:
                 self._queue.task_done()
 
     def _process(self, task: _Task) -> List[int]:
+        from llm_d_kv_cache_manager_tpu.metrics import collector as metrics
+
         prompt = task.prompt
         if task.render_request is not None:
+            t0 = time.perf_counter()
             prompt = self.tokenizer.render_chat_template(task.render_request)
+            metrics.observe_render(time.perf_counter() - t0)
 
         tokens, ratio = self.prefix_store.find_longest_contained_tokens(prompt)
         if ratio < self.config.min_prefix_overlap_ratio:
+            t0 = time.perf_counter()
             result = self.tokenizer.encode(prompt, task.model_name)
+            metrics.observe_tokenization(
+                time.perf_counter() - t0, len(result.tokens)
+            )
             self.prefix_store.add_tokenization(prompt, result.tokens, result.offsets)
             tokens = result.tokens
         return list(tokens)

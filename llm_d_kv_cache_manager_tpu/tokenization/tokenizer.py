@@ -23,6 +23,7 @@ from __future__ import annotations
 import abc
 import os
 import threading
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -242,25 +243,47 @@ class CompositeTokenizer(Tokenizer):
         self.backends = list(backends)
 
     def encode(self, prompt: str, model_name: str) -> TokenizationResult:
+        # Per-backend latency + fallback counters, mirroring the reference
+        # (/root/reference/pkg/tokenization/tokenizer.go:535-549).
+        from llm_d_kv_cache_manager_tpu.metrics import collector as metrics
+
         errors: List[str] = []
-        for backend in self.backends:
+        for i, backend in enumerate(self.backends):
+            name = type(backend).__name__
+            t0 = time.perf_counter()
             try:
-                return backend.encode(prompt, model_name)
+                result = backend.encode(prompt, model_name)
             except Exception as e:  # noqa: BLE001 - fallback semantics
-                errors.append(f"{type(backend).__name__}: {e}")
+                # Only a failure with a backend behind it is a fallback; the
+                # last backend's failure is a hard error (raised below).
+                if i + 1 < len(self.backends):
+                    metrics.count_backend_fallback(name, "encode")
+                errors.append(f"{name}: {e}")
+                continue
+            metrics.observe_backend(name, "encode", time.perf_counter() - t0)
+            return result
         raise RuntimeError(
             f"all tokenizer backends failed for model {model_name!r}: {'; '.join(errors)}"
         )
 
     def render_chat_template(self, request) -> str:
+        from llm_d_kv_cache_manager_tpu.metrics import collector as metrics
+
         errors: List[str] = []
-        for backend in self.backends:
+        for i, backend in enumerate(self.backends):
+            name = type(backend).__name__
+            t0 = time.perf_counter()
             try:
-                return backend.render_chat_template(request)
+                rendered = backend.render_chat_template(request)
             except NotImplementedError:
                 continue
             except Exception as e:  # noqa: BLE001
-                errors.append(f"{type(backend).__name__}: {e}")
+                if i + 1 < len(self.backends):
+                    metrics.count_backend_fallback(name, "render")
+                errors.append(f"{name}: {e}")
+                continue
+            metrics.observe_backend(name, "render", time.perf_counter() - t0)
+            return rendered
         raise RuntimeError(
             f"all chat-templating backends failed: {'; '.join(errors) or 'none capable'}"
         )

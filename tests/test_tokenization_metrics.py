@@ -1,0 +1,133 @@
+"""Tokenization metrics wiring: every declared collector must move.
+
+VERDICT r1 #5: `tokenization_latency` / `tokenized_tokens` /
+`render_latency` were declared and never observed, and CompositeTokenizer
+had no per-backend labels. Reference anchor:
+/root/reference/pkg/tokenization/tokenizer.go:503-549.
+"""
+
+import os
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.metrics import collector as m
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPool,
+    TokenizersPoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.tokenizer import (
+    CompositeTokenizer,
+    TokenizationResult,
+    Tokenizer,
+)
+
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "fixtures", "test-model", "tokenizer.json"
+)
+MODEL = "test-model"
+
+
+def _hist_count(hist, **labels):
+    h = hist.labels(**labels) if labels else hist
+    return h._sum.get()  # noqa: SLF001 - no public read API
+
+
+class _FailingBackend(Tokenizer):
+    def encode(self, prompt, model_name):
+        raise RuntimeError("backend down")
+
+    def render_chat_template(self, request):
+        raise RuntimeError("backend down")
+
+
+class _EchoBackend(Tokenizer):
+    def encode(self, prompt, model_name):
+        tokens = list(range(len(prompt.split())))
+        return TokenizationResult(tokens=tokens, offsets=[(0, 1)] * len(tokens))
+
+    def render_chat_template(self, request):
+        return str(request)
+
+
+@pytest.fixture(autouse=True)
+def _registered():
+    m.register_metrics()
+
+
+class TestPoolObservations:
+    def test_full_tokenization_observes_latency_and_tokens(self):
+        pool = TokenizationPool(
+            TokenizersPoolConfig(workers=1, local_tokenizer_files={MODEL: FIXTURE})
+        )
+        pool.run()
+        try:
+            before_sum = m.tokenization_latency._sum.get()
+            before_tokens = m.tokenized_tokens._value.get()
+            tokens = pool.tokenize(None, "a prompt to tokenize fully", MODEL)
+            assert tokens
+            assert m.tokenization_latency._sum.get() > before_sum
+            assert m.tokenized_tokens._value.get() == before_tokens + len(tokens)
+        finally:
+            pool.shutdown()
+
+    def test_prefix_hit_skips_tokenization_metrics(self):
+        pool = TokenizationPool(
+            TokenizersPoolConfig(workers=1, local_tokenizer_files={MODEL: FIXTURE})
+        )
+        pool.run()
+        try:
+            # Must span several 256-char prefix-store chunks for a hit.
+            prompt = "the same long prompt repeated for a prefix store hit " * 40
+            pool.tokenize(None, prompt, MODEL)
+            before = m.tokenized_tokens._value.get()
+            pool.tokenize(None, prompt, MODEL)  # served from the prefix store
+            assert m.tokenized_tokens._value.get() == before
+        finally:
+            pool.shutdown()
+
+    def test_render_latency_observed(self):
+        pool = TokenizationPool(
+            TokenizersPoolConfig(workers=1, local_tokenizer_files={MODEL: FIXTURE}),
+            tokenizer=_EchoBackend(),
+        )
+        pool.run()
+        try:
+            before = m.render_latency._sum.get()
+            pool.tokenize("rendered prompt text", "ignored", MODEL)
+            assert m.render_latency._sum.get() > before
+        finally:
+            pool.shutdown()
+
+
+class TestCompositeBackendLabels:
+    def test_success_observes_backend_latency(self):
+        comp = CompositeTokenizer([_EchoBackend()])
+        before = _hist_count(
+            m.tokenization_backend_latency, backend="_EchoBackend", op="encode"
+        )
+        comp.encode("one two three", MODEL)
+        after = _hist_count(
+            m.tokenization_backend_latency, backend="_EchoBackend", op="encode"
+        )
+        assert after > before
+
+    def test_fallback_counts_failed_backend_and_times_winner(self):
+        comp = CompositeTokenizer([_FailingBackend(), _EchoBackend()])
+        before_fb = m.tokenization_backend_fallbacks.labels(
+            backend="_FailingBackend", op="encode"
+        )._value.get()
+        comp.encode("hello there", MODEL)
+        after_fb = m.tokenization_backend_fallbacks.labels(
+            backend="_FailingBackend", op="encode"
+        )._value.get()
+        assert after_fb == before_fb + 1
+
+    def test_render_fallback_labels(self):
+        comp = CompositeTokenizer([_FailingBackend(), _EchoBackend()])
+        before = m.tokenization_backend_fallbacks.labels(
+            backend="_FailingBackend", op="render"
+        )._value.get()
+        assert comp.render_chat_template({"messages": []})
+        assert m.tokenization_backend_fallbacks.labels(
+            backend="_FailingBackend", op="render"
+        )._value.get() == before + 1
