@@ -251,7 +251,8 @@ class FleetSim:
             state, cached = pod.prefill(tokens)
         except OutOfPagesError:
             # Sequence larger than the pod's whole free pool: serve uncached
-            # (count the full prefill) without touching the cache.
+            # (count the full prefill). Any tier traffic the failed allocate
+            # already performed is still charged and counted.
             restored, onboarded = tier_delta()
             return (
                 BETA_OVERHEAD_S

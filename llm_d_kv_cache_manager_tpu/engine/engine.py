@@ -218,29 +218,51 @@ class EnginePod:
         self, tokens: List[int], lora_id: Optional[int] = None
     ) -> Tuple[SequenceState, int]:
         """Admit a sequence: allocate (with prefix reuse), compute the
-        uncached suffix, commit pages + events. Returns (state, cached_tokens)."""
+        uncached suffix in one chunk, commit pages + events. Returns
+        (state, cached_tokens). Chunked admission goes through
+        begin_prefill/prefill_chunk/finish_prefill instead."""
+        state, start = self.begin_prefill(tokens, lora_id=lora_id)
+        self.prefill_chunk(state, start, len(tokens))
+        self.finish_prefill(state)
+        return state, state.num_cached_tokens
+
+    # -- chunked prefill (scheduler drives these; `prefill` = one-shot) ------
+
+    def begin_prefill(
+        self, tokens: List[int], lora_id: Optional[int] = None
+    ) -> Tuple[SequenceState, int]:
+        """Allocate pages (with prefix reuse) without computing anything.
+        Follow with prefill_chunk over [n_cached, len(tokens)) in any chunk
+        sizes, then finish_prefill. Returns (state, compute_start): the
+        position chunked compute must start from (== num_cached_tokens,
+        except fully-cached prompts where the last position is recomputed
+        for logits)."""
         state = self.block_manager.allocate(tokens, lora_id=lora_id)
         n_cached = state.num_cached_tokens
         if n_cached >= len(tokens):
-            # Fully cached (modulo partial tail): recompute only the last
-            # position for logits in model mode; no page writes needed.
             n_cached = min(n_cached, len(tokens) - 1)
+        return state, n_cached
 
-        if self._model is not None:
-            jnp = self._jnp
-            block_table = self._padded_table(state)
-            new_tokens = jnp.asarray(tokens[n_cached:], dtype=jnp.int32)
-            self.kv_cache, self.last_logits = self._model.prefill_cache(
-                self._model_config,
-                self.params,
-                self.kv_cache,
-                new_tokens,
-                block_table,
-                n_cached,
-            )
+    def prefill_chunk(self, state: SequenceState, start: int, end: int) -> None:
+        """Compute KV (and logits) for tokens[start:end], attending over the
+        first `start` already-resident positions. vLLM-style chunked
+        prefill: the scheduler bounds end-start by its token budget so
+        decode ticks interleave with long prompts."""
+        if self._model is None:
+            return  # accounting-only pods have no compute to chunk
+        jnp = self._jnp
+        block_table = self._padded_table(state)
+        chunk = jnp.asarray(state.tokens[start:end], dtype=jnp.int32)
+        self.kv_cache, self.last_logits = self._model.prefill_cache(
+            self._model_config, self.params, self.kv_cache, chunk,
+            block_table, start,
+        )
 
+    def finish_prefill(self, state: SequenceState) -> None:
+        """Commit full pages + emit BlockStored — only now, when every
+        page's KV is actually computed; advertising blocks mid-prefill would
+        let peers onboard garbage."""
         self.block_manager.commit_prefill(state)
-        return state, state.num_cached_tokens
 
     def decode_append(self, state: SequenceState, token: int) -> None:
         """Accounting-only decode: record one generated token."""

@@ -1,12 +1,14 @@
 """Continuous-batching scheduler for EnginePod.
 
 The serving loop a vLLM-style engine runs: a waiting queue admits sequences
-as pages free up (prefill one sequence per step — prefill is the serialized
-resource), while all running sequences decode together in one batched
-`decode_step_cache` call per tick. Per-sequence block tables are padded to a
-shared bucket (EnginePod.table_bucket) so the batch has one static shape per
-(batch-size, bucket) pair — a handful of jit specializations, no dynamic
-shapes.
+as pages free up through a **chunked prefill budget** — each tick computes
+at most `prefill_token_budget` prompt tokens (a long prompt spans ticks,
+several short prompts pack into one), so decode latency for the running
+batch is bounded regardless of arrival sizes. All running sequences decode
+together in one batched `decode_step_cache` call per tick. Per-sequence
+block tables are padded to a shared bucket (EnginePod.table_bucket) so the
+batch has one static shape per (batch-size, bucket) pair — a handful of jit
+specializations, no dynamic shapes.
 
 Capacity policy:
 - `submit` rejects deterministically (empty result, `Request.error` set) any
@@ -46,16 +48,30 @@ class Request:
     state: Optional[SequenceState] = None
     generated: List[int] = field(default_factory=list)
     num_cached_tokens: int = 0
+    # Chunked-prefill progress: next prompt position to compute, or None
+    # when not mid-prefill.
+    prefill_pos: Optional[int] = None
     finished: bool = False
     error: Optional[str] = None
 
 
 class Scheduler:
-    def __init__(self, pod: EnginePod, max_batch: int = 8):
+    def __init__(
+        self,
+        pod: EnginePod,
+        max_batch: int = 8,
+        prefill_token_budget: int = 512,
+    ):
         if pod._model is None:
             raise ValueError("Scheduler requires an EnginePod with with_model=True")
+        if prefill_token_budget < 1:
+            raise ValueError("prefill_token_budget must be >= 1")
         self.pod = pod
         self.max_batch = max_batch
+        # vLLM-style chunked prefill: at most this many prompt tokens are
+        # computed per tick, so a long-prompt arrival cannot stall the
+        # running batch's decode for more than ~budget tokens of compute.
+        self.prefill_token_budget = prefill_token_budget
         self._waiting: deque = deque()
         self._running: List[Request] = []
         self._rejected: List[Request] = []
@@ -88,11 +104,12 @@ class Scheduler:
         return bool(self._waiting or self._running or self._rejected)
 
     def step(self) -> List[Request]:
-        """One scheduler tick: surface rejections, admit (prefill) at most
-        one sequence, then one batched decode across running sequences.
-        Returns newly finished requests (pages freed; cache stays warm)."""
+        """One scheduler tick: surface rejections, spend the prefill token
+        budget (chunked, possibly across several waiting sequences), then
+        one batched decode across running sequences. Returns newly finished
+        requests (pages freed; cache stays warm)."""
         finished, self._rejected = self._rejected, []
-        finished += self._admit()
+        finished += self._prefill_tick()
         finished += self._decode()
         return finished
 
@@ -132,37 +149,58 @@ class Scheduler:
         self.pod.free(req.state)
         req.prompt_tokens = list(req.state.tokens)
         req.state = None
+        req.prefill_pos = None
         self._waiting.appendleft(req)
 
-    def _admit(self) -> List[Request]:
-        if not self._waiting or len(self._running) >= self.max_batch:
-            return []
-        req = self._waiting[0]
-        try:
-            state, cached = self.pod.prefill(req.prompt_tokens, lora_id=req.lora_id)
-        except OutOfPagesError:
-            return []  # retry next tick once decodes free pages
-        self._waiting.popleft()
-        req.state = state
-        req.num_cached_tokens = cached
-        # Next generated token comes from the prefill logits (for a
-        # re-admitted preempted request this continues its generation).
-        jnp = self.pod._jnp
-        token = int(jnp.argmax(self.pod.last_logits))
-        req.generated.append(token)
-        # A finished sequence never attends again — skip the (possibly
-        # page-allocating) KV write for its final token.
-        if self._done(req, token):
-            req.finished = True
-            self.pod.free(state)
-            return [req]
-        try:
-            self.pod.decode_append(state, token)
-        except OutOfPagesError:
-            self._preempt(req)  # token folds into the recompute prompt
-            return []
-        self._running.append(req)
-        return []
+    def _prefill_tick(self) -> List[Request]:
+        """Spend up to prefill_token_budget prompt tokens of compute. Long
+        prompts span ticks (decode keeps running in between); short prompts
+        pack — several can admit in one tick if the budget covers them."""
+        finished: List[Request] = []
+        budget = self.prefill_token_budget
+        while budget > 0 and self._waiting and len(self._running) < self.max_batch:
+            req = self._waiting[0]
+            if req.state is None:
+                try:
+                    state, start = self.pod.begin_prefill(
+                        req.prompt_tokens, lora_id=req.lora_id
+                    )
+                except OutOfPagesError:
+                    break  # retry next tick once decodes free pages
+                req.state = state
+                req.num_cached_tokens = state.num_cached_tokens
+                req.prefill_pos = start
+
+            end = min(req.prefill_pos + budget, len(req.prompt_tokens))
+            if end > req.prefill_pos:
+                self.pod.prefill_chunk(req.state, req.prefill_pos, end)
+                budget -= end - req.prefill_pos
+                req.prefill_pos = end
+            if req.prefill_pos < len(req.prompt_tokens):
+                break  # budget exhausted mid-prompt; resume next tick
+
+            # Prompt fully prefilled: commit pages/events, sample the first
+            # token from the final chunk's logits (for a re-admitted
+            # preempted request this continues its generation).
+            self.pod.finish_prefill(req.state)
+            self._waiting.popleft()
+            req.prefill_pos = None
+            token = int(self.pod._jnp.argmax(self.pod.last_logits))
+            req.generated.append(token)
+            # A finished sequence never attends again — skip the (possibly
+            # page-allocating) KV write for its final token.
+            if self._done(req, token):
+                req.finished = True
+                self.pod.free(req.state)
+                finished.append(req)
+                continue
+            try:
+                self.pod.decode_append(req.state, token)
+            except OutOfPagesError:
+                self._preempt(req)  # token folds into the recompute prompt
+                continue
+            self._running.append(req)
+        return finished
 
     @staticmethod
     def _done(req: Request, token: int) -> bool:

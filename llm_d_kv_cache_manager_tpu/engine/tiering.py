@@ -29,7 +29,10 @@ from __future__ import annotations
 from collections import OrderedDict
 from typing import TYPE_CHECKING, Callable, Dict, List, Mapping, Optional, Tuple
 
-from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import (
+    Key,
+    base_pod_identifier,
+)
 from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
 
 if TYPE_CHECKING:  # kv_connectors loads the ctypes lib; keep it optional at
@@ -187,11 +190,14 @@ class IndexBackedPeerResolver:
         key = Key(self.model_name, chunk_hash)
         hits = self.index.lookup([key], set())
         for entry in hits.get(key, []):
-            if entry.pod_identifier == self.self_pod_id:
+            # Compare/resolve by bare pod identity: DP-ranked engines index
+            # as "pod@dpR" but the address map (and we) know bare pod ids.
+            bare = base_pod_identifier(entry.pod_identifier)
+            if bare == base_pod_identifier(self.self_pod_id):
                 continue
             if entry.device_tier != self.host_tier:
                 continue  # only staged blocks are fetchable
-            addr = self.pod_addrs.get(entry.pod_identifier)
+            addr = self.pod_addrs.get(entry.pod_identifier) or self.pod_addrs.get(bare)
             if addr is not None:
                 return addr
         return None

@@ -18,7 +18,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Set, Union
 
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index
-from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry, pod_matches
 from llm_d_kv_cache_manager_tpu.utils.humansize import parse_human_size
 from llm_d_kv_cache_manager_tpu.utils.lru import LRUCache
 
@@ -87,7 +87,8 @@ class CostAwareMemoryIndex(Index):
                     return pods_per_key  # prefix chain breaks here
                 if pod_identifier_set:
                     entries = [
-                        e for e in entries if e.pod_identifier in pod_identifier_set
+                        e for e in entries
+                        if pod_matches(e.pod_identifier, pod_identifier_set)
                     ]
                     if entries:
                         pods_per_key[key] = entries

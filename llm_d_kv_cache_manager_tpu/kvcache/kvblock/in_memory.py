@@ -18,7 +18,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Set
 
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index
-from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry, pod_matches
 from llm_d_kv_cache_manager_tpu.utils.lru import LRUCache
 from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
 
@@ -68,7 +68,10 @@ class InMemoryIndex(Index):
                 kvlog.trace(logger, "no pods for key, cutting search: %s", key)
                 return pods_per_key
             if pod_identifier_set:
-                entries = [e for e in entries if e.pod_identifier in pod_identifier_set]
+                entries = [
+                    e for e in entries
+                    if pod_matches(e.pod_identifier, pod_identifier_set)
+                ]
                 if entries:
                     pods_per_key[key] = entries
             else:

@@ -11,7 +11,26 @@ read-path lookups (which only ever see tokens) land on the same keys.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+import re
+from typing import Container, NamedTuple
+
+_DP_SUFFIX_RE = re.compile(r"@dp\d+$")
+
+
+def base_pod_identifier(pod_identifier: str) -> str:
+    """Strip the DP-rank qualifier the event pool appends ("pod@dp3" →
+    "pod"). Routers and address maps know pods by their bare identity; the
+    index stores the ranked one so DP>1 caches don't alias."""
+    return _DP_SUFFIX_RE.sub("", pod_identifier)
+
+
+def pod_matches(pod_identifier: str, pod_identifier_set: Container[str]) -> bool:
+    """Membership test for lookup filters: a ranked identity matches both
+    its exact form and its bare pod name."""
+    return (
+        pod_identifier in pod_identifier_set
+        or base_pod_identifier(pod_identifier) in pod_identifier_set
+    )
 
 
 class Key(NamedTuple):

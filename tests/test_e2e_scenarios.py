@@ -1,0 +1,401 @@
+"""E2E scenario suite over a real ZMQ event loop + fake Redis backend.
+
+Port of the reference's redis_mock e2e suite
+(/root/reference/tests/e2e/redis_mock/e2e_test.go:109-936): a full Indexer
+(block size 4, Redis-backed index against the in-process FakeRedisServer —
+the miniredis analogue), fed by genuine msgpack KVEvents through the bound
+ZMQ subscriber. Scenarios: cache hit/miss, prefix reduction/expansion,
+long-prefix expansion, chat completions (single + multi-turn through the
+real transformers templating path), local-tokenizer discovery variants
+(HF-cache and plain layouts), composite fallback, error handling, event
+eviction, and LoRA scoping (beyond the reference).
+"""
+
+import itertools
+import os
+import time
+import uuid
+
+import pytest
+
+from tests.conftest import FIXTURES_DIR, TEST_MODEL_NAME, TEST_TOKENIZER_JSON
+from tests.fake_redis import FakeRedisServer
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import IndexConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import (
+    RedisIndex,
+    RedisIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.events import (
+    BlockRemoved,
+    BlockStored,
+    EventBatch,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.pool import EventPool, EventPoolConfig
+from llm_d_kv_cache_manager_tpu.kvevents.publisher import Publisher, make_topic
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPool,
+    TokenizersPoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.preprocessing.chat_completions import (
+    ChatTemplatingProcessor,
+    RenderRequest,
+)
+
+BLOCK_SIZE = 4
+POD1 = "10.0.0.1"
+POD2 = "10.0.0.2"
+
+LOREM_FULL = (
+    "lorem ipsum dolor sit amet, consectetur adipiscing elit. Sed do eiusmod "
+    "tempor incididunt ut labore et dolore magna aliqua. Ut enim ad minim "
+    "veniam, quis nostrud exercitation ullamco laboris nisi ut aliquip ex ea "
+    "commodo consequat."
+)
+LOREM_MID = (
+    "lorem ipsum dolor sit amet, consectetur adipiscing elit. Sed do eiusmod "
+    "tempor incididunt ut labore et dolore magna aliqua."
+)
+LOREM_SHORT = "lorem ipsum dolor sit amet, consectetur adipiscing elit."
+
+SIMPLE_TEMPLATE = (
+    "{% for m in messages %}<|{{ m.role }}|>{{ m.content }}{% endfor %}"
+    "{% if add_generation_prompt %}<|assistant|>{% endif %}"
+)
+
+_hash_counter = itertools.count(10_000)
+
+
+class E2EEnv:
+    """The suite fixture: indexer + Redis index + live ZMQ write plane."""
+
+    def __init__(self, tmp_path, tokenizer_files=None):
+        self.redis = FakeRedisServer()
+        self.index = RedisIndex(RedisIndexConfig(url=self.redis.url))
+        self.endpoint = f"ipc://{tmp_path}/e2e-{uuid.uuid4().hex[:8]}.sock"
+        self.tokenization_pool = TokenizationPool(
+            TokenizersPoolConfig(
+                workers=2,
+                local_tokenizer_files=(
+                    tokenizer_files
+                    if tokenizer_files is not None
+                    else {TEST_MODEL_NAME: TEST_TOKENIZER_JSON}
+                ),
+            ),
+            chat_templating=ChatTemplatingProcessor(),
+        )
+        self.indexer = Indexer(
+            config=IndexerConfig(
+                token_processor_config=TokenProcessorConfig(block_size=BLOCK_SIZE),
+                kv_block_index_config=IndexConfig(),
+            ),
+            tokenization_pool=self.tokenization_pool,
+            kv_block_index=self.index,
+        )
+        self.indexer.run()
+        self.event_pool = EventPool(
+            EventPoolConfig(zmq_endpoint=self.endpoint, concurrency=2),
+            self.index,
+            self.indexer.token_processor,
+        )
+        self.event_pool.start(with_subscriber=True)
+        self._publishers = {}
+
+    def close(self):
+        for p in self._publishers.values():
+            p.close()
+        self.event_pool.shutdown()
+        self.indexer.shutdown()
+        self.index.close()
+        self.redis.close()
+
+    # -- helpers -----------------------------------------------------------
+
+    def tokens_for(self, prompt, model=TEST_MODEL_NAME):
+        return self.tokenization_pool.tokenizer.encode(prompt, model).tokens
+
+    def keys_for(self, prompt, model=TEST_MODEL_NAME, lora_id=None):
+        return self.indexer.token_processor.tokens_to_kv_block_keys(
+            None, self.tokens_for(prompt, model), model, lora_id=lora_id
+        )
+
+    def publisher(self, pod, model=TEST_MODEL_NAME):
+        key = (pod, model)
+        if key not in self._publishers:
+            self._publishers[key] = Publisher(self.endpoint, make_topic(pod, model))
+            time.sleep(0.3)  # ZMQ slow-joiner
+        return self._publishers[key]
+
+    def publish_cached(self, pod, prompt, model=TEST_MODEL_NAME, lora_id=None):
+        """Publish BlockStored as the engine would for this prompt; returns
+        the engine hashes used."""
+        tokens = self.tokens_for(prompt, model)
+        n_blocks = len(tokens) // BLOCK_SIZE
+        engine_hashes = [next(_hash_counter) for _ in range(n_blocks)]
+        self.publisher(pod, model).publish(EventBatch(
+            ts=time.monotonic(),
+            events=[BlockStored(
+                engine_hashes, None, tokens[: n_blocks * BLOCK_SIZE],
+                BLOCK_SIZE, lora_id=lora_id,
+            )],
+        ))
+        return engine_hashes
+
+    def publish_removed(self, pod, engine_hashes, model=TEST_MODEL_NAME):
+        self.publisher(pod, model).publish(EventBatch(
+            ts=time.monotonic(),
+            events=[BlockRemoved(list(engine_hashes))],
+        ))
+
+    def scores(self, prompt, pods=(), model=TEST_MODEL_NAME, **kw):
+        return self.indexer.get_pod_scores(prompt, model, list(pods), **kw)
+
+    def wait_score(self, prompt, pod, min_score=1, timeout=10.0, **kw):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            s = self.scores(prompt, **kw)
+            if s.get(pod, 0) >= min_score:
+                return s
+            time.sleep(0.05)
+        raise AssertionError(
+            f"{pod} never reached score {min_score}; last: {self.scores(prompt, **kw)}"
+        )
+
+
+@pytest.fixture
+def env(tmp_path):
+    e = E2EEnv(tmp_path)
+    yield e
+    e.close()
+
+
+def _matching_prefix_len(keys_a, keys_b):
+    n = 0
+    for a, b in zip(keys_a, keys_b):
+        if a != b:
+            break
+        n += 1
+    return n
+
+
+class TestCacheHitMiss:
+    def test_cache_hit(self, env):
+        env.publish_cached(POD1, LOREM_MID)
+        scores = env.wait_score(LOREM_MID, POD1)
+        assert scores[POD1] >= len(env.keys_for(LOREM_MID))
+
+    def test_cache_miss(self, env):
+        assert env.scores("What is the capital of France?", [POD1]) == {}
+
+    def test_filtered_pod_set_excludes_other_pods(self, env):
+        env.publish_cached(POD1, LOREM_MID)
+        env.wait_score(LOREM_MID, POD1)
+        assert POD1 not in env.scores(LOREM_MID, [POD2])
+
+
+class TestPrefixScenarios:
+    def test_prefix_reduction(self, env):
+        # e2e_test.go:135-169: cache the FULL prompt, then query
+        # progressively shorter prefixes — each still scores.
+        assert env.scores(LOREM_FULL, [POD1]) == {}
+        env.publish_cached(POD1, LOREM_FULL)
+        env.wait_score(LOREM_FULL, POD1)
+
+        full_keys = env.keys_for(LOREM_FULL)
+        for prompt in (LOREM_MID, LOREM_SHORT):
+            keys = env.keys_for(prompt)
+            expected = _matching_prefix_len(keys, full_keys)
+            assert expected > 0, "sub-prompt chains must share a prefix"
+            scores = env.scores(prompt, [POD1])
+            assert scores.get(POD1, 0) == expected
+
+    def test_prefix_expansion(self, env):
+        # e2e_test.go:171-205: cache short; a longer prompt scores exactly
+        # the short chain; cache mid; full scores the mid chain.
+        assert env.scores(LOREM_SHORT, [POD1]) == {}
+        env.publish_cached(POD1, LOREM_SHORT)
+        short_keys = env.keys_for(LOREM_SHORT)
+        env.wait_score(LOREM_SHORT, POD1, min_score=len(short_keys))
+
+        mid_keys = env.keys_for(LOREM_MID)
+        assert env.scores(LOREM_MID, [POD1])[POD1] == _matching_prefix_len(
+            mid_keys, short_keys
+        )
+
+        env.publish_cached(POD1, LOREM_MID)
+        env.wait_score(LOREM_MID, POD1, min_score=len(mid_keys))
+        full_keys = env.keys_for(LOREM_FULL)
+        assert env.scores(LOREM_FULL, [POD1])[POD1] == _matching_prefix_len(
+            full_keys, mid_keys
+        )
+
+    def test_long_prefix_expansion(self, env):
+        # e2e_test.go:207-245 at ~4500-token scale.
+        base = "The quick brown fox jumps over the lazy dog "
+        short, mid, long_ = base * 2, base * 100, base * 500
+
+        assert env.scores(short, [POD1]) == {}
+        env.publish_cached(POD1, short)
+        env.wait_score(mid, POD1)
+
+        env.publish_cached(POD1, mid)
+        mid_keys = env.keys_for(mid)
+        # The read path serves prefix-store tokens at >=0.8 coverage (the
+        # latency/exactness trade both we and the reference make), so the
+        # score floor is 80% of the chain, not 100%.
+        floor = int(len(mid_keys) * 0.8)
+        env.wait_score(mid, POD1, min_score=floor)
+        scores = env.scores(long_, [POD1])
+        assert scores[POD1] >= floor
+
+
+class TestChatCompletions:
+    def _render_request(self, messages):
+        return RenderRequest(
+            conversations=[messages], chat_template=SIMPLE_TEMPLATE
+        )
+
+    def test_single_turn(self, env):
+        # e2e_test.go:247-305: score via the real templating path, publish
+        # the rendered prompt's blocks, score again — cache hit.
+        messages = [{"role": "user", "content": "What is the capital of France? " * 8}]
+        req = self._render_request(messages)
+        assert env.scores("", render_request=req) == {}
+
+        rendered = env.tokenization_pool.tokenizer.render_chat_template(req)
+        assert rendered.startswith("<|user|>")
+        env.publish_cached(POD1, rendered)
+        env.wait_score("", POD1, render_request=req)
+
+    def test_multi_turn_extends_prefix(self, env):
+        # e2e_test.go:688-804: each turn extends the conversation; the next
+        # turn's score grows with the shared rendered prefix.
+        messages = [
+            {"role": "system", "content": "You are a terse assistant. " * 6},
+            {"role": "user", "content": "First question, with enough words to fill blocks?"},
+        ]
+        req1 = self._render_request(messages)
+        rendered1 = env.tokenization_pool.tokenizer.render_chat_template(req1)
+        env.publish_cached(POD1, rendered1)
+        score1 = env.wait_score("", POD1, render_request=req1)[POD1]
+
+        messages2 = messages + [
+            {"role": "assistant", "content": "First answer."},
+            {"role": "user", "content": "Second question?"},
+        ]
+        req2 = self._render_request(messages2)
+        rendered2 = env.tokenization_pool.tokenizer.render_chat_template(req2)
+        assert rendered2.startswith(rendered1[: len(rendered1) - 40])
+        env.publish_cached(POD1, rendered2)
+        score2 = env.wait_score(
+            "", POD1, min_score=int(score1) + 1, render_request=req2
+        )[POD1]
+        assert score2 > score1
+
+
+class TestTokenizerDiscovery:
+    def test_hf_cache_layout_discovery(self, env, tmp_path, monkeypatch):
+        # e2e_test.go:478-530: models--org--name/snapshots/<rev>/ resolves
+        # to model "org/name".
+        root = tmp_path / "hub"
+        snap = root / "models--acme--chatty" / "snapshots" / "abc123"
+        snap.mkdir(parents=True)
+        with open(TEST_TOKENIZER_JSON, "rb") as f:
+            (snap / "tokenizer.json").write_bytes(f.read())
+        monkeypatch.setenv("LOCAL_TOKENIZER_DIR", str(root))
+
+        pool = TokenizationPool(TokenizersPoolConfig(workers=1))
+        pool.run()
+        try:
+            tokens = pool.tokenize(None, LOREM_SHORT, "acme/chatty")
+            assert tokens == env.tokens_for(LOREM_SHORT)
+        finally:
+            pool.shutdown()
+
+    def test_mixed_directory_layout_discovery(self, env, tmp_path, monkeypatch):
+        # e2e_test.go:532-592: plain relative-dir layout next to HF-cache.
+        root = tmp_path / "models"
+        plain = root / "plainmodel"
+        plain.mkdir(parents=True)
+        with open(TEST_TOKENIZER_JSON, "rb") as f:
+            (plain / "tokenizer.json").write_bytes(f.read())
+        monkeypatch.setenv("LOCAL_TOKENIZER_DIR", str(root))
+
+        pool = TokenizationPool(TokenizersPoolConfig(workers=1))
+        pool.run()
+        try:
+            tokens = pool.tokenize(None, LOREM_SHORT, "plainmodel")
+            assert tokens == env.tokens_for(LOREM_SHORT)
+        finally:
+            pool.shutdown()
+
+
+class TestCompositeFallbackE2E:
+    def test_uds_down_falls_back_to_local(self, env, tmp_path):
+        # e2e_test.go:426-476 analogue: first backend dead (UDS socket that
+        # doesn't exist), local backend serves, scoring works end to end.
+        pool = TokenizationPool(TokenizersPoolConfig(
+            workers=1,
+            enable_uds=True,
+            uds_socket_path=str(tmp_path / "no-such.sock"),
+            local_tokenizer_files={TEST_MODEL_NAME: TEST_TOKENIZER_JSON},
+        ))
+        # Order is local → UDS → HF, so force the failing one first.
+        pool.tokenizer.backends.reverse()
+        pool.run()
+        try:
+            tokens = pool.tokenize(None, LOREM_SHORT, TEST_MODEL_NAME)
+            assert tokens == env.tokens_for(LOREM_SHORT)
+        finally:
+            pool.shutdown()
+
+
+class TestErrorHandling:
+    def test_unknown_model_raises_cleanly(self, env):
+        with pytest.raises(Exception, match="no-such-model"):
+            env.scores(LOREM_SHORT, [POD1], model="no-such-model")
+
+    def test_malformed_event_does_not_poison_the_loop(self, env):
+        # Reference poison-pill semantics (kvevents/pool.go:182-187): a
+        # garbage frame is dropped; later events still index.
+        pub = env.publisher(POD1)
+        pub._socket.send_multipart(
+            [f"kv@{POD1}@{TEST_MODEL_NAME}".encode(), b"\x00" * 8, b"not msgpack"]
+        )
+        env.publish_cached(POD1, LOREM_MID)
+        env.wait_score(LOREM_MID, POD1)
+
+    def test_chat_template_error_surfaces(self, env):
+        # e2e_test.go:895-934: a broken template is an error, not a hang.
+        req = RenderRequest(
+            conversations=[[{"role": "user", "content": "hi"}]],
+            chat_template="{{ undefined_fn() }}",
+        )
+        with pytest.raises(Exception):
+            env.scores("", render_request=req)
+
+
+class TestEvictionAndLoRA:
+    def test_block_removed_drops_score(self, env):
+        hashes = env.publish_cached(POD1, LOREM_MID)
+        keys = env.keys_for(LOREM_MID)
+        env.wait_score(LOREM_MID, POD1, min_score=len(keys))
+        # Remove the whole chain; score must collapse to empty.
+        env.publish_removed(POD1, hashes)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if env.scores(LOREM_MID, [POD1]) == {}:
+                break
+            time.sleep(0.05)
+        assert env.scores(LOREM_MID, [POD1]) == {}
+
+    def test_lora_scoped_cache_is_disjoint(self, env):
+        # Beyond the reference (its LoRA parity test is a skipped TODO):
+        # blocks cached under an adapter only score for that adapter.
+        env.publish_cached(POD1, LOREM_MID, lora_id=7)
+        env.wait_score(LOREM_MID, POD1, lora_id=7)
+        assert env.scores(LOREM_MID, [POD1]) == {}  # base keyspace: miss
+        assert env.scores(LOREM_MID, [POD1], lora_id=8) == {}  # other adapter

@@ -266,3 +266,32 @@ class TestInstrumentedMetrics:
         assert m.index_admissions._value.get() == before_adds + 2
         assert m.index_lookup_requests._value.get() == before_lookups + 1
         assert m.index_max_pod_hits._sum.get() >= 2
+
+
+class TestDPRankedIdentities:
+    """Ranked identities ("pod@dpR") must round-trip every backend with the
+    rank intact and match bare-pod lookup filters."""
+
+    def test_redis_field_roundtrip_preserves_rank_and_tier(self):
+        index = _redis_backend()
+        entry = PodEntry("pod-1@dp0", "hbm")
+        index.add([_k(1)], [_k(1)], [entry])
+        got = index.lookup([_k(1)], set())
+        assert got[_k(1)] == [entry]  # not PodEntry("pod-1", "dp0@hbm")
+        # Bare-name filter matches the ranked entry.
+        assert index.lookup([_k(1)], {"pod-1"})[_k(1)] == [entry]
+        # Evict by the exact entry works (field re-serialization matches).
+        index.evict(_k(1), [entry])
+        assert index.lookup([_k(1)], set()) == {}
+        index.close()
+
+    def test_all_backends_match_bare_filter(self):
+        for name, factory in BACKENDS.items():
+            index = factory()
+            entry = PodEntry("pod-9@dp3", "host")
+            index.add([_k(7)], [_k(7)], [entry])
+            got = index.lookup([_k(7)], {"pod-9"})
+            assert got[_k(7)] == [entry], f"backend {name}"
+            assert index.lookup([_k(7)], {"pod-9@dp3"})[_k(7)] == [entry]
+            if hasattr(index, "close"):
+                index.close()

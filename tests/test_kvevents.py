@@ -148,6 +148,68 @@ class TestEventPool:
         finally:
             pool.shutdown()
 
+    def test_dp_ranks_of_one_pod_do_not_alias(self):
+        # VERDICT r1 #9: a DP>1 engine runs one KV cache per rank; rank r's
+        # events index under "pod@dpR" so the scorer never credits the pod
+        # for blocks only one rank holds. (The reference decodes
+        # DataParallelRank and drops it, events.go:42.)
+        pool, index, processor = _make_pool()
+        try:
+            tokens_r0 = [1, 2, 3, 4]
+            tokens_r1 = [5, 6, 7, 8]
+            pool.add_task(_msg(EventBatch(
+                ts=0.0, events=[BlockStored([100], None, tokens_r0, 4)],
+                data_parallel_rank=0,
+            )))
+            pool.add_task(_msg(EventBatch(
+                ts=0.0, events=[BlockStored([200], None, tokens_r1, 4)],
+                data_parallel_rank=1,
+            )))
+            pool.drain()
+            keys_r0 = processor.tokens_to_kv_block_keys(None, tokens_r0, "m")
+            keys_r1 = processor.tokens_to_kv_block_keys(None, tokens_r1, "m")
+            assert index.lookup(keys_r0, set())[keys_r0[0]] == [
+                PodEntry("pod-1@dp0", "hbm")
+            ]
+            assert index.lookup(keys_r1, set())[keys_r1[0]] == [
+                PodEntry("pod-1@dp1", "hbm")
+            ]
+        finally:
+            pool.shutdown()
+
+    def test_ranked_identity_matches_bare_pod_filter(self):
+        # Routers filter by the bare pod names they discover; ranked
+        # entries must still match (and come back with their rank so the
+        # router can target the owning rank).
+        pool, index, processor = _make_pool()
+        try:
+            tokens = [1, 2, 3, 4]
+            pool.add_task(_msg(EventBatch(
+                ts=0.0, events=[BlockStored([100], None, tokens, 4)],
+                data_parallel_rank=2,
+            )))
+            pool.drain()
+            keys = processor.tokens_to_kv_block_keys(None, tokens, "m")
+            got = index.lookup(keys, {"pod-1"})  # bare name filter
+            assert got[keys[0]] == [PodEntry("pod-1@dp2", "hbm")]
+            assert index.lookup(keys, {"pod-other"}) == {}
+        finally:
+            pool.shutdown()
+
+    def test_invalid_dp_rank_falls_back_to_bare_pod_identity(self):
+        pool, index, processor = _make_pool()
+        try:
+            tokens = [1, 2, 3, 4]
+            pool.add_task(_msg(EventBatch(
+                ts=0.0, events=[BlockStored([100], None, tokens, 4)],
+                data_parallel_rank="three",  # wire garbage
+            )))
+            pool.drain()
+            keys = processor.tokens_to_kv_block_keys(None, tokens, "m")
+            assert index.lookup(keys, set())[keys[0]] == [PodEntry("pod-1", "hbm")]
+        finally:
+            pool.shutdown()
+
     def test_medium_overrides_tier(self):
         pool, index, processor = _make_pool()
         try:

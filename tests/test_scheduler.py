@@ -111,3 +111,55 @@ class TestScheduler:
         req = sched.submit(list(range(8)), max_new_tokens=10, eos_token=probe)
         results = sched.run()
         assert results[req] == [probe]  # stopped at the first token
+
+
+class TestChunkedPrefill:
+    """VERDICT r1 #10: prefill token budget per tick, interleaved with
+    decode (vLLM-style), replacing one-admission-per-tick."""
+
+    def test_chunked_equals_unchunked(self):
+        # f32 model: chunked prefill is the same math in different slices,
+        # so greedy generation must match exactly.
+        prompt = list(range(2, 50))  # 48 tokens -> 6 chunks at budget 8
+        expected = None
+        for budget in (4096, 8):
+            sched = Scheduler(_pod(), prefill_token_budget=budget)
+            rid = sched.submit(prompt, max_new_tokens=6)
+            out = sched.run()[rid]
+            assert len(out) == 6
+            if expected is None:
+                expected = out
+            else:
+                assert out == expected
+
+    def test_long_prompt_does_not_stall_decode(self):
+        pod = _pod(n_pages=128)
+        sched = Scheduler(pod, max_batch=4, prefill_token_budget=8)
+        short = sched.submit(list(range(5)), max_new_tokens=40)
+        sched.step()  # short admitted (5 <= budget), starts decoding
+        assert len(sched._running) == 1
+        short_req = sched._running[0]
+
+        long_id = sched.submit(list(range(60, 108)), max_new_tokens=2)  # 48 tok
+        ticks = 0
+        done_ids = []
+        while long_id not in done_ids:
+            gen_before = len(short_req.generated)
+            done_ids += [r.req_id for r in sched.step()]
+            ticks += 1
+            # The running batch decoded every tick while the long prompt
+            # was being prefilled in chunks — bounded decode stall.
+            assert len(short_req.generated) == gen_before + 1
+            assert ticks < 20, "long prompt never finished prefilling"
+        assert ticks >= 48 // 8  # the prompt really did span multiple ticks
+
+    def test_budget_packs_multiple_short_prompts_in_one_tick(self):
+        sched = Scheduler(_pod(), max_batch=4, prefill_token_budget=512)
+        for i in range(3):
+            sched.submit(list(range(i * 10, i * 10 + 8)), max_new_tokens=4)
+        sched.step()
+        assert len(sched._running) == 3  # all admitted in a single tick
+
+    def test_budget_validation(self):
+        with pytest.raises(ValueError, match="prefill_token_budget"):
+            Scheduler(_pod(), prefill_token_budget=0)
