@@ -84,10 +84,15 @@ def device_section() -> str:
         )
     out += [
         "",
-        f"**Overhead-corrected (differences cancel the fixed "
-        f"~{an['fixed_dispatch_overhead_ms']:.0f}ms dispatch overhead): "
-        f"prefill runs at {an['prefill_marginal_tflops']} TFLOP/s marginal "
-        f"= {an['prefill_marginal_mfu']:.1%} MFU.**",
+        (
+            f"**Overhead-corrected (differences cancel the fixed "
+            f"~{an['fixed_dispatch_overhead_ms']:.0f}ms dispatch overhead): "
+            f"prefill runs at {an['prefill_marginal_tflops']} TFLOP/s marginal "
+            f"= {an['prefill_marginal_mfu']:.1%} MFU.**"
+            if "prefill_marginal_mfu" in an
+            else "Overhead-corrected prefill analysis unavailable for this run "
+                 "(needs >=2 seq lengths with increasing times)."
+        ),
         "",
         "Decode (paged flash-decoding kernel, ctx 2048):",
         "",
@@ -102,11 +107,16 @@ def device_section() -> str:
         )
     out += [
         "",
-        f"Marginal decode cost is {an['decode_marginal_ms_per_seq']}ms per "
-        f"sequence at ctx 2048 — the kernel streams KV at "
-        f"{an['decode_kv_stream_gbps_per_seq']} GB/s per sequence "
-        f"({an['decode_kv_stream_pct_of_hbm']}% of HBM), the current "
-        "optimization target.",
+        (
+            f"Marginal decode cost is {an['decode_marginal_ms_per_seq']}ms per "
+            f"sequence at ctx 2048 — the kernel streams KV at "
+            f"{an['decode_kv_stream_gbps_per_seq']} GB/s per sequence "
+            f"({an['decode_kv_stream_pct_of_hbm']}% of HBM), the current "
+            "optimization target."
+            if "decode_marginal_ms_per_seq" in an
+            else "Marginal decode analysis unavailable for this run "
+                 "(needs >=2 batch sizes with increasing times)."
+        ),
         "",
         f"Fidelity flags: {d['fidelity_flags'] or 'none — all numbers are physically plausible'}.",
     ]

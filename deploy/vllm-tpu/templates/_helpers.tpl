@@ -1,0 +1,50 @@
+{{/*
+Shared helpers + fleet-invariant validation.
+
+The two values every component must agree on — hashSeed (vLLM
+PYTHONHASHSEED == manager TokenProcessor hash_seed) and blockSize (engine
+page size == manager block size) — live ONLY at .Values root; templates
+must reference them through these helpers so a per-component override
+cannot be introduced by accident. validateInvariants fails the render
+early with an actionable message.
+*/}}
+
+{{- define "kvcache.name" -}}
+{{- default .Chart.Name .Values.nameOverride | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "kvcache.labels" -}}
+app.kubernetes.io/name: {{ include "kvcache.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end -}}
+
+{{- define "kvcache.hashSeed" -}}
+{{- required "hashSeed is required: it must equal the vLLM fleet's PYTHONHASHSEED or every pod score is silently 0" .Values.hashSeed -}}
+{{- end -}}
+
+{{- define "kvcache.blockSize" -}}
+{{- $bs := int (required "blockSize is required: manager block size must equal the engine page size" .Values.blockSize) -}}
+{{- if not (has $bs (list 16 32 64 128)) -}}
+{{- fail (printf "blockSize %d is not a supported engine page size (16|32|64|128)" $bs) -}}
+{{- end -}}
+{{- $bs -}}
+{{- end -}}
+
+{{- define "kvcache.validateInvariants" -}}
+{{- include "kvcache.hashSeed" . | trim -}}
+{{- include "kvcache.blockSize" . | trim -}}
+{{- if and .Values.valkey.enabled (not .Values.manager.indexUrl) -}}
+{{- /* default wiring: manager uses the chart's valkey */ -}}
+{{- else if and (not .Values.valkey.enabled) (not .Values.manager.indexUrl) (gt (int .Values.manager.replicas) 1) -}}
+{{- fail "manager.replicas > 1 requires a shared index: enable valkey or set manager.indexUrl" -}}
+{{- end -}}
+{{- end -}}
+
+{{- define "kvcache.indexUrl" -}}
+{{- if .Values.manager.indexUrl -}}
+{{- .Values.manager.indexUrl -}}
+{{- else if .Values.valkey.enabled -}}
+valkey://{{ include "kvcache.name" . }}-valkey:{{ .Values.valkey.port }}
+{{- end -}}
+{{- end -}}

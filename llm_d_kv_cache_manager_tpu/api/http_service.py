@@ -54,6 +54,11 @@ def config_from_env() -> dict:
         "hf_token": os.environ.get("HF_TOKEN"),
         "enable_hf": os.environ.get("ENABLE_HF_TOKENIZER", "") == "1",
         "enable_metrics": os.environ.get("ENABLE_METRICS", "1") == "1",
+        # Shared index backend (redis:// or valkey:// URL) for multi-replica
+        # managers; empty -> in-memory index.
+        "index_url": os.environ.get("INDEX_URL", ""),
+        # UDS tokenizer sidecar socket; empty -> local tokenization only.
+        "uds_socket": os.environ.get("UDS_SOCKET", ""),
     }
 
 
@@ -68,13 +73,24 @@ class ScoringService:
         if indexer is not None:  # injected (tests / embedding)
             self.indexer = indexer
         else:
+            index_config = IndexConfig.default()
+            if env.get("index_url"):
+                from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import (
+                    RedisIndexConfig,
+                )
+
+                index_config = IndexConfig(
+                    redis_config=RedisIndexConfig(url=env["index_url"])
+                )
             indexer_config = IndexerConfig(
                 token_processor_config=TokenProcessorConfig(
                     block_size=env["block_size"], hash_seed=env["hash_seed"]
                 ),
-                kv_block_index_config=IndexConfig.default(),
+                kv_block_index_config=index_config,
                 tokenizers_pool_config=TokenizersPoolConfig(
                     enable_local=True,
+                    enable_uds=bool(env.get("uds_socket")),
+                    uds_socket_path=env.get("uds_socket") or None,
                     enable_hf=env["enable_hf"],
                     hf_auth_token=env.get("hf_token"),
                 ),

@@ -145,12 +145,19 @@ class Scheduler:
 
     def _preempt(self, req: Request) -> None:
         """Recompute preemption: release pages (prefix stays cached), fold
-        generated tokens into the prompt, rejoin the queue at the front."""
+        generated tokens into the prompt, rejoin the queue at the front —
+        but never ahead of a mid-prefill request. That request holds its
+        allocated pages and only makes progress at the queue head; queueing
+        in front of it would deadlock the loop (it can't resume, its pages
+        can't free, nothing else can allocate)."""
         self.pod.free(req.state)
         req.prompt_tokens = list(req.state.tokens)
         req.state = None
         req.prefill_pos = None
-        self._waiting.appendleft(req)
+        if self._waiting and self._waiting[0].state is not None:
+            self._waiting.insert(1, req)
+        else:
+            self._waiting.appendleft(req)
 
     def _prefill_tick(self) -> List[Request]:
         """Spend up to prefill_token_budget prompt tokens of compute. Long

@@ -92,7 +92,9 @@ class UDSTokenizer(Tokenizer):
 
     def encode(self, prompt: str, model_name: str) -> TokenizationResult:
         data = self._request(
-            "/tokenize", {"prompt": prompt, "model": model_name, "add_special_tokens": True}
+            # No add_special_tokens: the sidecar's configured default + BOS
+            # dedup decide (templated prompts may already carry BOS).
+            "/tokenize", {"prompt": prompt, "model": model_name}
         )
         tokens: List[int] = list(data["input_ids"])
         char_offsets = [tuple(o) for o in data.get("offset_mapping", [])]

@@ -46,7 +46,9 @@ def make_app(service: TokenizerService) -> web.Application:
             return web.json_response({"error": f"invalid request: {e}"}, status=400)
         try:
             ids, offsets = await asyncio.to_thread(
-                service.encode, prompt, model, body.get("add_special_tokens", True)
+                # None when omitted: the service's configured default + BOS
+                # dedup decide; an explicit client value overrides.
+                service.encode, prompt, model, body.get("add_special_tokens")
             )
         except Exception as e:  # noqa: BLE001
             return web.json_response({"error": str(e)}, status=500)
@@ -115,6 +117,47 @@ async def run_server(
         await runner.cleanup()
 
 
+# -- production entry ---------------------------------------------------------
+
+_worker_service: TokenizerService | None = None
+
+
+def install_uvloop_if_present() -> bool:
+    """Use uvloop's event loop when installed (the reference's production
+    posture, server.py:20-27); the stdlib loop otherwise."""
+    try:
+        import uvloop  # type: ignore
+    except ImportError:
+        return False
+    asyncio.set_event_loop_policy(uvloop.EventLoopPolicy())
+    return True
+
+
+def create_app_for_worker(
+    lock_path: str = "/tmp/tokenizer_init.lock",
+    service_factory=TokenizerService,
+) -> web.Application:
+    """Preforking-server entry (gunicorn `aiohttp.GunicornWebWorker`, or any
+    multi-worker launcher): the first worker to take the flock initializes
+    the shared on-disk state (download dir, socket dir); every worker gets
+    its own in-process TokenizerService. Mirrors the reference's
+    flock-guarded init (server.py:317-353)."""
+    global _worker_service
+    if _worker_service is None:
+        import fcntl
+
+        open(lock_path, "a").close()
+        with open(lock_path, "r+") as lock_file:
+            fcntl.flock(lock_file.fileno(), fcntl.LOCK_EX)
+            try:
+                if _worker_service is None:
+                    logger.info("worker holds init lock; building service")
+                    _worker_service = service_factory()
+            finally:
+                fcntl.flock(lock_file.fileno(), fcntl.LOCK_UN)
+    return make_app(_worker_service)
+
+
 def main() -> None:
     logging.basicConfig(level=logging.INFO)
     parser = argparse.ArgumentParser()
@@ -125,6 +168,7 @@ def main() -> None:
         default=int(os.environ.get("PROBE_PORT", DEFAULT_PROBE_PORT)),
     )
     args = parser.parse_args()
+    install_uvloop_if_present()
     asyncio.run(run_server(args.socket, args.probe_port))
 
 

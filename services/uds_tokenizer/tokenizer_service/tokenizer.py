@@ -1,16 +1,94 @@
 """Tokenizer service backing the UDS sidecar.
 
 Parity target: /root/reference/services/uds_tokenizer/tokenizer_service/
-tokenizer.py — loads tokenizers per model (local dirs or hub downloads when
-allowed), encodes with offsets, renders chat templates, supports config
-hot-reload.
+tokenizer.py:80-270 — per-model tokenizer loading with a local-dir fast
+path, **allow-pattern-filtered remote downloads** (Hugging Face or
+ModelScope), remote-vs-local identifier detection, **BOS-dedup-aware
+encoding with offsets**, chat-template rendering, and config hot-reload
+with a generation guard.
+
+Differences by design (TPU build): tokenization uses the Rust `tokenizers`
+core directly (same library vLLM's fast path wraps) instead of
+AutoTokenizer, so the sidecar stays lean; the download machinery fetches
+only the tokenizer-relevant files and the downloader functions are
+injectable for offline tests.
 """
 
 from __future__ import annotations
 
+import logging
 import os
+import shutil
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
+
+# Only tokenizer-relevant files are fetched from a hub — the reference's
+# allow-pattern list (tokenizer.py:110-118); model weights never download.
+TOKENIZER_ALLOW_PATTERNS = [
+    "tokenizer.json",
+    "tokenizer_config.json",
+    "special_tokens_map.json",
+    "vocab.json",
+    "merges.txt",
+    "config.json",
+    "generation_config.json",
+]
+
+# Files that must exist for a cached download dir to be trusted.
+REQUIRED_FILES = ["tokenizer.json"]
+
+# BOS strings to probe when none is configured; vocab membership decides.
+_BOS_CANDIDATES = ("<s>", "<|begin_of_text|>", "<bos>", "[CLS]")
+
+
+class ModelDownloadError(RuntimeError):
+    pass
+
+
+def _hf_snapshot_download(model: str, local_dir: str) -> None:
+    from huggingface_hub import snapshot_download
+
+    snapshot_download(
+        model, local_dir=local_dir, allow_patterns=TOKENIZER_ALLOW_PATTERNS
+    )
+
+
+def _modelscope_snapshot_download(model: str, local_dir: str) -> None:
+    try:
+        from modelscope import snapshot_download  # type: ignore
+    except ImportError as e:  # pragma: no cover - modelscope not in CI image
+        raise ModelDownloadError(
+            "remote_source=modelscope but the modelscope package is not installed"
+        ) from e
+    snapshot_download(
+        model, local_dir=local_dir, allow_patterns=TOKENIZER_ALLOW_PATTERNS
+    )
+
+
+# Injectable for offline tests (and alternative hubs).
+DOWNLOADERS: Dict[str, Callable[[str, str], None]] = {
+    "hf": _hf_snapshot_download,
+    "modelscope": _modelscope_snapshot_download,
+}
+
+
+def is_remote_model(model_identifier: str) -> bool:
+    """Remote hub name vs local path — reference tokenizer.py:187-207."""
+    if os.path.isabs(model_identifier):
+        return False
+    if model_identifier.startswith(("./", "../")):
+        return False
+    if os.path.exists(model_identifier):
+        return False
+    # Protocol-prefixed URIs (s3://, gs://, ...) are storage paths, not hub
+    # names. (The reference checks `split("/")[0]` which can never contain
+    # "://" — an upstream bug this build does not reproduce.)
+    if "://" in model_identifier:
+        return False
+    # Anything else — "org/model" or a bare legacy hub id like "gpt2" — is a
+    # hub name. (The reference requires a "/", which makes bare ids
+    # undownloadable; hub semantics accept them, so this build does too.)
+    return True
 
 
 class TokenizerService:
@@ -18,7 +96,16 @@ class TokenizerService:
         self._config = {
             "local_tokenizer_dir": os.environ.get("LOCAL_TOKENIZER_DIR", ""),
             "allow_remote": os.environ.get("ALLOW_REMOTE_DOWNLOAD", "") == "1",
+            "remote_source": os.environ.get("REMOTE_SOURCE", "hf"),
+            "download_dir": os.environ.get(
+                "TOKENIZER_DOWNLOAD_DIR", "/tmp/tokenizer-downloads"
+            ),
             "tokenizer_filename": "tokenizer.json",
+            # None = auto: dedup BOS when the prompt already starts with it
+            # (chat templates often bake BOS in — vLLM sets
+            # add_special_tokens=False for templated prompts).
+            "add_special_tokens": None,
+            "bos_token": None,  # None = autodetect from vocab
         }
         if config:
             self._config.update(config)
@@ -43,7 +130,47 @@ class TokenizerService:
             self._tokenizers.clear()  # hot-reload: drop loaded tokenizers
             self._config_generation += 1
 
-    # -- tokenization ----------------------------------------------------------
+    # -- loading ---------------------------------------------------------------
+
+    def _download_remote(self, model: str, config: dict) -> str:
+        """Fetch tokenizer files into download_dir/<model>; returns the
+        tokenizer.json path. Cached dirs are reused; a failed download is
+        cleaned up so a retry starts fresh (reference tokenizer.py:120-127)."""
+        local_model_path = os.path.join(
+            config["download_dir"], model.replace("/", "--")
+        )
+        target = os.path.join(local_model_path, "tokenizer.json")
+        if all(
+            os.path.exists(os.path.join(local_model_path, f))
+            for f in REQUIRED_FILES
+        ):
+            logging.info("using cached tokenizer download at %s", local_model_path)
+            return target
+
+        source = config.get("remote_source", "hf")
+        downloader = DOWNLOADERS.get(source)
+        if downloader is None:
+            raise ModelDownloadError(
+                f"unknown remote_source {source!r}; expected one of "
+                f"{sorted(DOWNLOADERS)}"
+            )
+        os.makedirs(local_model_path, exist_ok=True)
+        try:
+            downloader(model, local_model_path)
+        except ModelDownloadError:
+            raise
+        except Exception as e:
+            # Clean up the incomplete directory so a retry starts fresh.
+            shutil.rmtree(local_model_path, ignore_errors=True)
+            raise ModelDownloadError(
+                f"failed to download tokenizer for {model!r} from {source}: {e}"
+            ) from e
+        if not os.path.exists(target):
+            shutil.rmtree(local_model_path, ignore_errors=True)
+            raise ModelDownloadError(
+                f"download for {model!r} completed but produced no tokenizer.json"
+            )
+        return target
 
     def _get_tokenizer(self, model: str):
         with self._mu:
@@ -63,8 +190,14 @@ class TokenizerService:
         )
         if model in local:
             tok = HFTokenizer.from_file(local[model])
-        elif config["allow_remote"]:
-            tok = HFTokenizer.from_pretrained(model)
+        elif not is_remote_model(model) and os.path.exists(
+            os.path.join(model, config["tokenizer_filename"])
+        ):
+            tok = HFTokenizer.from_file(
+                os.path.join(model, config["tokenizer_filename"])
+            )
+        elif config["allow_remote"] and is_remote_model(model):
+            tok = HFTokenizer.from_file(self._download_remote(model, config))
         else:
             raise FileNotFoundError(
                 f"model {model!r} not found locally and remote download disabled"
@@ -79,11 +212,44 @@ class TokenizerService:
             return self._get_tokenizer(model)
         return tok
 
+    # -- tokenization ----------------------------------------------------------
+
+    def _detect_bos(self, tok, config: dict) -> Optional[str]:
+        configured = config.get("bos_token")
+        if configured:
+            return configured if tok.token_to_id(configured) is not None else None
+        for candidate in _BOS_CANDIDATES:
+            if tok.token_to_id(candidate) is not None:
+                return candidate
+        return None
+
+    def resolve_add_special_tokens(
+        self, tok, prompt: str, config: Optional[dict] = None
+    ) -> bool:
+        """BOS-dedup semantics (reference tokenizer.py:225-259): if the
+        prompt already begins with the BOS token — chat templates commonly
+        bake it in — special tokens must not be added again, regardless of
+        the configured default; otherwise the configured value (True when
+        unset) applies."""
+        config = config or self.config
+        bos = self._detect_bos(tok, config)
+        if bos is not None and prompt.startswith(bos):
+            return False
+        configured = config.get("add_special_tokens")
+        return True if configured is None else bool(configured)
+
     def encode(
-        self, prompt: str, model: str, add_special_tokens: bool = True
+        self, prompt: str, model: str, add_special_tokens: Optional[bool] = None
     ) -> Tuple[List[int], List[List[int]]]:
+        """Encode with byte offsets. `add_special_tokens=None` (the wire
+        default) resolves via BOS dedup; an explicit True is still demoted
+        to False when the prompt already carries BOS."""
         tok = self._get_tokenizer(model)
-        encoding = tok.encode(prompt, add_special_tokens=add_special_tokens)
+        config = self.config
+        if add_special_tokens is not None:
+            config["add_special_tokens"] = add_special_tokens
+        resolved = self.resolve_add_special_tokens(tok, prompt, config)
+        encoding = tok.encode(prompt, add_special_tokens=resolved)
         return list(encoding.ids), [list(o) for o in encoding.offsets]
 
     # -- chat templating -------------------------------------------------------

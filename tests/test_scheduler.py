@@ -163,3 +163,25 @@ class TestChunkedPrefill:
     def test_budget_validation(self):
         with pytest.raises(ValueError, match="prefill_token_budget"):
             Scheduler(_pod(), prefill_token_budget=0)
+
+    def test_preemption_never_starves_mid_prefill_head(self):
+        # Livelock regression: a preempted request must not queue ahead of
+        # a mid-prefill request — that request holds its pages and only
+        # progresses at the queue head. Tight pool + long prompts + small
+        # budget force preemption churn; run() must drain.
+        pod = _pod(n_pages=16)  # 64 tokens of pages total
+        sched = Scheduler(pod, max_batch=4, prefill_token_budget=4)
+        ids = [
+            sched.submit(list(range(i * 30, i * 30 + 20)), max_new_tokens=8)
+            for i in range(3)
+        ]
+        ticks = 0
+        results = {}
+        while sched.has_work:
+            for req in sched.step():
+                results[req.req_id] = req
+            ticks += 1
+            assert ticks < 500, "scheduler livelocked under page pressure"
+        for rid in ids:
+            assert results[rid].error is None
+            assert len(results[rid].generated) == 8

@@ -1,0 +1,203 @@
+"""Sidecar TokenizerService unit tests — download machinery, BOS dedup,
+remote/local detection, worker init.
+
+Mirrors the reference's sidecar unit suite
+(/root/reference/services/uds_tokenizer/tests/test_tokenizer_unit.py)
+against the hardened service (tokenizer_service/tokenizer.py): allow-pattern
+remote downloads with cache reuse and failure cleanup, ModelScope source
+gating, BOS-dedup-aware encode, and the flock-guarded preforking entry.
+All hub access is faked — the image has no egress.
+"""
+
+import os
+import shutil
+
+import pytest
+
+from tests.conftest import TEST_MODEL_NAME, TEST_TOKENIZER_JSON
+from services.uds_tokenizer import tokenizer_service
+from services.uds_tokenizer.tokenizer_service.tokenizer import (
+    DOWNLOADERS,
+    ModelDownloadError,
+    TOKENIZER_ALLOW_PATTERNS,
+    TokenizerService,
+    is_remote_model,
+)
+
+
+@pytest.fixture
+def service(tmp_path):
+    return TokenizerService({
+        "local_tokenizer_dir": os.path.dirname(os.path.dirname(TEST_TOKENIZER_JSON)),
+        "allow_remote": False,
+        "download_dir": str(tmp_path / "downloads"),
+    })
+
+
+class TestRemoteDetection:
+    @pytest.mark.parametrize("ident,expected", [
+        ("org/model", True),
+        ("org/sub/model", True),
+        ("/abs/path/model", False),
+        ("./rel/model", False),
+        ("../rel/model", False),
+        ("s3://bucket/model", False),
+        # Bare legacy hub ids (gpt2-style) are remote — unlike the
+        # reference, which can't download them at all.
+        ("gpt2", True),
+    ])
+    def test_matrix(self, ident, expected):
+        assert is_remote_model(ident) is expected
+
+    def test_existing_local_dir_is_local(self, tmp_path):
+        d = tmp_path / "org" / "model"
+        d.mkdir(parents=True)
+        os.chdir(tmp_path)
+        assert is_remote_model("org/model") is False
+
+
+class TestDownloadMachinery:
+    def _fake_downloader(self, calls, fail=False, write=True):
+        def dl(model, local_dir):
+            calls.append((model, local_dir))
+            if fail:
+                raise ConnectionError("no egress")
+            if write:
+                with open(TEST_TOKENIZER_JSON, "rb") as f:
+                    data = f.read()
+                with open(os.path.join(local_dir, "tokenizer.json"), "wb") as out:
+                    out.write(data)
+        return dl
+
+    def test_remote_download_via_allowed_source(self, service, monkeypatch):
+        calls = []
+        monkeypatch.setitem(DOWNLOADERS, "hf", self._fake_downloader(calls))
+        service.update_config({"allow_remote": True})
+        ids, offsets = service.encode("hello world", "acme/remote-model")
+        assert ids and len(ids) == len(offsets)
+        assert calls == [("acme/remote-model", calls[0][1])]
+        assert "acme--remote-model" in calls[0][1]
+
+    def test_cached_download_skips_network(self, service, monkeypatch):
+        calls = []
+        monkeypatch.setitem(DOWNLOADERS, "hf", self._fake_downloader(calls))
+        service.update_config({"allow_remote": True})
+        service.encode("one", "acme/m")
+        service.update_config({"allow_remote": True})  # drops tokenizer cache
+        service.encode("two", "acme/m")  # dir cache hit: no second download
+        assert len(calls) == 1
+
+    def test_failed_download_cleans_up_for_retry(self, service, monkeypatch):
+        calls = []
+        monkeypatch.setitem(DOWNLOADERS, "hf", self._fake_downloader(calls, fail=True))
+        service.update_config({"allow_remote": True})
+        with pytest.raises(ModelDownloadError, match="no egress"):
+            service.encode("x", "acme/broken")
+        download_dir = service.config["download_dir"]
+        assert not os.path.exists(os.path.join(download_dir, "acme--broken"))
+        # Retry after the hub recovers succeeds from a fresh dir.
+        monkeypatch.setitem(DOWNLOADERS, "hf", self._fake_downloader(calls))
+        assert service.encode("x", "acme/broken")[0]
+
+    def test_empty_download_is_an_error(self, service, monkeypatch):
+        monkeypatch.setitem(
+            DOWNLOADERS, "hf", self._fake_downloader([], write=False)
+        )
+        service.update_config({"allow_remote": True})
+        with pytest.raises(ModelDownloadError, match="no tokenizer.json"):
+            service.encode("x", "acme/empty")
+
+    def test_unknown_source_rejected(self, service):
+        service.update_config({"allow_remote": True, "remote_source": "gopher"})
+        with pytest.raises(ModelDownloadError, match="unknown remote_source"):
+            service.encode("x", "acme/m")
+
+    def test_modelscope_gated_when_missing(self, service):
+        service.update_config({"allow_remote": True, "remote_source": "modelscope"})
+        with pytest.raises(ModelDownloadError, match="modelscope"):
+            service.encode("x", "acme/m")
+
+    def test_remote_disabled_raises_not_found(self, service):
+        with pytest.raises(FileNotFoundError, match="remote download disabled"):
+            service.encode("x", "acme/m")
+
+    def test_allow_patterns_are_tokenizer_only(self):
+        assert "tokenizer.json" in TOKENIZER_ALLOW_PATTERNS
+        assert not any(
+            p.endswith((".safetensors", ".bin", ".pt"))
+            for p in TOKENIZER_ALLOW_PATTERNS
+        ), "weights must never be downloaded by the sidecar"
+
+
+class _FakeTok:
+    def __init__(self, vocab=("<s>",)):
+        self._vocab = set(vocab)
+
+    def token_to_id(self, token):
+        return 1 if token in self._vocab else None
+
+
+class TestBOSDedup:
+    def test_prompt_with_bos_suppresses_special_tokens(self, service):
+        tok = _FakeTok()
+        assert service.resolve_add_special_tokens(tok, "<s>hello") is False
+
+    def test_prompt_without_bos_uses_default_true(self, service):
+        tok = _FakeTok()
+        assert service.resolve_add_special_tokens(tok, "hello") is True
+
+    def test_explicit_true_still_demoted_on_bos_prompt(self, service):
+        # Reference semantics (tokenizer.py:247-251): an explicit setting
+        # is overridden when the prompt already carries BOS.
+        tok = _FakeTok()
+        cfg = dict(service.config, add_special_tokens=True)
+        assert service.resolve_add_special_tokens(tok, "<s>hi", cfg) is False
+
+    def test_configured_false_respected(self, service):
+        tok = _FakeTok()
+        cfg = dict(service.config, add_special_tokens=False)
+        assert service.resolve_add_special_tokens(tok, "hi", cfg) is False
+
+    def test_configured_bos_token_wins_over_autodetect(self, service):
+        tok = _FakeTok(vocab=("<|begin_of_text|>",))
+        cfg = dict(service.config, bos_token="<|begin_of_text|>")
+        assert service.resolve_add_special_tokens(
+            tok, "<|begin_of_text|>x", cfg
+        ) is False
+
+    def test_no_bos_in_vocab_means_no_dedup(self, service):
+        tok = _FakeTok(vocab=())
+        assert service.resolve_add_special_tokens(tok, "<s>hello") is True
+
+    def test_encode_wire_default_resolves(self, service):
+        # The fixture BPE has no BOS in vocab -> dedup never fires; the
+        # call exercises the resolution path end to end.
+        ids, offsets = service.encode("hello world", TEST_MODEL_NAME)
+        assert ids and len(ids) == len(offsets)
+
+
+class TestWorkerEntry:
+    def test_flock_guarded_worker_init_builds_once(self, tmp_path, service):
+        import services.uds_tokenizer.server as server
+
+        built = []
+
+        def factory():
+            built.append(1)
+            return service
+
+        old = server._worker_service
+        server._worker_service = None
+        try:
+            lock = str(tmp_path / "init.lock")
+            app1 = server.create_app_for_worker(lock, factory)
+            app2 = server.create_app_for_worker(lock, factory)
+            assert built == [1]  # second call reuses the worker service
+            assert app1 is not app2  # but each gets a fresh app
+        finally:
+            server._worker_service = old
+
+    def test_uvloop_install_is_graceful(self):
+        import services.uds_tokenizer.server as server
+
+        assert server.install_uvloop_if_present() is False  # not in image
