@@ -133,6 +133,165 @@ def _decode_kernel(
                            ).astype(o_ref.dtype)
 
 
+def _decode_kernel_pipelined(
+    block_tables_ref,  # SMEM [batch, pages_per_seq] (scalar prefetch)
+    seq_lens_ref,  # SMEM [batch]
+    q_ref,  # VMEM (1, n_kv, GROUP_PAD, head_dim)
+    k_hbm_ref,  # ANY [n_kv, n_pages, page_size, head_dim]
+    v_hbm_ref,
+    o_ref,  # VMEM (1, n_kv, GROUP_PAD, head_dim)
+    k_buf,  # VMEM (2, n_kv, page_size, head_dim) double buffer
+    v_buf,
+    k_sem,  # DMA semaphores (2,)
+    v_sem,
+    *,
+    page_size: int,
+    scale: float,
+):
+    """Flash-decoding with a manual double-buffered page pipeline.
+
+    One grid step handles one sequence END TO END: an inner loop walks the
+    sequence's pages, DMAing page i+1 from HBM while the MXU works on page
+    i. Two deliberate DMA-shape choices drive the speedup over the tiled
+    variant (one grid step per (head, page) tile):
+
+    - ALL kv heads of a page move in ONE strided DMA (`.at[:, page]`), so a
+      page costs 2 descriptors (K + V, ~n_kv*page*hd bytes each) instead of
+      2*n_kv tiny ones — per-descriptor fixed cost, not bytes, dominated
+      the tiled kernel (measured ~2us/descriptor on v5e; see
+      benchmarking/DEVICE_BENCH.json analysis).
+    - compute is batched over heads on the MXU (dot_general with the head
+      axis as a batch dim), so the inner loop stays two matmuls per page.
+
+    Only the pages each sequence actually references move on the bus.
+    """
+    b = pl.program_id(0)
+    seq_len = seq_lens_ref[b]
+    n_pages = (seq_len + page_size - 1) // page_size
+    n_kv = q_ref.shape[1]
+    group_pad = q_ref.shape[2]
+    head_dim = q_ref.shape[3]
+
+    def k_dma(slot, idx):
+        return pltpu.make_async_copy(
+            k_hbm_ref.at[:, block_tables_ref[b, idx]], k_buf.at[slot],
+            k_sem.at[slot],
+        )
+
+    def v_dma(slot, idx):
+        return pltpu.make_async_copy(
+            v_hbm_ref.at[:, block_tables_ref[b, idx]], v_buf.at[slot],
+            v_sem.at[slot],
+        )
+
+    # Padded batch slots (seq_len == 0) must not emit VMEM garbage.
+    o_ref[0] = jnp.zeros_like(o_ref[0])
+
+    @pl.when(n_pages > 0)
+    def _run():
+        k_dma(0, 0).start()
+        v_dma(0, 0).start()
+        q = q_ref[0].astype(jnp.float32)  # (n_kv, GROUP_PAD, hd)
+
+        def body(i, carry):
+            m_prev, l_prev, acc = carry
+            slot = i % 2
+
+            @pl.when(i + 1 < n_pages)
+            def _prefetch_next():
+                k_dma((i + 1) % 2, i + 1).start()
+                v_dma((i + 1) % 2, i + 1).start()
+
+            k_dma(slot, i).wait()
+            v_dma(slot, i).wait()
+            k = k_buf[slot].astype(jnp.float32)  # (n_kv, page, hd)
+            v = v_buf[slot].astype(jnp.float32)
+
+            s = jax.lax.dot_general(
+                q, k, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            ) * scale  # (n_kv, GROUP_PAD, page)
+            pos = i * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+            s = jnp.where(pos < seq_len, s, -jnp.inf)
+
+            m_cur = jnp.max(s, axis=2, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)
+            l_new = alpha * l_prev + jnp.sum(p, axis=2, keepdims=True)
+            acc_new = acc * alpha + jax.lax.dot_general(
+                p, v, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )
+            return m_new, l_new, acc_new
+
+        init = (
+            jnp.full((n_kv, group_pad, 1), -jnp.inf, jnp.float32),
+            jnp.zeros((n_kv, group_pad, 1), jnp.float32),
+            jnp.zeros((n_kv, group_pad, head_dim), jnp.float32),
+        )
+        _, l_final, acc = jax.lax.fori_loop(0, n_pages, body, init)
+        o_ref[0] = (
+            acc / jnp.where(l_final == 0, 1.0, l_final)
+        ).astype(o_ref.dtype)
+
+
+def _paged_attention_call_pipelined(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    block_tables: jax.Array,
+    seq_lens: jax.Array,
+    *,
+    interpret: bool,
+) -> jax.Array:
+    n_kv_heads, _n_pages, page_size, head_dim = k_pages.shape
+    batch, n_q_heads, _ = q.shape
+    group = n_q_heads // n_kv_heads
+    if group * n_kv_heads != n_q_heads:
+        raise ValueError(
+            f"n_q_heads {n_q_heads} not divisible by n_kv_heads {n_kv_heads}"
+        )
+    scale = 1.0 / (head_dim**0.5)
+
+    qg = q.reshape(batch, n_kv_heads, group, head_dim)
+    if group < _GROUP_PAD:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, _GROUP_PAD - group), (0, 0)))
+    group_pad = qg.shape[2]
+
+    q_spec = pl.BlockSpec(
+        (1, n_kv_heads, group_pad, head_dim), lambda b, bt, sl: (b, 0, 0, 0)
+    )
+    hbm_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_kernel_pipelined, page_size=page_size, scale=scale
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(batch,),
+            in_specs=[q_spec, hbm_spec, hbm_spec],
+            out_specs=q_spec,
+            scratch_shapes=[
+                pltpu.VMEM((2, n_kv_heads, page_size, head_dim), k_pages.dtype),
+                pltpu.VMEM((2, n_kv_heads, page_size, head_dim), v_pages.dtype),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (batch, n_kv_heads, group_pad, head_dim), q.dtype
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",),
+        ),
+        interpret=interpret,
+    )(block_tables, seq_lens, qg, k_pages, v_pages)
+
+    return out[:, :, :group, :].reshape(batch, n_q_heads, head_dim)
+
+
 def _paged_attention_call(
     q: jax.Array,
     kv_arrays,  # (k, v) or (k_q, k_scale, v_q, v_scale)
@@ -204,7 +363,7 @@ def _paged_attention_call(
     return out[:, :, :group, :].reshape(batch, n_q_heads, head_dim)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "pipelined"))
 def paged_attention(
     q: jax.Array,  # [batch, n_q_heads, head_dim]
     k_pages: jax.Array,  # [n_kv_heads, n_pages, page_size, head_dim]
@@ -213,9 +372,28 @@ def paged_attention(
     seq_lens: jax.Array,  # [batch] int32
     *,
     interpret: bool = False,
+    pipelined: bool = False,
 ) -> jax.Array:
-    """Flash-decoding paged attention (Pallas TPU kernel)."""
+    """Flash-decoding paged attention (Pallas TPU kernel).
+
+    Two variants, identical semantics (cross-checked against each other and
+    the jnp oracle):
+
+    - default (tiled): one grid step per (seq, head, page) tile; Mosaic's
+      BlockSpec pipeline prefetches tiles. Shared body with the
+      int8-quantized kernel. Fastest in clean like-for-like runs at serving
+      shapes (~20-30us/call at batch 8, ctx 1-2k).
+    - `pipelined=True`: one grid step per sequence; a manual double-buffered
+      loop DMAs each page's K/V for ALL kv heads in one strided descriptor
+      (2 descriptors/page instead of 2*n_kv tiles). Fewer, larger DMAs —
+      the variant to reach for when per-descriptor overhead dominates
+      (many pages x heads per sequence).
+    """
     n_kv_heads, _n_pages, page_size, head_dim = k_pages.shape
+    if pipelined:
+        return _paged_attention_call_pipelined(
+            q, k_pages, v_pages, block_tables, seq_lens, interpret=interpret
+        )
     return _paged_attention_call(
         q,
         (k_pages, v_pages),

@@ -137,9 +137,29 @@ class _CachedTokenizerBase(Tokenizer):
 
     def encode(self, prompt: str, model_name: str) -> TokenizationResult:
         tok = self._get(model_name)
-        encoding = tok.encode(prompt, add_special_tokens=True)
+        encoding = tok.encode(
+            prompt, add_special_tokens=resolve_add_special_tokens(tok, prompt)
+        )
         byte_offsets = _char_to_byte_offsets(prompt, encoding.offsets)
         return TokenizationResult(tokens=list(encoding.ids), offsets=byte_offsets)
+
+
+# BOS strings to probe for dedup; vocab membership decides applicability.
+_BOS_CANDIDATES = ("<s>", "<|begin_of_text|>", "<bos>", "[CLS]")
+
+
+def resolve_add_special_tokens(tok, prompt: str) -> bool:
+    """BOS-dedup: if the prompt already starts with the tokenizer's BOS
+    string (chat templates commonly bake it in), special tokens must not be
+    added again. EVERY tokenizer backend — in-process local/HF here, the
+    UDS sidecar remotely — must apply the same rule, or the composite's
+    fallback order changes the token ids (and therefore the block hashes)
+    for the very same prompt. Sidecar counterpart:
+    services/uds_tokenizer/tokenizer_service/tokenizer.py."""
+    for candidate in _BOS_CANDIDATES:
+        if prompt.startswith(candidate) and tok.token_to_id(candidate) is not None:
+            return False
+    return True
 
 
 def discover_local_tokenizers(

@@ -138,9 +138,10 @@ def create_app_for_worker(
     service_factory=TokenizerService,
 ) -> web.Application:
     """Preforking-server entry (gunicorn `aiohttp.GunicornWebWorker`, or any
-    multi-worker launcher): the first worker to take the flock initializes
-    the shared on-disk state (download dir, socket dir); every worker gets
-    its own in-process TokenizerService. Mirrors the reference's
+    multi-worker launcher). Each worker process builds its own in-process
+    TokenizerService (memoized per process); the flock serializes the
+    genuinely *shared* on-disk init — creating the download directory — so
+    concurrent first-boot workers don't race it. Mirrors the reference's
     flock-guarded init (server.py:317-353)."""
     global _worker_service
     if _worker_service is None:
@@ -153,6 +154,12 @@ def create_app_for_worker(
                 if _worker_service is None:
                     logger.info("worker holds init lock; building service")
                     _worker_service = service_factory()
+                    os.makedirs(
+                        _worker_service.config.get(
+                            "download_dir", "/tmp/tokenizer-downloads"
+                        ),
+                        exist_ok=True,
+                    )
             finally:
                 fcntl.flock(lock_file.fileno(), fcntl.LOCK_UN)
     return make_app(_worker_service)

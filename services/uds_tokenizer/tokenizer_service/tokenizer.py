@@ -37,8 +37,12 @@ TOKENIZER_ALLOW_PATTERNS = [
 # Files that must exist for a cached download dir to be trusted.
 REQUIRED_FILES = ["tokenizer.json"]
 
-# BOS strings to probe when none is configured; vocab membership decides.
-_BOS_CANDIDATES = ("<s>", "<|begin_of_text|>", "<bos>", "[CLS]")
+# BOS candidates are shared with the in-process backends: every tokenizer
+# backend must apply identical BOS-dedup or the composite's fallback order
+# would change token ids (and block hashes) for the same prompt.
+from llm_d_kv_cache_manager_tpu.tokenization.tokenizer import (  # noqa: E402
+    _BOS_CANDIDATES,
+)
 
 
 class ModelDownloadError(RuntimeError):

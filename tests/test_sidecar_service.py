@@ -49,10 +49,10 @@ class TestRemoteDetection:
     def test_matrix(self, ident, expected):
         assert is_remote_model(ident) is expected
 
-    def test_existing_local_dir_is_local(self, tmp_path):
+    def test_existing_local_dir_is_local(self, tmp_path, monkeypatch):
         d = tmp_path / "org" / "model"
         d.mkdir(parents=True)
-        os.chdir(tmp_path)
+        monkeypatch.chdir(tmp_path)
         assert is_remote_model("org/model") is False
 
 

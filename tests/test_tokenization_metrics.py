@@ -54,6 +54,30 @@ def _registered():
     m.register_metrics()
 
 
+class TestBOSDedupConsistency:
+    """All backends must resolve add_special_tokens identically, or the
+    composite's fallback order changes token ids for the same prompt."""
+
+    def test_in_process_resolver_matches_sidecar_semantics(self):
+        from llm_d_kv_cache_manager_tpu.tokenization.tokenizer import (
+            resolve_add_special_tokens,
+        )
+        from services.uds_tokenizer.tokenizer_service.tokenizer import (
+            TokenizerService,
+        )
+
+        class FakeTok:
+            def token_to_id(self, t):
+                return 1 if t == "<s>" else None
+
+        svc = TokenizerService({"local_tokenizer_dir": ""})
+        tok = FakeTok()
+        for prompt in ("<s>templated", "plain prompt", "<bos>not-in-vocab"):
+            assert resolve_add_special_tokens(tok, prompt) == (
+                svc.resolve_add_special_tokens(tok, prompt)
+            ), prompt
+
+
 class TestPoolObservations:
     def test_full_tokenization_observes_latency_and_tokens(self):
         pool = TokenizationPool(
