@@ -137,6 +137,7 @@ class EnginePod:
         config: EnginePodConfig,
         event_sink: Optional[Callable[[EventBatch], None]] = None,
         params=None,
+        lora_adapters: Optional[dict] = None,  # {lora_id: models.lora params}
     ):
         self.config = config
         self._publisher: Optional[Publisher] = None
@@ -204,6 +205,22 @@ class EnginePod:
                 )
             self._jnp = jnp
 
+        # Multi-LoRA registry: adapter weights served per sequence, with
+        # the cache already scoped per adapter (block hashes carry
+        # lora_id). Index 0 is the zero adapter (base traffic).
+        self.lora_stack = None
+        self._lora_index: dict = {}
+        if lora_adapters:
+            if self._model is None:
+                raise ValueError("lora_adapters requires with_model=True")
+            from llm_d_kv_cache_manager_tpu.models import lora as lora_mod
+
+            ids = sorted(lora_adapters)
+            self.lora_stack = lora_mod.stack_adapters(
+                [lora_adapters[i] for i in ids]
+            )
+            self._lora_index = {lid: i + 1 for i, lid in enumerate(ids)}
+
     # -- events --------------------------------------------------------------
 
     def _emit(self, batch: EventBatch) -> None:
@@ -243,6 +260,35 @@ class EnginePod:
             n_cached = min(n_cached, len(tokens) - 1)
         return state, n_cached
 
+    def lora_index(self, lora_id: Optional[int]) -> int:
+        """Registry index for an adapter id (0 = base). Raises KeyError for
+        an unknown adapter so admission can reject deterministically."""
+        if lora_id is None:
+            return 0
+        if self.lora_stack is None:
+            raise KeyError(f"no LoRA adapters configured (requested {lora_id})")
+        return self._lora_index[lora_id]
+
+    def _lora_for_prefill(self, lora_id: Optional[int]):
+        if self.lora_stack is None:
+            return None
+        from llm_d_kv_cache_manager_tpu.models import lora as lora_mod
+
+        return lora_mod.select_adapter(self.lora_stack, self.lora_index(lora_id))
+
+    def lora_for_decode(self, lora_ids):
+        """(registry stack, [B] indices) for a decode batch, or None when
+        the pod serves no adapters. The per-sequence weight gather happens
+        inside the jitted step, not here."""
+        if self.lora_stack is None:
+            return None
+        import numpy as _np
+
+        idx = self._jnp.asarray(
+            _np.asarray([self.lora_index(i) for i in lora_ids], dtype=_np.int32)
+        )
+        return (self.lora_stack, idx)
+
     def prefill_chunk(self, state: SequenceState, start: int, end: int) -> None:
         """Compute KV (and logits) for tokens[start:end], attending over the
         first `start` already-resident positions. vLLM-style chunked
@@ -255,7 +301,7 @@ class EnginePod:
         chunk = jnp.asarray(state.tokens[start:end], dtype=jnp.int32)
         self.kv_cache, self.last_logits = self._model.prefill_cache(
             self._model_config, self.params, self.kv_cache, chunk,
-            block_table, start,
+            block_table, start, lora=self._lora_for_prefill(state.lora_id),
         )
 
     def finish_prefill(self, state: SequenceState) -> None:
@@ -285,6 +331,7 @@ class EnginePod:
             self._padded_table(state)[None],
             jnp.asarray([pos], dtype=jnp.int32),
             self.config.use_kernel,
+            lora=self.lora_for_decode([state.lora_id]),
         )
         token = int(jnp.argmax(logits[0]))
         self.block_manager.append_token(state, token)

@@ -128,6 +128,10 @@ class Scheduler:
     def _validate(self, req: Request) -> Optional[str]:
         if req.max_new_tokens < 1:
             return f"max_new_tokens must be >= 1, got {req.max_new_tokens}"
+        try:
+            self.pod.lora_index(req.lora_id)
+        except KeyError as e:
+            return f"unknown LoRA adapter: {e}"
         page_size = self.pod.config.page_size
         total_tokens = len(req.prompt_tokens) + req.max_new_tokens
         pages_needed = (total_tokens + page_size - 1) // page_size
@@ -241,6 +245,9 @@ class Scheduler:
             jnp.asarray(tables),
             jnp.asarray(positions),
             self.pod.config.use_kernel,
+            lora=self.pod.lora_for_decode(
+                [r.lora_id for r in self._running]
+            ),
         )
         next_tokens = np.asarray(jnp.argmax(logits, axis=-1))
 

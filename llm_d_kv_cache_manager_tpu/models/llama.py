@@ -272,9 +272,11 @@ def prefill_cache(
     tokens: jax.Array,  # [L] one sequence's NEW (non-cached) tokens
     block_table: jax.Array,  # [pages_per_seq] int32
     start_pos,  # int32: number of already-cached tokens (prefix-cache hit)
+    lora=None,  # models.lora per-layer adapter (select_adapter) or None
 ) -> Tuple[tuple, jax.Array]:
     """Prefill new tokens, attending to the cached prefix; returns
-    (kv_cache, last_token_logits)."""
+    (kv_cache, last_token_logits). `lora` applies q/v adapter deltas
+    (models/lora.py) for this sequence's adapter."""
     c = config
     l = tokens.shape[0]
     x = params["embed"][tokens][None]  # [1, L, d]
@@ -282,11 +284,19 @@ def prefill_cache(
 
     def layer_fn(carry, inputs):
         x, = carry
-        layer, cache = inputs[0], inputs[1:]
+        layer, cache = inputs["layer"], inputs["cache"]
         h = rms_norm(x, layer["attn_norm"], c.rms_eps)
-        q = (h @ layer["wq"]).reshape(1, l, c.n_q_heads, c.head_dim)
+        q_flat = h @ layer["wq"]
+        v_flat = h @ layer["wv"]
+        if lora is not None:
+            from llm_d_kv_cache_manager_tpu.models.lora import apply_prefill_delta
+
+            dq, dv = apply_prefill_delta(h, inputs["lora"])
+            q_flat = q_flat + dq
+            v_flat = v_flat + dv
+        q = q_flat.reshape(1, l, c.n_q_heads, c.head_dim)
         k = (h @ layer["wk"]).reshape(1, l, c.n_kv_heads, c.head_dim)
-        v = (h @ layer["wv"]).reshape(1, l, c.n_kv_heads, c.head_dim)
+        v = v_flat.reshape(1, l, c.n_kv_heads, c.head_dim)
         q = _rope(q, positions, c.rope_theta)
         k = _rope(k, positions, c.rope_theta)
 
@@ -300,9 +310,10 @@ def prefill_cache(
         x = x + _mlp(layer, h)
         return (x,), cache
 
-    (x,), kv_cache = jax.lax.scan(
-        layer_fn, (x,), (params["layers"],) + tuple(kv_cache)
-    )
+    xs = {"layer": params["layers"], "cache": tuple(kv_cache)}
+    if lora is not None:
+        xs["lora"] = lora
+    (x,), kv_cache = jax.lax.scan(layer_fn, (x,), xs)
     x = rms_norm(x, params["final_norm"], c.rms_eps)
     logits = x[:, -1] @ params["out"]  # [1, vocab]
     return kv_cache, logits[0]
@@ -319,8 +330,12 @@ def decode_step_cache(
     block_tables: jax.Array,  # [B, pages_per_seq]
     seq_lens: jax.Array,  # [B] tokens already cached (position of new token)
     use_kernel: bool = False,
+    lora=None,  # (adapter registry stack, [B] int32 indices) or None
 ) -> Tuple[tuple, jax.Array]:
-    """One batched decode step; returns (kv_cache, logits [B, vocab])."""
+    """One batched decode step; returns (kv_cache, logits [B, vocab]).
+    `lora` is (stack, adapter_indices): the per-sequence gather happens
+    inside the trace so XLA fuses it — a batch can mix adapters and base
+    traffic (index 0)."""
     c = config
     page_size = kv_cache[0].shape[3]
     b = tokens.shape[0]
@@ -334,11 +349,19 @@ def decode_step_cache(
 
     def layer_fn(carry, inputs):
         x, = carry
-        layer, cache = inputs[0], inputs[1:]
+        layer, cache = inputs["layer"], inputs["cache"]
         h = rms_norm(x, layer["attn_norm"], c.rms_eps)
-        q = (h @ layer["wq"]).reshape(b, 1, c.n_q_heads, c.head_dim)
+        q_flat = h @ layer["wq"]
+        v_flat = h @ layer["wv"]
+        if lora is not None:
+            from llm_d_kv_cache_manager_tpu.models.lora import apply_decode_delta
+
+            dq, dv = apply_decode_delta(h, inputs["lora"])
+            q_flat = q_flat + dq
+            v_flat = v_flat + dv
+        q = q_flat.reshape(b, 1, c.n_q_heads, c.head_dim)
         k = (h @ layer["wk"]).reshape(b, 1, c.n_kv_heads, c.head_dim)
-        v = (h @ layer["wv"]).reshape(b, 1, c.n_kv_heads, c.head_dim)
+        v = v_flat.reshape(b, 1, c.n_kv_heads, c.head_dim)
         q = _rope(q, positions, c.rope_theta)
         k = _rope(k, positions, c.rope_theta)
 
@@ -366,9 +389,13 @@ def decode_step_cache(
         x = x + _mlp(layer, h)
         return (x,), cache
 
-    (x,), kv_cache = jax.lax.scan(
-        layer_fn, (x,), (params["layers"],) + tuple(kv_cache)
-    )
+    xs = {"layer": params["layers"], "cache": tuple(kv_cache)}
+    if lora is not None:
+        from llm_d_kv_cache_manager_tpu.models.lora import gather_adapters
+
+        lora_stack, adapter_indices = lora
+        xs["lora"] = gather_adapters(lora_stack, adapter_indices)
+    (x,), kv_cache = jax.lax.scan(layer_fn, (x,), xs)
     x = rms_norm(x, params["final_norm"], c.rms_eps)
     return kv_cache, (x[:, 0] @ params["out"])
 

@@ -1,0 +1,125 @@
+"""Multi-LoRA serving: adapter weights for the paged-cache llama path.
+
+The control plane already scopes KV blocks by adapter id end to end
+(kvcache/kvblock/token_processor.py extra-keys; engine block manager;
+scoring) — this module supplies the missing device half: actually applying
+per-sequence adapter deltas during prefill/decode, vLLM-multi-LoRA style.
+
+Design (TPU-first):
+- Standard LoRA on the q and v projections: W_eff = W + B·A with the
+  alpha/rank scale FOLDED INTO B at init, so serving needs no runtime
+  scale and the delta is two small matmuls per layer.
+- Adapters are served from one layer-stacked *registry*
+  (`stack_adapters`): index 0 is the all-zeros "no adapter", so a batch
+  mixing base and adapter traffic is one gather + one einsum — no
+  per-sequence control flow, shapes static under jit.
+- Batched decode gathers each sequence's adapter rows
+  ([n_layers, B, d, r]) outside the layer scan; rank is small so the
+  gathered bytes are negligible next to the weight stream.
+
+The reference has no model execution at all; vLLM's LoRA support is the
+behavioral anchor (adapter-scoped caches must produce adapter-specific
+logits).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from llm_d_kv_cache_manager_tpu.models.llama import LlamaConfig
+
+LoraParams = Dict[str, jax.Array]  # layer-stacked wq_a/wq_b/wv_a/wv_b
+
+
+def init_lora_adapter(
+    config: LlamaConfig, rank: int, key: jax.Array
+) -> LoraParams:
+    """One adapter: per-layer A (normal init) and B (zeros, LoRA-standard,
+    so a freshly initialized adapter is an exact no-op) for wq and wv.
+    The alpha/rank scale is folded into B's effective magnitude when B is
+    trained/loaded; `make_test_adapter` below fills B for tests/demos."""
+    c = config
+    ka_q, ka_v = jax.random.split(key)
+    init = jax.nn.initializers.normal(0.02)
+    return {
+        "wq_a": init(ka_q, (c.n_layers, c.d_model, rank), c.dtype),
+        "wq_b": jnp.zeros((c.n_layers, rank, c.q_dim), c.dtype),
+        "wv_a": init(ka_v, (c.n_layers, c.d_model, rank), c.dtype),
+        "wv_b": jnp.zeros((c.n_layers, rank, c.kv_dim), c.dtype),
+    }
+
+
+def make_test_adapter(
+    config: LlamaConfig, rank: int, key: jax.Array, alpha: float = 16.0
+) -> LoraParams:
+    """A non-trivial adapter (random B scaled by alpha/rank) for tests."""
+    adapter = init_lora_adapter(config, rank, key)
+    kb_q, kb_v = jax.random.split(jax.random.fold_in(key, 1))
+    init = jax.nn.initializers.normal(0.02)
+    scale = alpha / rank
+    adapter["wq_b"] = init(kb_q, adapter["wq_b"].shape, config.dtype) * scale
+    adapter["wv_b"] = init(kb_v, adapter["wv_b"].shape, config.dtype) * scale
+    return adapter
+
+
+def stack_adapters(adapters: Sequence[LoraParams]) -> LoraParams:
+    """Registry: [n_adapters+1, n_layers, ...] with index 0 the zero
+    adapter (base-model traffic)."""
+    if not adapters:
+        raise ValueError("stack_adapters needs at least one adapter")
+    zero = jax.tree_util.tree_map(jnp.zeros_like, adapters[0])
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), zero, *adapters
+    )
+
+
+def select_adapter(stack: LoraParams, index: int) -> LoraParams:
+    """Single-sequence selection (prefill): per-layer arrays for one
+    adapter, ready to ride the layer scan."""
+    return {k: v[index] for k, v in stack.items()}
+
+
+def gather_adapters(stack: LoraParams, adapter_indices) -> LoraParams:
+    """Batched decode selection: per-sequence adapter rows, layers leading
+    so the layer scan carries [B, ...] slices. Call this INSIDE the jitted
+    step (decode_step_cache does) so XLA fuses the gather instead of
+    materializing per-sequence weight copies eagerly on the host hot loop."""
+    return {
+        k: jnp.moveaxis(v[adapter_indices], 0, 1) for k, v in stack.items()
+    }
+
+
+def merge_adapter(params, adapter: LoraParams) -> dict:
+    """Materialize W + B·A into dense weights (single-adapter serving /
+    equivalence testing). Returns a new params tree."""
+    layers = dict(params["layers"])
+    layers["wq"] = params["layers"]["wq"] + jnp.einsum(
+        "ldr,lrq->ldq", adapter["wq_a"].astype(jnp.float32),
+        adapter["wq_b"].astype(jnp.float32),
+    ).astype(params["layers"]["wq"].dtype)
+    layers["wv"] = params["layers"]["wv"] + jnp.einsum(
+        "ldr,lrk->ldk", adapter["wv_a"].astype(jnp.float32),
+        adapter["wv_b"].astype(jnp.float32),
+    ).astype(params["layers"]["wv"].dtype)
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+def apply_prefill_delta(h: jax.Array, lo: LoraParams) -> Tuple[jax.Array, jax.Array]:
+    """Single-sequence deltas: h [1, L, d]; lo arrays [d, r]/[r, out]."""
+    dq = (h @ lo["wq_a"]) @ lo["wq_b"]
+    dv = (h @ lo["wv_a"]) @ lo["wv_b"]
+    return dq, dv
+
+
+def apply_decode_delta(h: jax.Array, lo: LoraParams) -> Tuple[jax.Array, jax.Array]:
+    """Per-sequence deltas: h [B, 1, d]; lo arrays [B, d, r]/[B, r, out]."""
+    tq = jnp.einsum("bld,bdr->blr", h, lo["wq_a"])
+    dq = jnp.einsum("blr,brq->blq", tq, lo["wq_b"])
+    tv = jnp.einsum("bld,bdr->blr", h, lo["wv_a"])
+    dv = jnp.einsum("blr,brk->blk", tv, lo["wv_b"])
+    return dq, dv

@@ -148,18 +148,38 @@ class _CachedTokenizerBase(Tokenizer):
 _BOS_CANDIDATES = ("<s>", "<|begin_of_text|>", "<bos>", "[CLS]")
 
 
-def resolve_add_special_tokens(tok, prompt: str) -> bool:
+def detect_bos_token(tok, configured: Optional[str] = None) -> Optional[str]:
+    """The tokenizer's BOS string: the configured one (if present in the
+    vocab), else the first candidate the vocab contains. A tokenizer has
+    one BOS; first-in-vocab keeps detection deterministic."""
+    if configured:
+        return configured if tok.token_to_id(configured) is not None else None
+    for candidate in _BOS_CANDIDATES:
+        if tok.token_to_id(candidate) is not None:
+            return candidate
+    return None
+
+
+def resolve_add_special_tokens(
+    tok,
+    prompt: str,
+    configured: Optional[bool] = None,
+    bos_token: Optional[str] = None,
+) -> bool:
     """BOS-dedup: if the prompt already starts with the tokenizer's BOS
     string (chat templates commonly bake it in), special tokens must not be
-    added again. EVERY tokenizer backend — in-process local/HF here, the
-    UDS sidecar remotely — must apply the same rule, or the composite's
-    fallback order changes the token ids (and therefore the block hashes)
-    for the very same prompt. Sidecar counterpart:
-    services/uds_tokenizer/tokenizer_service/tokenizer.py."""
-    for candidate in _BOS_CANDIDATES:
-        if prompt.startswith(candidate) and tok.token_to_id(candidate) is not None:
-            return False
-    return True
+    added again — overriding even an explicit True. Otherwise the
+    configured value applies (True when unset).
+
+    This is THE single implementation: every tokenizer backend — in-process
+    local/HF here, the UDS sidecar remotely
+    (services/uds_tokenizer/tokenizer_service/tokenizer.py delegates to
+    it) — must share it, or the composite's fallback order would change
+    token ids (and therefore block hashes) for the very same prompt."""
+    bos = detect_bos_token(tok, bos_token)
+    if bos is not None and prompt.startswith(bos):
+        return False
+    return True if configured is None else bool(configured)
 
 
 def discover_local_tokenizers(

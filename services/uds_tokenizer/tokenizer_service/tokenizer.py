@@ -37,11 +37,12 @@ TOKENIZER_ALLOW_PATTERNS = [
 # Files that must exist for a cached download dir to be trusted.
 REQUIRED_FILES = ["tokenizer.json"]
 
-# BOS candidates are shared with the in-process backends: every tokenizer
-# backend must apply identical BOS-dedup or the composite's fallback order
-# would change token ids (and block hashes) for the same prompt.
+# The BOS-dedup resolver is shared with the in-process backends: every
+# tokenizer backend must apply identical semantics or the composite's
+# fallback order would change token ids (and block hashes) for the same
+# prompt.
 from llm_d_kv_cache_manager_tpu.tokenization.tokenizer import (  # noqa: E402
-    _BOS_CANDIDATES,
+    resolve_add_special_tokens as _shared_resolve,
 )
 
 
@@ -218,15 +219,6 @@ class TokenizerService:
 
     # -- tokenization ----------------------------------------------------------
 
-    def _detect_bos(self, tok, config: dict) -> Optional[str]:
-        configured = config.get("bos_token")
-        if configured:
-            return configured if tok.token_to_id(configured) is not None else None
-        for candidate in _BOS_CANDIDATES:
-            if tok.token_to_id(candidate) is not None:
-                return candidate
-        return None
-
     def resolve_add_special_tokens(
         self, tok, prompt: str, config: Optional[dict] = None
     ) -> bool:
@@ -234,13 +226,14 @@ class TokenizerService:
         prompt already begins with the BOS token — chat templates commonly
         bake it in — special tokens must not be added again, regardless of
         the configured default; otherwise the configured value (True when
-        unset) applies."""
+        unset) applies. Delegates to the single shared resolver so every
+        backend in the fleet agrees byte-for-byte."""
         config = config or self.config
-        bos = self._detect_bos(tok, config)
-        if bos is not None and prompt.startswith(bos):
-            return False
-        configured = config.get("add_special_tokens")
-        return True if configured is None else bool(configured)
+        return _shared_resolve(
+            tok, prompt,
+            configured=config.get("add_special_tokens"),
+            bos_token=config.get("bos_token"),
+        )
 
     def encode(
         self, prompt: str, model: str, add_special_tokens: Optional[bool] = None

@@ -67,12 +67,23 @@ class TestBOSDedupConsistency:
         )
 
         class FakeTok:
+            def __init__(self, vocab):
+                self._vocab = set(vocab)
+
             def token_to_id(self, t):
-                return 1 if t == "<s>" else None
+                return 1 if t in self._vocab else None
 
         svc = TokenizerService({"local_tokenizer_dir": ""})
-        tok = FakeTok()
-        for prompt in ("<s>templated", "plain prompt", "<bos>not-in-vocab"):
+        cases = [
+            (FakeTok({"<s>"}), "<s>templated"),
+            (FakeTok({"<s>"}), "plain prompt"),
+            (FakeTok({"<s>"}), "<bos>not-in-vocab"),
+            # Two BOS-like strings in vocab: detection must be identical
+            # (first-in-vocab), or fallback order changes block hashes.
+            (FakeTok({"<s>", "<bos>"}), "<bos>ambiguous"),
+            (FakeTok({"<bos>"}), "<bos>only-bos"),
+        ]
+        for tok, prompt in cases:
             assert resolve_add_special_tokens(tok, prompt) == (
                 svc.resolve_add_special_tokens(tok, prompt)
             ), prompt
