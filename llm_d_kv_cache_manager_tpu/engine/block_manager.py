@@ -173,10 +173,16 @@ class BlockManager:
             block_table.append(page_id)
             n_cached_pages += 1
 
-        # 2. Fresh pages for the rest.
+        # 2. Fresh pages for the rest. Fresh pages are referenced too:
+        # without the increment, committing such a page and having another
+        # sequence reuse it lets this sequence's free() drop the refcount
+        # to zero while the other still holds it — the page becomes
+        # reclaimable under a live reader (use-after-reclaim).
         try:
             while len(block_table) < n_pages_needed:
-                block_table.append(self._take_free_page())
+                page_id = self._take_free_page()
+                self._pages[page_id].ref_count += 1
+                block_table.append(page_id)
         except OutOfPagesError:
             self._rollback(block_table, n_cached_pages)
             raise
@@ -206,9 +212,18 @@ class BlockManager:
         pages_needed = (
             len(state.tokens) + self.config.page_size - 1
         ) // self.config.page_size
-        if pages_needed > len(state.block_table):
-            state.block_table.append(self._take_free_page())
+        self.reserve_pages(state, pages_needed)
         self._commit_full_pages(state)
+
+    def reserve_pages(self, state: SequenceState, n_total_pages: int) -> None:
+        """Extend the sequence's block table with fresh (uncommitted) pages
+        so device writes beyond the current token count have somewhere to
+        land — speculative decoding writes proposed tokens' KV before
+        acceptance. Unused reservations return to the pool on free()."""
+        while len(state.block_table) < n_total_pages:
+            page_id = self._take_free_page()
+            self._pages[page_id].ref_count += 1
+            state.block_table.append(page_id)
 
     def free(self, state: SequenceState) -> None:
         """Release the sequence. Committed pages stay cached (reclaimable);
@@ -338,8 +353,8 @@ class BlockManager:
     def _rollback(self, block_table: List[int], n_cached: int) -> None:
         for i, page_id in enumerate(block_table):
             page = self._pages[page_id]
+            page.ref_count -= 1
             if i < n_cached:
-                page.ref_count -= 1
                 if page.ref_count == 0:
                     self._reclaimable[page_id] = None
             else:

@@ -1,0 +1,221 @@
+"""Greedy speculative decoding: draft proposes, target verifies in one pass.
+
+TPU-first rationale: decode is bandwidth-bound (one token streams the whole
+weight stack), but the MXU can score k+1 positions for nearly the price of
+one. A small draft model proposes k tokens autoregressively; the target
+then runs a single `prefill_cache(all_logits=True)` over the proposals —
+one weight stream amortized over k positions — and accepts the longest
+prefix whose greedy argmax chain matches. Every emitted token is the
+argmax of TARGET logits, so the sampling rule is exactly target-only
+greedy; the tests pin bit-identical output on f32 models. (In bf16 the
+dense verify path and the paged decode path can round differently, so a
+near-exact logit tie may resolve differently than plain decode would —
+the same numerics caveat batched-vs-isolated decode already carries.)
+
+Integration with the serving stack:
+- the target sequence lives in the pod's real BlockManager: proposals'
+  KV lands in pages reserved ahead (`reserve_pages`), and only ACCEPTED
+  tokens are appended (so BlockStored events / prefix-cache commits never
+  advertise unverified content). Rejected positions leave stale device
+  rows beyond seq_len — masked by attention and overwritten by the next
+  round, exactly like vLLM's rejected draft slots.
+- the draft keeps a private paged cache (its own page pool, identity block
+  table); after each round it catches up on the accepted tokens it did
+  not itself propose.
+
+Reference anchor: none (the reference executes no model math); vLLM's
+speculative decoding is the behavioral anchor.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llm_d_kv_cache_manager_tpu.engine.engine import EnginePod
+from llm_d_kv_cache_manager_tpu.models import llama
+
+
+@dataclass
+class SpeculativeStats:
+    proposed: int = 0
+    accepted: int = 0
+    rounds: int = 0
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / self.proposed if self.proposed else 0.0
+
+
+class _DraftState:
+    """The draft model's private paged cache for one sequence."""
+
+    def __init__(self, config, params, max_tokens: int, page_size: int):
+        self.config = config
+        self.params = params
+        self.page_size = page_size
+        n_pages = (max_tokens + page_size - 1) // page_size + 1
+        self.cache = llama.make_kv_pages(config, n_pages, page_size)
+        self.table = jnp.arange(n_pages, dtype=jnp.int32)
+        self.n_tokens = 0  # positions with valid KV
+
+    def ingest(self, tokens: List[int]) -> jax.Array:
+        """Write KV for `tokens` at the current position; returns the
+        last-position logits (the draft's next proposal seed). Single
+        tokens ride the O(seq_len) paged decode path; multi-token catch-up
+        chunks use prefill."""
+        if len(tokens) == 1:
+            self.cache, logits = llama.decode_step_cache(
+                self.config, self.params, self.cache,
+                jnp.asarray(tokens, jnp.int32),
+                self.table[None],
+                jnp.asarray([self.n_tokens], jnp.int32),
+            )
+            self.n_tokens += 1
+            return logits[0]
+        chunk = jnp.asarray(tokens, dtype=jnp.int32)
+        self.cache, logits = llama.prefill_cache(
+            self.config, self.params, self.cache, chunk, self.table,
+            self.n_tokens,
+        )
+        self.n_tokens += len(tokens)
+        return logits
+
+
+class SpeculativeDecoder:
+    """Single-sequence greedy generation with draft-model speculation."""
+
+    def __init__(
+        self,
+        pod: EnginePod,
+        draft_config,
+        draft_params,
+        k: int = 4,
+    ):
+        if pod._model is None:
+            raise ValueError("SpeculativeDecoder requires with_model=True")
+        if pod.lora_stack is not None:
+            raise NotImplementedError("speculative decoding with LoRA adapters")
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.pod = pod
+        self.draft_config = draft_config
+        self.draft_params = draft_params
+        self.k = k
+        self.stats = SpeculativeStats()
+
+    def generate(
+        self,
+        prompt_tokens: List[int],
+        max_new_tokens: int,
+        eos_token: Optional[int] = None,
+    ) -> List[int]:
+        pod = self.pod
+        page_size = pod.config.page_size
+        max_total = len(prompt_tokens) + max_new_tokens + self.k + 1
+
+        state, _ = pod.prefill(list(prompt_tokens))
+        draft = _DraftState(
+            self.draft_config, self.draft_params, max_total, page_size
+        )
+        draft_logits = draft.ingest(list(prompt_tokens))
+
+        generated: List[int] = []
+        target_logits = pod.last_logits  # target's opinion at the frontier
+
+        try:
+            while len(generated) < max_new_tokens:
+                # The frontier token: the target's own greedy choice.
+                t0 = int(jnp.argmax(target_logits))
+                pos_t0 = len(state.tokens)  # device position t0 will occupy
+
+                # Cap proposals at what could possibly be accepted: the
+                # remaining token budget after t0, and the sequence's page
+                # capacity (reserving past max_pages_per_seq would crash a
+                # generation that plain decode finishes fine).
+                capacity_tokens = (
+                    pod.config.max_pages_per_seq * page_size - pos_t0 - 1
+                )
+                k_eff = max(
+                    0,
+                    min(self.k, max_new_tokens - len(generated) - 1,
+                        capacity_tokens),
+                )
+
+                # Draft proposes k_eff tokens after t0 (greedy,
+                # autoregressive). In the final stretch (k_eff == 0) the
+                # draft is skipped entirely — no further rounds propose.
+                proposals: List[int] = []
+                if k_eff > 0:
+                    seed_logits = draft.ingest([t0])
+                    for _ in range(k_eff):
+                        p = int(jnp.argmax(seed_logits))
+                        proposals.append(p)
+                        seed_logits = draft.ingest([p])
+                self.stats.proposed += len(proposals)
+                self.stats.rounds += 1
+
+                # Target verifies all proposals in ONE pass. The chunk
+                # starts with t0 (whose KV is not yet in the cache);
+                # logits[i] is the target's next-token opinion after
+                # chunk[i], so logits[i] vs proposals[i] is the acceptance
+                # test and logits[n_accept] seeds the next round.
+                chunk = [t0] + proposals
+                pod.block_manager.reserve_pages(
+                    state,
+                    (pos_t0 + len(chunk) + page_size - 1) // page_size,
+                )
+                pod.kv_cache, verify_logits = llama.prefill_cache(
+                    pod._model_config, pod.params, pod.kv_cache,
+                    jnp.asarray(chunk, jnp.int32),
+                    pod._padded_table(state), pos_t0,
+                    all_logits=True,
+                )
+                argmaxes = np.asarray(jnp.argmax(verify_logits, axis=-1))
+
+                n_accept = 0
+                for i, p in enumerate(proposals):
+                    if int(argmaxes[i]) != p:
+                        break
+                    n_accept += 1
+                self.stats.accepted += n_accept
+
+                done = False
+                for tok in [t0] + proposals[:n_accept]:
+                    if self._push(state, generated, tok, eos_token,
+                                  max_new_tokens):
+                        done = True
+                        break
+                if done:
+                    break
+
+                # Draft already holds KV for t0 + all proposals; on partial
+                # acceptance its tail (like the target's) is stale-but-
+                # masked. Rewind its valid-token count to the accepted
+                # frontier so the next ingest overwrites the stale rows.
+                # (k_eff == 0 rounds never touched the draft — and k_eff is
+                # monotonic, so it stays untouched.)
+                if k_eff > 0:
+                    draft.n_tokens = len(state.tokens)
+                target_logits = verify_logits[n_accept]
+        finally:
+            pod.free(state)
+        return generated
+
+    def _push(
+        self, state, generated: List[int], token: int,
+        eos_token: Optional[int], max_new_tokens: int,
+    ) -> bool:
+        """Append one ACCEPTED token to the real sequence (block-manager
+        accounting + events). Returns True when generation is finished."""
+        generated.append(token)
+        if eos_token is not None and token == eos_token:
+            return True
+        if len(generated) >= max_new_tokens:
+            return True
+        self.pod.block_manager.append_token(state, token)
+        return False

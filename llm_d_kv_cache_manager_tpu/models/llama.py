@@ -264,7 +264,9 @@ def _cache_attend(cache: tuple, q, block_tables, seq_lens, use_kernel: bool):
     return attend(q, *cache, block_tables, seq_lens)
 
 
-@functools.partial(jax.jit, static_argnames=("config",), donate_argnums=(2,))
+@functools.partial(
+    jax.jit, static_argnames=("config", "all_logits"), donate_argnums=(2,)
+)
 def prefill_cache(
     config: LlamaConfig,
     params: Params,
@@ -273,10 +275,13 @@ def prefill_cache(
     block_table: jax.Array,  # [pages_per_seq] int32
     start_pos,  # int32: number of already-cached tokens (prefix-cache hit)
     lora=None,  # models.lora per-layer adapter (select_adapter) or None
+    all_logits: bool = False,  # True: logits for EVERY position (spec verify)
 ) -> Tuple[tuple, jax.Array]:
     """Prefill new tokens, attending to the cached prefix; returns
-    (kv_cache, last_token_logits). `lora` applies q/v adapter deltas
-    (models/lora.py) for this sequence's adapter."""
+    (kv_cache, last_token_logits) — or [L, vocab] logits with
+    `all_logits=True`, the speculative-decoding verification pass (the MXU
+    scores every proposed position in one shot). `lora` applies q/v
+    adapter deltas (models/lora.py) for this sequence's adapter."""
     c = config
     l = tokens.shape[0]
     x = params["embed"][tokens][None]  # [1, L, d]
@@ -315,6 +320,8 @@ def prefill_cache(
         xs["lora"] = lora
     (x,), kv_cache = jax.lax.scan(layer_fn, (x,), xs)
     x = rms_norm(x, params["final_norm"], c.rms_eps)
+    if all_logits:
+        return kv_cache, x[0] @ params["out"]  # [L, vocab]
     logits = x[:, -1] @ params["out"]  # [1, vocab]
     return kv_cache, logits[0]
 

@@ -270,3 +270,29 @@ class TestEnginePodWithModel:
         generated2 = [pod.decode_step(state2) for _ in range(5)]
         assert generated2 == generated  # deterministic greedy decode
         pod.free(state2)
+
+
+class TestFreshPageRefcounts:
+    def test_shared_committed_page_not_reclaimed_under_live_reader(self):
+        # Regression (found in r2): fresh pages joined the table with
+        # ref_count 0, so after commit + reuse by a second sequence, the
+        # first sequence's free() dropped the count to zero and the page
+        # became reclaimable while the second sequence still read it.
+        bm = _manager(n_pages=4, page_size=4)
+        a = bm.allocate(list(range(8)))
+        bm.commit_prefill(a)
+        b = bm.allocate(list(range(8)))  # shares a's committed pages
+        bm.free(a)
+        bm.allocate([50, 51, 52, 53, 54, 55, 56, 57])  # takes the fresh pair
+        with pytest.raises(OutOfPagesError):
+            bm.allocate([70, 71, 72, 73])  # must NOT steal b's live pages
+        assert b.block_table == [0, 1]
+
+    def test_reserved_pages_return_to_pool_on_free(self):
+        bm = _manager(n_pages=8, page_size=4)
+        s = bm.allocate(list(range(8)))
+        bm.reserve_pages(s, 5)  # 2 in use + 3 reserved ahead
+        assert len(s.block_table) == 5
+        assert bm.num_free_pages == 3
+        bm.free(s)
+        assert bm.num_free_pages == 8  # reservations fully returned

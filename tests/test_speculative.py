@@ -1,0 +1,131 @@
+"""Speculative decoding: greedy-equivalence is the contract.
+
+With greedy sampling, speculation must produce BIT-IDENTICAL output to
+target-only greedy generation — the draft only changes latency, never
+content. A draft that equals the target must accept everything; a random
+draft must still yield identical output (with lower acceptance)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from llm_d_kv_cache_manager_tpu.engine.engine import EnginePod, EnginePodConfig
+from llm_d_kv_cache_manager_tpu.engine.speculative import SpeculativeDecoder
+from llm_d_kv_cache_manager_tpu.models import llama
+from llm_d_kv_cache_manager_tpu.models.llama import LlamaConfig
+
+TARGET_CFG = LlamaConfig(
+    vocab_size=128, d_model=32, n_layers=2, n_q_heads=2, n_kv_heads=2,
+    head_dim=16, d_ff=64, dtype=jnp.float32,
+)
+DRAFT_CFG = LlamaConfig(
+    vocab_size=128, d_model=16, n_layers=1, n_q_heads=2, n_kv_heads=2,
+    head_dim=8, d_ff=32, dtype=jnp.float32,
+)
+TARGET_PARAMS = llama.init_params(TARGET_CFG, jax.random.PRNGKey(0))
+DRAFT_PARAMS = llama.init_params(DRAFT_CFG, jax.random.PRNGKey(5))
+
+
+def _pod(n_pages=64):
+    return EnginePod(
+        EnginePodConfig(n_pages=n_pages, page_size=4, with_model=True,
+                        model_config=TARGET_CFG, max_pages_per_seq=16),
+        params=TARGET_PARAMS,
+    )
+
+
+def _greedy_reference(prompt, n_new, eos=None):
+    pod = _pod()
+    state, _ = pod.prefill(list(prompt))
+    out = [int(jnp.argmax(pod.last_logits))]
+    pod.decode_append(state, out[0])
+    while len(out) < n_new and (eos is None or out[-1] != eos):
+        out.append(pod.decode_step(state))
+    pod.free(state)
+    return out[: n_new] if eos is None else out
+
+
+class TestGreedyEquivalence:
+    @pytest.mark.parametrize("k", [1, 3, 4])
+    def test_weak_draft_output_identical(self, k):
+        prompt = list(range(2, 13))
+        expected = _greedy_reference(prompt, 12)
+        pod = _pod()
+        spec = SpeculativeDecoder(pod, DRAFT_CFG, DRAFT_PARAMS, k=k)
+        out = spec.generate(prompt, max_new_tokens=12)
+        assert out == expected
+        # Proposals are capped by the remaining budget in late rounds.
+        assert 0 < spec.stats.proposed <= spec.stats.rounds * k
+        assert spec.stats.accepted <= spec.stats.proposed
+
+    def test_perfect_draft_accepts_everything(self):
+        # Draft == target: every proposal must be accepted.
+        prompt = list(range(3, 10))
+        expected = _greedy_reference(prompt, 10)
+        pod = _pod()
+        spec = SpeculativeDecoder(pod, TARGET_CFG, TARGET_PARAMS, k=3)
+        out = spec.generate(prompt, max_new_tokens=10)
+        assert out == expected
+        # Every token beyond the per-round frontier token came from an
+        # accepted proposal — no proposal was ever *rejected* (the last
+        # round's tail is cut by the token budget, not by mismatch).
+        assert spec.stats.accepted == len(out) - spec.stats.rounds
+
+    def test_eos_stops_generation(self):
+        prompt = list(range(2, 10))
+        ref = _greedy_reference(prompt, 1)
+        eos = ref[0]
+        pod = _pod()
+        spec = SpeculativeDecoder(pod, DRAFT_CFG, DRAFT_PARAMS, k=3)
+        out = spec.generate(prompt, max_new_tokens=10, eos_token=eos)
+        assert out == [eos]
+
+
+class TestEngineStateHygiene:
+    def test_pages_fully_released_after_generation(self):
+        pod = _pod(n_pages=32)
+        spec = SpeculativeDecoder(pod, DRAFT_CFG, DRAFT_PARAMS, k=4)
+        spec.generate(list(range(2, 13)), max_new_tokens=8)
+        # All pages back (committed ones cached/reclaimable, reserved ones
+        # fresh): a second, larger run must still fit.
+        assert pod.block_manager.num_free_pages == 32
+        spec.generate(list(range(40, 60)), max_new_tokens=8)
+        assert pod.block_manager.num_free_pages == 32
+
+    def test_prefix_cache_only_advertises_accepted_tokens(self):
+        # Events committed during speculation must cover exactly the
+        # accepted sequence — never unverified proposals.
+        batches = []
+        pod = EnginePod(
+            EnginePodConfig(n_pages=64, page_size=4, with_model=True,
+                            model_config=TARGET_CFG, max_pages_per_seq=16),
+            event_sink=batches.append,
+            params=TARGET_PARAMS,
+        )
+        spec = SpeculativeDecoder(pod, DRAFT_CFG, DRAFT_PARAMS, k=4)
+        prompt = list(range(2, 10))
+        out = spec.generate(prompt, max_new_tokens=6)
+        full = prompt + list(out)
+        emitted_tokens = [
+            t for b in batches for e in b.events
+            if hasattr(e, "token_ids") for t in e.token_ids
+        ]
+        # Every emitted block is a prefix chunk of the accepted sequence.
+        assert emitted_tokens == full[: len(emitted_tokens)]
+
+    def test_page_capacity_boundary_completes(self):
+        # A generation that exactly fills max_pages_per_seq capacity must
+        # complete: proposals are capped so the verify chunk never reserves
+        # past the page budget (16 pages x 4 = 64-token capacity here).
+        prompt = list(range(2, 61))  # 59 tokens
+        expected = _greedy_reference(prompt, 5)
+        pod = _pod()
+        spec = SpeculativeDecoder(pod, DRAFT_CFG, DRAFT_PARAMS, k=4)
+        assert spec.generate(prompt, max_new_tokens=5) == expected
+
+    def test_rejects_k_zero_and_accounting_pods(self):
+        with pytest.raises(ValueError, match="k must be"):
+            SpeculativeDecoder(_pod(), DRAFT_CFG, DRAFT_PARAMS, k=0)
+        acct = EnginePod(EnginePodConfig(n_pages=8, page_size=4))
+        with pytest.raises(ValueError, match="with_model"):
+            SpeculativeDecoder(acct, DRAFT_CFG, DRAFT_PARAMS)
