@@ -10,18 +10,22 @@ TPU-first MoE design:
 - Experts live stacked on a leading axis [n_experts, ...] and are sharded
   over the "ep" mesh axis (see expert_param_specs); under jit XLA keeps each
   expert's matmuls local to its shard and all-reduces the combined output.
-- Routing is top-k softmax gating computed densely: every expert processes
-  the full token batch and outputs are combined with the (mostly-zero) gate
-  matrix via one einsum. This is exact (no capacity dropping) and maps onto
-  the MXU as n_experts large matmuls; at demo scale the flops trade is right,
-  and the seam where a capacity-based gather/scatter dispatch would slot in
-  is `_moe_mlp`.
+- Routing is top-k softmax gating with two dispatch modes, selected by
+  `MixtralConfig.capacity_factor`:
+  * None (default): exact dense dispatch — every expert processes the full
+    token batch and outputs combine through the (mostly-zero) gate matrix.
+    No dropping, E× the expert FLOPs; the right trade at demo scale and the
+    numerical oracle for the capacity path.
+  * float (e.g. 1.25): GShard/Switch-style static-capacity dispatch
+    (`_moe_mlp_capacity`) — sort-based token→expert slotting with a fixed
+    per-expert capacity, overflow tokens dropped to the residual. The
+    production path: static shapes, E× fewer expert FLOPs.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -46,6 +50,12 @@ class MixtralConfig:
     d_ff: int = 512
     n_experts: int = 8
     top_k: int = 2
+    # None -> exact dense dispatch (every expert sees every token, E× the
+    # FLOPs, no dropping). A float (GShard-style, e.g. 1.25) -> fixed
+    # per-expert capacity C = ceil(S/E · factor · top_k): static shapes,
+    # each expert computes only C tokens, overflow tokens fall back to the
+    # residual path for that expert slot.
+    capacity_factor: Optional[float] = None
     rope_theta: float = 500_000.0
     rms_eps: float = 1e-5
     dtype: jnp.dtype = jnp.bfloat16
@@ -89,9 +99,77 @@ def init_params(config: MixtralConfig, key: jax.Array) -> Params:
     }
 
 
+def _moe_mlp_capacity(
+    config: MixtralConfig, layer: Dict, x: jax.Array
+) -> jax.Array:
+    """Capacity-based (GShard/Switch-style) top-k dispatch. x: [B, L, d].
+
+    TPU-idiomatic MoE: per-expert capacity C is a STATIC shape, so each
+    expert runs exactly C tokens on the MXU regardless of routing —
+    compiler-friendly, E× fewer expert FLOPs than the exact dense path, at
+    the cost of dropping overflow tokens (which then ride the residual
+    connection). Dispatch is SORT-based: the S·K (token, choice) pairs are
+    stably sorted by expert (k-major, so k=0 claims slots first), given
+    in-group positions by a cumulative count, and scattered/gathered into
+    the [E, C, d] expert batch — O(SK·log(SK) + SK·d) instead of the
+    O(S²·d) a one-hot dispatch matrix costs. The experts axis stays a
+    leading array dim, so ep sharding is unchanged.
+    """
+    c = config
+    b, l, d = x.shape
+    s = b * l
+    sk = s * c.top_k
+    xf = x.reshape(s, d)
+    capacity = max(
+        1,
+        int(-(-s * c.top_k * c.capacity_factor // c.n_experts)),  # ceil
+    )
+
+    logits = (xf @ layer["router"]).astype(jnp.float32)  # [S, E]
+    top_vals, top_idx = jax.lax.top_k(logits, c.top_k)
+    gates = jax.nn.softmax(top_vals, axis=-1).astype(jnp.float32)  # [S, K]
+
+    # k-major pair order: all k=0 pairs (token order), then k=1, ...
+    flat_expert = top_idx.T.reshape(sk)
+    flat_gate = gates.T.reshape(sk)
+    flat_tok = jnp.tile(jnp.arange(s, dtype=jnp.int32), (c.top_k,))
+
+    order = jnp.argsort(flat_expert, stable=True)
+    se = flat_expert[order]  # sorted pair -> expert
+    sg = flat_gate[order]
+    st = flat_tok[order]
+    counts = jnp.bincount(flat_expert, length=c.n_experts)
+    group_start = jnp.cumsum(counts) - counts
+    pos = jnp.arange(sk, dtype=jnp.int32) - group_start[se]
+    keep = pos < capacity
+
+    # Scatter kept pairs into the expert batch; dropped pairs land in a
+    # trash slot that is sliced away. Destinations of kept pairs are unique
+    # by construction (distinct (expert, position)).
+    dest = jnp.where(keep, se * capacity + pos, c.n_experts * capacity)
+    expert_in = jnp.zeros((c.n_experts * capacity + 1, d), x.dtype)
+    expert_in = expert_in.at[dest].set(xf[st])
+    expert_in = expert_in[:-1].reshape(c.n_experts, capacity, d)
+
+    gate_proj = jnp.einsum("ecd,edf->ecf", expert_in, layer["w_gate"])
+    up_proj = jnp.einsum("ecd,edf->ecf", expert_in, layer["w_up"])
+    hidden = jax.nn.silu(gate_proj) * up_proj  # [E, C, f]
+    expert_out = jnp.einsum("ecf,efd->ecd", hidden, layer["w_down"])
+
+    # Combine: gather each kept pair's expert output, weight by its gate,
+    # scatter-add back to its token (a token's k pairs sum).
+    out_flat = expert_out.reshape(c.n_experts * capacity, d).astype(jnp.float32)
+    vals = out_flat[jnp.where(keep, se * capacity + pos, 0)]
+    vals = vals * (sg * keep.astype(jnp.float32))[:, None]
+    y = jnp.zeros((s, d), jnp.float32).at[st].add(vals)
+    return y.astype(x.dtype).reshape(b, l, d)
+
+
 def _moe_mlp(config: MixtralConfig, layer: Dict, x: jax.Array) -> jax.Array:
     """Top-k routed mixture of SwiGLU experts. x: [B, L, d]."""
     c = config
+    if c.capacity_factor is not None:
+        return _moe_mlp_capacity(c, layer, x)
     logits = (x @ layer["router"]).astype(jnp.float32)  # [B, L, E]
     top_vals, top_idx = jax.lax.top_k(logits, c.top_k)
     gates = jax.nn.softmax(top_vals, axis=-1).astype(x.dtype)  # [B, L, K]

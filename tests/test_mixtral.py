@@ -7,6 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from llm_d_kv_cache_manager_tpu.models import mixtral
 from llm_d_kv_cache_manager_tpu.models.mixtral import (
     MixtralConfig,
     _moe_mlp,
@@ -93,3 +94,61 @@ class TestExpertParallel:
         host = jax.tree_util.tree_map(np.asarray, params)
         ref = loss_fn(cfg, host, np.asarray(batch))
         np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+
+
+class TestCapacityDispatch:
+    def _cfg(self, **over):
+        import dataclasses
+        return dataclasses.replace(CFG, **over)
+
+    def test_ample_capacity_matches_dense_dispatch(self):
+        # With capacity >= every routed token, GShard dispatch computes the
+        # exact same mixture as the dense all-experts path.
+        import numpy as np
+
+        cfg_dense = self._cfg(capacity_factor=None)
+        cfg_cap = self._cfg(capacity_factor=float(cfg_dense.n_experts * 4))
+        params = mixtral.init_params(cfg_dense, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                    cfg_dense.vocab_size)
+        dense = mixtral.forward_dense(cfg_dense, params, tokens)
+        cap = mixtral.forward_dense(cfg_cap, params, tokens)
+        np.testing.assert_allclose(
+            np.asarray(cap, np.float32), np.asarray(dense, np.float32),
+            rtol=2e-2, atol=2e-2,
+        )
+
+    def test_tight_capacity_actually_drops(self):
+        import numpy as np
+
+        tight = self._cfg(capacity_factor=0.25)  # aggressive dropping
+        ample = self._cfg(capacity_factor=float(tight.n_experts * 4))
+        params = mixtral.init_params(tight, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                    tight.vocab_size)
+        out_tight = np.asarray(mixtral.forward_dense(tight, params, tokens),
+                               np.float32)
+        out_ample = np.asarray(mixtral.forward_dense(ample, params, tokens),
+                               np.float32)
+        assert np.isfinite(out_tight).all()
+        # Overflow tokens were dropped: outputs must differ from the
+        # no-dropping dispatch (a no-op/zero capacity path can't pass both
+        # this and the ample-capacity equivalence test).
+        assert not np.allclose(out_tight, out_ample, atol=1e-3)
+
+    def test_capacity_static_shapes_aot_executable_reusable(self):
+        # The whole point on TPU: capacity is static, so one compiled
+        # executable serves any routing decision. AOT-compile once, then
+        # run the same executable on different token values.
+        cfg = self._cfg(capacity_factor=1.25)
+        params = mixtral.init_params(cfg, jax.random.PRNGKey(0))
+        fwd = jax.jit(functools.partial(mixtral.forward_dense, cfg))
+        batches = [
+            jax.random.randint(jax.random.PRNGKey(seed), (2, 8), 0,
+                               cfg.vocab_size)
+            for seed in range(3)
+        ]
+        compiled = fwd.lower(params, batches[0]).compile()
+        for tokens in batches:
+            out = compiled(params, tokens)
+            assert out.shape == (2, 8, cfg.vocab_size)
