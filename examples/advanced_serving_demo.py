@@ -1,0 +1,167 @@
+"""Advanced serving demo: two-tier KV data plane + multi-LoRA + speculative.
+
+Runs offline on any backend (tiny f32 models) and exercises the round-2
+serving features end to end:
+
+1. **Two-tier data plane**: pod A computes a prefix, exports it to its C++
+   transfer server; pod B — which never computed it — onboards the blocks
+   over the (loopback) DCN leg, resolved through the shared control-plane
+   index, and serves with identical logits.
+2. **Multi-LoRA**: one pod serves base + two adapters in a single
+   continuous batch; outputs match dedicated merged-weight pods.
+3. **Speculative decoding**: a small draft proposes, the target verifies
+   all positions in one pass; output is identical to plain greedy.
+
+Run: python examples/advanced_serving_demo.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+# Functional demo with tiny models and many jit shapes: run on CPU so it is
+# snappy everywhere (the axon TPU plugin ignores JAX_PLATFORMS env; the
+# config API is authoritative).
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+from llm_d_kv_cache_manager_tpu.engine.engine import EnginePod, EnginePodConfig
+from llm_d_kv_cache_manager_tpu.engine.scheduler import Scheduler
+from llm_d_kv_cache_manager_tpu.engine.speculative import SpeculativeDecoder
+from llm_d_kv_cache_manager_tpu.engine.tiering import IndexBackedPeerResolver
+from llm_d_kv_cache_manager_tpu.kv_connectors.connector import native_available
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import InMemoryIndex
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.pool import EventPool, EventPoolConfig, Message
+from llm_d_kv_cache_manager_tpu.models import llama, lora
+from llm_d_kv_cache_manager_tpu.models.llama import LlamaConfig
+
+CFG = LlamaConfig(vocab_size=256, d_model=64, n_layers=2, n_q_heads=4,
+                  n_kv_heads=2, head_dim=16, d_ff=128, dtype=jnp.float32)
+DRAFT_CFG = LlamaConfig(vocab_size=256, d_model=32, n_layers=1, n_q_heads=2,
+                        n_kv_heads=2, head_dim=16, d_ff=64, dtype=jnp.float32)
+PARAMS = llama.init_params(CFG, jax.random.PRNGKey(0))
+MODEL = "demo-model"
+PAGE = 4
+
+
+def demo_two_tier():
+    if not native_available():
+        print("[1] two-tier: skipped (libkvtransfer.so not built — run "
+              "`make -C kv_connectors/cpp`)")
+        return
+    index = InMemoryIndex()
+    processor = ChunkedTokenDatabase(TokenProcessorConfig(block_size=PAGE))
+    pool = EventPool(EventPoolConfig(concurrency=1), index, processor)
+    pool.start(with_subscriber=False)
+
+    def sink(pod_id):
+        def s(batch):
+            pool.add_task(Message(f"kv@{pod_id}@{MODEL}", batch.to_msgpack(),
+                                  0, pod_id, MODEL))
+        return s
+
+    def pod(pod_id):
+        return EnginePod(EnginePodConfig(
+            pod_id=pod_id, model_name=MODEL, n_pages=32, page_size=PAGE,
+            device_tier="hbm", with_model=True, model_config=CFG,
+            enable_host_tier=True,
+        ), event_sink=sink(pod_id), params=PARAMS)
+
+    a, b = pod("pod-a"), pod("pod-b")
+    try:
+        prompt = list(np.random.RandomState(1).randint(0, CFG.vocab_size, 19))
+        state_a, _ = a.prefill(prompt)
+        n = a.export_sequence(state_a)
+        pool.drain()
+        b.set_peer_resolver(IndexBackedPeerResolver(
+            index, MODEL, {"pod-a": a.transfer_address}, "pod-b"))
+        _, cached = b.prefill(prompt)
+        same = np.allclose(np.asarray(b.last_logits), np.asarray(a.last_logits),
+                           atol=1e-4)
+        print(f"[1] two-tier: pod-a exported {n} blocks; pod-b onboarded "
+              f"{b.tier_store.stats['onboards']} over DCN, served "
+              f"{cached}/19 tokens from cache, logits identical: {same}")
+        assert same and cached == 16
+    finally:
+        a.close(); b.close(); pool.shutdown()
+
+
+def _generate_isolated(params, prompt, n_new):
+    pod = EnginePod(EnginePodConfig(
+        n_pages=64, page_size=PAGE, with_model=True, model_config=CFG,
+        max_pages_per_seq=16,
+    ), params=params)
+    state, _ = pod.prefill(list(prompt))
+    out = [int(jnp.argmax(pod.last_logits))]
+    pod.decode_append(state, out[0])
+    while len(out) < n_new:
+        out.append(pod.decode_step(state))
+    pod.free(state)
+    return out
+
+
+def demo_multi_lora():
+    adapter_a = lora.make_test_adapter(CFG, rank=4, key=jax.random.PRNGKey(1))
+    adapter_b = lora.make_test_adapter(CFG, rank=4, key=jax.random.PRNGKey(2))
+    pod = EnginePod(EnginePodConfig(
+        n_pages=64, page_size=PAGE, with_model=True, model_config=CFG,
+        max_pages_per_seq=16,
+    ), params=PARAMS, lora_adapters={1: adapter_a, 2: adapter_b})
+    sched = Scheduler(pod, max_batch=4)
+    prompts = {"base": list(range(5)), "adapter-1": list(range(20, 28)),
+               "adapter-2": list(range(40, 46))}
+    ids = {
+        "base": sched.submit(prompts["base"], max_new_tokens=5),
+        "adapter-1": sched.submit(prompts["adapter-1"], max_new_tokens=5, lora_id=1),
+        "adapter-2": sched.submit(prompts["adapter-2"], max_new_tokens=5, lora_id=2),
+    }
+    results = sched.run()
+    outs = {name: results[rid] for name, rid in ids.items()}
+    print(f"[2] multi-LoRA mixed batch: {outs}")
+    # The contract: each request matches a dedicated pod running the
+    # (merged) weights for its adapter.
+    assert outs["base"] == _generate_isolated(PARAMS, prompts["base"], 5)
+    assert outs["adapter-1"] == _generate_isolated(
+        lora.merge_adapter(PARAMS, adapter_a), prompts["adapter-1"], 5)
+    assert outs["adapter-2"] == _generate_isolated(
+        lora.merge_adapter(PARAMS, adapter_b), prompts["adapter-2"], 5)
+
+
+def demo_speculative():
+    draft_params = llama.init_params(DRAFT_CFG, jax.random.PRNGKey(7))
+    pod = EnginePod(EnginePodConfig(
+        n_pages=64, page_size=PAGE, with_model=True, model_config=CFG,
+        max_pages_per_seq=16,
+    ), params=PARAMS)
+    spec = SpeculativeDecoder(pod, DRAFT_CFG, draft_params, k=4)
+    prompt = list(range(2, 13))
+    out = spec.generate(prompt, max_new_tokens=10)
+
+    ref_pod = EnginePod(EnginePodConfig(
+        n_pages=64, page_size=PAGE, with_model=True, model_config=CFG,
+        max_pages_per_seq=16,
+    ), params=PARAMS)
+    state, _ = ref_pod.prefill(prompt)
+    ref = [int(jnp.argmax(ref_pod.last_logits))]
+    ref_pod.decode_append(state, ref[0])
+    while len(ref) < 10:
+        ref.append(ref_pod.decode_step(state))
+    print(f"[3] speculative: {len(out)} tokens, acceptance "
+          f"{spec.stats.acceptance_rate:.0%} over {spec.stats.rounds} rounds, "
+          f"identical to plain greedy: {out == ref}")
+    assert out == ref
+
+
+if __name__ == "__main__":
+    demo_two_tier()
+    demo_multi_lora()
+    demo_speculative()
+    print("OK: advanced serving demo complete")

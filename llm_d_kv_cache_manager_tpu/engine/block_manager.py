@@ -141,7 +141,10 @@ class BlockManager:
         pool cannot cover the request (caller should retry later). A
         `lora_id` scopes prefix reuse to that adapter's blocks.
         """
-        tokens = list(tokens)
+        # Genuine Python ints throughout: token ids often arrive as
+        # numpy/jax scalars, which the hash fast path and msgpack events
+        # both reject.
+        tokens = [int(t) for t in tokens]
         n_pages_needed = (len(tokens) + self.config.page_size - 1) // self.config.page_size
 
         block_table: List[int] = []
@@ -208,7 +211,7 @@ class BlockManager:
     def append_token(self, state: SequenceState, token: int) -> None:
         """Record one decoded token; allocates a new page at boundaries and
         commits pages as they fill."""
-        state.tokens.append(token)
+        state.tokens.append(int(token))
         pages_needed = (
             len(state.tokens) + self.config.page_size - 1
         ) // self.config.page_size

@@ -135,6 +135,10 @@ def prefix_hashes_fast(
     if n_full == 0:
         return []
     if _native is not None and extra is None:
-        return list(_native.prefix_hashes(parent, list(tokens), block_size))
+        # The C extension requires genuine Python ints; token ids often
+        # arrive as numpy/jax integer scalars from engine code.
+        return list(_native.prefix_hashes(
+            int(parent), [int(t) for t in tokens], block_size
+        ))
     chunks = [tokens[i * block_size:(i + 1) * block_size] for i in range(n_full)]
     return prefix_hashes(parent, chunks, extra)

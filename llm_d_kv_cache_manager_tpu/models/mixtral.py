@@ -19,7 +19,9 @@ TPU-first MoE design:
   * float (e.g. 1.25): GShard/Switch-style static-capacity dispatch
     (`_moe_mlp_capacity`) — sort-based token→expert slotting with a fixed
     per-expert capacity, overflow tokens dropped to the residual. The
-    production path: static shapes, E× fewer expert FLOPs.
+    production path: static shapes, E/(K·factor)× fewer expert FLOPs
+    (dense runs E·S expert-token units, capacity runs E·C ≈ S·K·factor —
+    e.g. 3.2× at E=8, K=2, factor=1.25).
 """
 
 from __future__ import annotations
@@ -106,7 +108,8 @@ def _moe_mlp_capacity(
 
     TPU-idiomatic MoE: per-expert capacity C is a STATIC shape, so each
     expert runs exactly C tokens on the MXU regardless of routing —
-    compiler-friendly, E× fewer expert FLOPs than the exact dense path, at
+    compiler-friendly, E/(K·factor)× fewer expert FLOPs than the exact
+    dense path (dense: E·S expert-token units; capacity: E·C ≈ S·K·factor), at
     the cost of dropping overflow tokens (which then ride the residual
     connection). Dispatch is SORT-based: the S·K (token, choice) pairs are
     stably sorted by expert (k-major, so k=0 claims slots first), given
