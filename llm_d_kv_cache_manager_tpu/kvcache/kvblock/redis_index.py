@@ -88,7 +88,17 @@ class RedisIndex(Index):
         if not request_keys:
             raise ValueError("no request keys provided for lookup")
 
-        replies = self._pipeline([("HKEYS", _key_str(k)) for k in request_keys])
+        try:
+            replies = self._pipeline(
+                [("HKEYS", _key_str(k)) for k in request_keys]
+            )
+        except OSError as e:  # includes ConnectionError
+            # Reference semantics (redis.go:185-192): a Redis failure cuts
+            # the prefix chain — the read path degrades to a cache miss, it
+            # never unwinds the scoring request. Writes still raise (their
+            # callers log and drop the event).
+            logger.debug("redis lookup failed, cutting chain: %s", e)
+            return {}
 
         pods_per_key: Dict[Key, List[PodEntry]] = {}
         for key, reply in zip(request_keys, replies):
@@ -145,6 +155,12 @@ class RedisIndex(Index):
             ])
 
     def get_request_key(self, engine_key: Key) -> Optional[Key]:
+        # Deliberately NOT soft-failed: None means "parent genuinely not
+        # indexed" and makes the event pool start a fresh hash chain —
+        # returning it on a connection blip would commit mid-prompt blocks
+        # under fresh-chain request keys (false prefix hits that persist).
+        # A raised error instead drops the event batch (worker catch-all),
+        # which is consistent.
         replies = self._pipeline([("GET", _engine_key_str(engine_key))])
         value = replies[0]
         if value is None or isinstance(value, RespError):

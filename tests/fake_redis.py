@@ -23,6 +23,7 @@ class FakeRedisServer:
         self._sock.bind(("127.0.0.1", 0))
         self._sock.listen(16)
         self.port = self._sock.getsockname()[1]
+        self._conns: list = []
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._thread.start()
@@ -37,6 +38,13 @@ class FakeRedisServer:
             self._sock.close()
         except OSError:
             pass
+        # Drop live client connections too, so close() simulates a real
+        # server death for fault-injection tests.
+        for conn in list(self._conns):
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     # -- server loops --------------------------------------------------------
 
@@ -46,6 +54,7 @@ class FakeRedisServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            self._conns.append(conn)
             threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True
             ).start()
