@@ -38,7 +38,8 @@ def bench_paged_attention():
     )
 
     print("paged attention decode (n_q=8 n_kv=4 hd=128, page=128, bf16):")
-    print(f"{'batch':>6} {'ctx':>6} | {'pallas us':>10} {'gather us':>10} {'speedup':>8}")
+    print(f"{'batch':>6} {'ctx':>6} | {'tiled us':>9} {'piped us':>9} "
+          f"{'gather us':>10} {'speedup':>8}")
     for batch, ctx_pages in [(1, 8), (4, 8), (8, 8), (8, 32), (16, 16), (32, 8)]:
         n_pages = max(batch * ctx_pages + 1, 64)
         keys = jax.random.split(jax.random.PRNGKey(0), 4)
@@ -49,11 +50,16 @@ def bench_paged_attention():
         bt = bt.reshape(batch, ctx_pages).astype(jnp.int32)
         seq_lens = jnp.full((batch,), ctx_pages * 128 - 5, jnp.int32)
 
-        t_kernel = timeit(paged_attention, q, kp, vp, bt, seq_lens)
+        t_tiled = timeit(paged_attention, q, kp, vp, bt, seq_lens)
+        t_piped = timeit(
+            lambda *a: paged_attention(*a, pipelined=True),
+            q, kp, vp, bt, seq_lens,
+        )
         t_ref = timeit(paged_attention_reference, q, kp, vp, bt, seq_lens)
         print(
-            f"{batch:>6} {ctx_pages * 128:>6} | {t_kernel * 1e6:>10.0f} "
-            f"{t_ref * 1e6:>10.0f} {t_ref / t_kernel:>7.2f}x"
+            f"{batch:>6} {ctx_pages * 128:>6} | {t_tiled * 1e6:>9.0f} "
+            f"{t_piped * 1e6:>9.0f} {t_ref * 1e6:>10.0f} "
+            f"{t_ref / min(t_tiled, t_piped):>7.2f}x"
         )
 
 

@@ -17,6 +17,7 @@ placeholder maps to DCN-attached Valkey on TPU fleets (config flag kept).
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Set
 
@@ -62,11 +63,22 @@ def _parse_entry(field: str) -> Optional[PodEntry]:
     return PodEntry(pod, tier)
 
 
+# After a failed reconnect, skip further reconnect attempts for this long:
+# without it, a partitioned Redis makes EVERY scoring lookup block the full
+# connect timeout before soft-failing — a fleet-wide stall, not a miss.
+RECONNECT_BACKOFF_S = 5.0
+# Cut-chain events surface at WARNING at most this often (an outage must be
+# operator-visible, not a debug-level mystery hit-rate collapse).
+_WARN_INTERVAL_S = 30.0
+
+
 class RedisIndex(Index):
     def __init__(self, config: Optional[RedisIndexConfig] = None):
         self.config = config or RedisIndexConfig()
         self._conn = RespConnection(self.config.url, self.config.timeout_s)
         self._mu = threading.Lock()  # serialize reconnect attempts
+        self._down_until = 0.0
+        self._last_warn = 0.0
         self._conn.connect()
         if not self._conn.ping():
             raise ConnectionError(f"redis PING failed for {self.config.url}")
@@ -75,12 +87,34 @@ class RedisIndex(Index):
         self._conn.close()
 
     def _pipeline(self, commands):
+        if time.monotonic() < self._down_until:
+            raise ConnectionError(
+                f"redis backend in reconnect backoff ({self.config.url})"
+            )
         try:
-            return self._conn.pipeline(commands)
-        except (ConnectionError, OSError):
+            replies = self._conn.pipeline(commands)
+        except OSError:
             with self._mu:
-                self._conn.connect()
-            return self._conn.pipeline(commands)
+                try:
+                    self._conn.connect()
+                except OSError:
+                    self._down_until = time.monotonic() + RECONNECT_BACKOFF_S
+                    raise
+            try:
+                replies = self._conn.pipeline(commands)
+            except OSError:
+                self._down_until = time.monotonic() + RECONNECT_BACKOFF_S
+                raise
+        self._down_until = 0.0
+        return replies
+
+    def _warn_cut(self, e: Exception) -> None:
+        now = time.monotonic()
+        if now - self._last_warn >= _WARN_INTERVAL_S:
+            self._last_warn = now
+            logger.warning(
+                "redis index unavailable, scoring degrades to cache misses: %s", e
+            )
 
     def lookup(
         self, request_keys: Sequence[Key], pod_identifier_set: Set[str]
@@ -97,7 +131,7 @@ class RedisIndex(Index):
             # the prefix chain — the read path degrades to a cache miss, it
             # never unwinds the scoring request. Writes still raise (their
             # callers log and drop the event).
-            logger.debug("redis lookup failed, cutting chain: %s", e)
+            self._warn_cut(e)
             return {}
 
         pods_per_key: Dict[Key, List[PodEntry]] = {}

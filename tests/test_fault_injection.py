@@ -90,6 +90,8 @@ class TestDataPlaneFaults:
 
 class TestSharedIndexFaults:
     def test_redis_death_cuts_chain_not_process(self):
+        import time as _time
+
         srv = FakeRedisServer()
         index = RedisIndex(RedisIndexConfig(url=srv.url))
         key = Key("m", 7)
@@ -101,4 +103,23 @@ class TestSharedIndexFaults:
         # Lookup after the server dies: the prefix chain cuts (empty result)
         # instead of an exception unwinding the read path.
         assert index.lookup([key], set()) == {}
+        # Sustained outage: the reconnect backoff makes subsequent lookups
+        # fail FAST (no per-request connect-timeout stall on the hot path).
+        t0 = _time.monotonic()
+        for _ in range(5):
+            assert index.lookup([key], set()) == {}
+        assert _time.monotonic() - t0 < 1.0
+        index.close()
+
+    def test_outage_is_operator_visible(self, caplog):
+        import logging as _logging
+
+        srv = FakeRedisServer()
+        index = RedisIndex(RedisIndexConfig(url=srv.url))
+        key = Key("m", 9)
+        index.add([key], [key], [PodEntry("p1", "hbm")])
+        srv.close()
+        with caplog.at_level(_logging.WARNING):
+            index.lookup([key], set())
+        assert any("degrades to cache misses" in r.message for r in caplog.records)
         index.close()
