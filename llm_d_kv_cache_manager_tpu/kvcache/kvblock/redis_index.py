@@ -78,7 +78,9 @@ class RedisIndex(Index):
         self._conn = RespConnection(self.config.url, self.config.timeout_s)
         self._mu = threading.Lock()  # serialize reconnect attempts
         self._down_until = 0.0
-        self._last_warn = 0.0
+        # Negative sentinel: monotonic() is time-since-boot, so 0.0 would
+        # suppress the FIRST outage warning during early uptime.
+        self._last_warn = -_WARN_INTERVAL_S
         self._conn.connect()
         if not self._conn.ping():
             raise ConnectionError(f"redis PING failed for {self.config.url}")

@@ -137,16 +137,10 @@ def _decode_kernel_pipelined(
     block_tables_ref,  # SMEM [batch, pages_per_seq] (scalar prefetch)
     seq_lens_ref,  # SMEM [batch]
     q_ref,  # VMEM (1, n_kv, GROUP_PAD, head_dim)
-    k_hbm_ref,  # ANY [n_kv, n_pages, page_size, head_dim]
-    v_hbm_ref,
-    o_ref,  # VMEM (1, n_kv, GROUP_PAD, head_dim)
-    k_buf,  # VMEM (2, n_kv, page_size, head_dim) double buffer
-    v_buf,
-    k_sem,  # DMA semaphores (2,)
-    v_sem,
-    *,
+    *rest,  # N HBM page arrays, o_ref, N double buffers, N DMA sem arrays
     page_size: int,
     scale: float,
+    quantized: bool,
 ):
     """Flash-decoding with a manual double-buffered page pipeline.
 
@@ -163,8 +157,16 @@ def _decode_kernel_pipelined(
     - compute is batched over heads on the MXU (dot_general with the head
       axis as a batch dim), so the inner loop stays two matmuls per page.
 
-    Only the pages each sequence actually references move on the bus.
+    Only the pages each sequence actually references move on the bus. The
+    int8-quantized format pipelines four arrays per page (values + per-row
+    scales for K and V) and dequantizes in VMEM, like the tiled variant.
     """
+    n_arrays = 4 if quantized else 2
+    hbm_refs = rest[:n_arrays]
+    o_ref = rest[n_arrays]
+    bufs = rest[n_arrays + 1:2 * n_arrays + 1]
+    sems = rest[2 * n_arrays + 1:]
+
     b = pl.program_id(0)
     seq_len = seq_lens_ref[b]
     n_pages = (seq_len + page_size - 1) // page_size
@@ -172,25 +174,20 @@ def _decode_kernel_pipelined(
     group_pad = q_ref.shape[2]
     head_dim = q_ref.shape[3]
 
-    def k_dma(slot, idx):
-        return pltpu.make_async_copy(
-            k_hbm_ref.at[:, block_tables_ref[b, idx]], k_buf.at[slot],
-            k_sem.at[slot],
-        )
-
-    def v_dma(slot, idx):
-        return pltpu.make_async_copy(
-            v_hbm_ref.at[:, block_tables_ref[b, idx]], v_buf.at[slot],
-            v_sem.at[slot],
-        )
+    def dmas(slot, idx):
+        page = block_tables_ref[b, idx]
+        return [
+            pltpu.make_async_copy(hbm.at[:, page], buf.at[slot], sem.at[slot])
+            for hbm, buf, sem in zip(hbm_refs, bufs, sems)
+        ]
 
     # Padded batch slots (seq_len == 0) must not emit VMEM garbage.
     o_ref[0] = jnp.zeros_like(o_ref[0])
 
     @pl.when(n_pages > 0)
     def _run():
-        k_dma(0, 0).start()
-        v_dma(0, 0).start()
+        for dma in dmas(0, 0):
+            dma.start()
         q = q_ref[0].astype(jnp.float32)  # (n_kv, GROUP_PAD, hd)
 
         def body(i, carry):
@@ -199,13 +196,19 @@ def _decode_kernel_pipelined(
 
             @pl.when(i + 1 < n_pages)
             def _prefetch_next():
-                k_dma((i + 1) % 2, i + 1).start()
-                v_dma((i + 1) % 2, i + 1).start()
+                for dma in dmas((i + 1) % 2, i + 1):
+                    dma.start()
 
-            k_dma(slot, i).wait()
-            v_dma(slot, i).wait()
-            k = k_buf[slot].astype(jnp.float32)  # (n_kv, page, hd)
-            v = v_buf[slot].astype(jnp.float32)
+            for dma in dmas(slot, i):
+                dma.wait()
+            if quantized:
+                kq_buf, ks_buf, vq_buf, vs_buf = bufs
+                k = kq_buf[slot].astype(jnp.float32) * ks_buf[slot]
+                v = vq_buf[slot].astype(jnp.float32) * vs_buf[slot]
+            else:
+                k_buf, v_buf = bufs
+                k = k_buf[slot].astype(jnp.float32)  # (n_kv, page, hd)
+                v = v_buf[slot].astype(jnp.float32)
 
             s = jax.lax.dot_general(
                 q, k, (((2,), (2,)), ((0,), (0,))),
@@ -238,14 +241,14 @@ def _decode_kernel_pipelined(
 
 def _paged_attention_call_pipelined(
     q: jax.Array,
-    k_pages: jax.Array,
-    v_pages: jax.Array,
+    kv_arrays,  # (k, v) or (k_q, k_scale, v_q, v_scale)
     block_tables: jax.Array,
     seq_lens: jax.Array,
     *,
+    quantized: bool,
     interpret: bool,
 ) -> jax.Array:
-    n_kv_heads, _n_pages, page_size, head_dim = k_pages.shape
+    n_kv_heads, _n_pages, page_size, head_dim = kv_arrays[0].shape
     batch, n_q_heads, _ = q.shape
     group = n_q_heads // n_kv_heads
     if group * n_kv_heads != n_q_heads:
@@ -264,21 +267,25 @@ def _paged_attention_call_pipelined(
     )
     hbm_spec = pl.BlockSpec(memory_space=pltpu.ANY)
 
+    # One double buffer + DMA sem pair per pipelined array; buffer shapes
+    # mirror each array's per-page slice ((n_kv, page, hd) or (n_kv, page, 1)).
+    buf_shapes = [
+        pltpu.VMEM((2, n_kv_heads) + arr.shape[2:], arr.dtype)
+        for arr in kv_arrays
+    ]
+    sem_shapes = [pltpu.SemaphoreType.DMA((2,)) for _ in kv_arrays]
+
     out = pl.pallas_call(
         functools.partial(
-            _decode_kernel_pipelined, page_size=page_size, scale=scale
+            _decode_kernel_pipelined, page_size=page_size, scale=scale,
+            quantized=quantized,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(batch,),
-            in_specs=[q_spec, hbm_spec, hbm_spec],
+            in_specs=[q_spec] + [hbm_spec] * len(kv_arrays),
             out_specs=q_spec,
-            scratch_shapes=[
-                pltpu.VMEM((2, n_kv_heads, page_size, head_dim), k_pages.dtype),
-                pltpu.VMEM((2, n_kv_heads, page_size, head_dim), v_pages.dtype),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((2,)),
-            ],
+            scratch_shapes=buf_shapes + sem_shapes,
         ),
         out_shape=jax.ShapeDtypeStruct(
             (batch, n_kv_heads, group_pad, head_dim), q.dtype
@@ -287,7 +294,7 @@ def _paged_attention_call_pipelined(
             dimension_semantics=("parallel",),
         ),
         interpret=interpret,
-    )(block_tables, seq_lens, qg, k_pages, v_pages)
+    )(block_tables, seq_lens, qg, *kv_arrays)
 
     return out[:, :, :group, :].reshape(batch, n_q_heads, head_dim)
 
@@ -392,7 +399,8 @@ def paged_attention(
     n_kv_heads, _n_pages, page_size, head_dim = k_pages.shape
     if pipelined:
         return _paged_attention_call_pipelined(
-            q, k_pages, v_pages, block_tables, seq_lens, interpret=interpret
+            q, (k_pages, v_pages), block_tables, seq_lens,
+            quantized=False, interpret=interpret,
         )
     return _paged_attention_call(
         q,

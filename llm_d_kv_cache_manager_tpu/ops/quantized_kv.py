@@ -86,7 +86,7 @@ def paged_attention_quantized_reference(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "pipelined"))
 def paged_attention_quantized(
     q: jax.Array,  # [batch, n_q_heads, head_dim]
     k_q: jax.Array,  # [n_kv, n_pages, page, hd] int8
@@ -97,17 +97,27 @@ def paged_attention_quantized(
     seq_lens: jax.Array,
     *,
     interpret: bool = False,
+    pipelined: bool = False,
 ) -> jax.Array:
     """Flash-decoding over int8 KV pages with in-VMEM dequantization.
 
-    Same kernel body and grid wiring as ops.paged_attention (shared via
-    _paged_attention_call, quantized=True) — the only delta is the int8
-    page + per-row-scale loads and the dequant multiplies.
+    Same kernel bodies and grid wiring as ops.paged_attention (shared via
+    _paged_attention_call / _paged_attention_call_pipelined,
+    quantized=True) — the only delta is the int8 page + per-row-scale
+    loads and the dequant multiplies. `pipelined=True` selects the
+    per-sequence manual-DMA variant (four arrays per page move in strided
+    all-head descriptors).
     """
     from llm_d_kv_cache_manager_tpu.ops.paged_attention import (
         _paged_attention_call,
+        _paged_attention_call_pipelined,
     )
 
+    if pipelined:
+        return _paged_attention_call_pipelined(
+            q, (k_q, k_scale, v_q, v_scale), block_tables, seq_lens,
+            quantized=True, interpret=interpret,
+        )
     n_kv_heads, _n_pages, page_size, head_dim = k_q.shape
     return _paged_attention_call(
         q,

@@ -93,3 +93,38 @@ class TestQuantizedWrites:
         deq = kq.astype(jnp.float32) * ks
         err = float(jnp.max(jnp.abs(deq[:, 3, 14] - k_ref[:, 3, 14])))
         assert err < 0.05
+
+
+class TestQuantizedPipelinedVariant:
+    """The manual-DMA pipelined variant over int8 pages (4 arrays per page
+    in strided all-head descriptors) must match the quantized oracle across
+    partial pages, boundaries, and padded batch slots."""
+
+    _setup = TestQuantizedPagedAttention._setup
+
+    def test_pipelined_matches_oracle(self):
+        q, _k, _v, kq, ks, vq, vs, bt = self._setup()
+        for seq_lens in ([5, 300], [128, 384], [0, 256]):
+            seq_lens = jnp.array(seq_lens, jnp.int32)
+            ref = paged_attention_quantized_reference(
+                q, kq, ks, vq, vs, bt, seq_lens
+            )
+            out = paged_attention_quantized(
+                q, kq, ks, vq, vs, bt, seq_lens, interpret=True, pipelined=True
+            )
+            mask = np.asarray(seq_lens) > 0
+            np.testing.assert_allclose(
+                np.asarray(out)[mask], np.asarray(ref)[mask], atol=5e-3
+            )
+
+    def test_pipelined_matches_tiled(self):
+        q, _k, _v, kq, ks, vq, vs, bt = self._setup()
+        seq_lens = jnp.array([37, 290], jnp.int32)
+        tiled = paged_attention_quantized(
+            q, kq, ks, vq, vs, bt, seq_lens, interpret=True
+        )
+        piped = paged_attention_quantized(
+            q, kq, ks, vq, vs, bt, seq_lens, interpret=True, pipelined=True
+        )
+        np.testing.assert_allclose(np.asarray(piped), np.asarray(tiled),
+                                   atol=1e-5)
