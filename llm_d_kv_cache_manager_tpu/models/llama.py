@@ -118,7 +118,8 @@ def _dense_attention(
     q: jax.Array,  # [B, L, n_q, hd]
     k: jax.Array,  # [B, S, n_kv, hd]
     v: jax.Array,
-    causal_offset: jax.Array | int,  # q position i attends k positions <= offset+i
+    causal_offset: jax.Array | int,  # q position i attends k positions <=
+    # offset+i; scalar, or [B] for per-sequence offsets (batched verify)
 ) -> jax.Array:
     b, l, n_q, hd = q.shape
     n_kv = k.shape[2]
@@ -127,10 +128,11 @@ def _dense_attention(
     scores = jnp.einsum(
         "blhgd,bshd->bhgls", qg.astype(jnp.float32), k.astype(jnp.float32)
     ) / (hd**0.5)
-    q_pos = jnp.arange(l)[:, None]
-    k_pos = jnp.arange(k.shape[1])[None, :]
-    mask = k_pos <= (q_pos + causal_offset)
-    scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+    q_pos = jnp.arange(l)[None, :, None]
+    k_pos = jnp.arange(k.shape[1])[None, None, :]
+    offset = jnp.broadcast_to(jnp.asarray(causal_offset), (b,))[:, None, None]
+    mask = k_pos <= (q_pos + offset)  # [B, L, S]
+    scores = jnp.where(mask[:, None, None], scores, -jnp.inf)
     weights = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhgls,bshd->blhgd", weights, v.astype(jnp.float32))
     return out.reshape(b, l, n_q, hd).astype(q.dtype)
@@ -405,6 +407,77 @@ def decode_step_cache(
     (x,), kv_cache = jax.lax.scan(layer_fn, (x,), xs)
     x = rms_norm(x, params["final_norm"], c.rms_eps)
     return kv_cache, (x[:, 0] @ params["out"])
+
+
+@functools.partial(jax.jit, static_argnames=("config",), donate_argnums=(2,))
+def verify_step_cache(
+    config: LlamaConfig,
+    params: Params,
+    kv_cache: tuple,
+    tokens: jax.Array,  # [B, S] S new tokens per sequence (spec proposals)
+    block_tables: jax.Array,  # [B, pages_per_seq]
+    start_positions: jax.Array,  # [B] cached tokens per sequence
+) -> Tuple[tuple, jax.Array]:
+    """Batched multi-position verification: compute KV + logits for S new
+    tokens of EVERY sequence in one pass — the op that makes speculative
+    decoding batchable (one weight stream amortized over B·S positions,
+    where batched per-sequence prefill would stream weights B times).
+    Returns (kv_cache, logits [B, S, vocab]); logits[b, i] is the target's
+    next-token opinion after tokens[b, i]. Bf16 (k, v) cache layout only.
+    """
+    if len(kv_cache) != 2:
+        raise NotImplementedError("verify_step_cache: bf16 (k, v) cache only")
+    c = config
+    b, s = tokens.shape
+    page_size = kv_cache[0].shape[3]
+    x = params["embed"][tokens]  # [B, S, d]
+    positions = start_positions[:, None] + jnp.arange(s)[None]  # [B, S]
+
+    # Scatter targets for the new rows: flatten (b, s) pairs.
+    page_ids = jnp.take_along_axis(
+        block_tables, positions // page_size, axis=1
+    ).reshape(-1)  # [B*S]
+    slots = (positions % page_size).reshape(-1)
+
+    def layer_fn(carry, inputs):
+        x, = carry
+        layer, cache = inputs["layer"], inputs["cache"]
+        h = rms_norm(x, layer["attn_norm"], c.rms_eps)
+        q = (h @ layer["wq"]).reshape(b, s, c.n_q_heads, c.head_dim)
+        k = (h @ layer["wk"]).reshape(b, s, c.n_kv_heads, c.head_dim)
+        v = (h @ layer["wv"]).reshape(b, s, c.n_kv_heads, c.head_dim)
+        q = _rope(q, positions, c.rope_theta)
+        k = _rope(k, positions, c.rope_theta)
+
+        kp, vp = cache
+        k_rows = k.reshape(b * s, c.n_kv_heads, c.head_dim)
+        v_rows = v.reshape(b * s, c.n_kv_heads, c.head_dim)
+        kp = kp.at[:, page_ids, slots, :].set(jnp.swapaxes(k_rows, 0, 1))
+        vp = vp.at[:, page_ids, slots, :].set(jnp.swapaxes(v_rows, 0, 1))
+        cache = (kp, vp)
+
+        # Gather each sequence's pages and attend with a per-sequence
+        # causal offset (position i attends cached prefix + tokens <= i) —
+        # the same _dense_attention math every other path uses.
+        k_all = jnp.moveaxis(kp[:, block_tables], 1, 0)  # [B, n_kv, P, page, hd]
+        v_all = jnp.moveaxis(vp[:, block_tables], 1, 0)
+        max_ctx = k_all.shape[2] * page_size
+        k_all = jnp.swapaxes(
+            k_all.reshape(b, c.n_kv_heads, max_ctx, c.head_dim), 1, 2
+        )  # [B, ctx, n_kv, hd]
+        v_all = jnp.swapaxes(
+            v_all.reshape(b, c.n_kv_heads, max_ctx, c.head_dim), 1, 2
+        )
+        attn = _dense_attention(q, k_all, v_all, start_positions)
+        x = x + attn.reshape(b, s, c.q_dim) @ layer["wo"]
+        h = rms_norm(x, layer["mlp_norm"], c.rms_eps)
+        x = x + _mlp(layer, h)
+        return (x,), cache
+
+    xs = {"layer": params["layers"], "cache": tuple(kv_cache)}
+    (x,), kv_cache = jax.lax.scan(layer_fn, (x,), xs)
+    x = rms_norm(x, params["final_norm"], c.rms_eps)
+    return kv_cache, x @ params["out"]  # [B, S, vocab]
 
 
 def prefill(

@@ -129,3 +129,61 @@ class TestEngineStateHygiene:
         acct = EnginePod(EnginePodConfig(n_pages=8, page_size=4))
         with pytest.raises(ValueError, match="with_model"):
             SpeculativeDecoder(acct, DRAFT_CFG, DRAFT_PARAMS)
+
+
+class TestBatchedVerify:
+    """verify_step_cache: one batched pass must equal per-sequence
+    prefill verification — the building block for batched speculation."""
+
+    def test_matches_per_sequence_prefill(self):
+        import numpy as np
+
+        cfg = TARGET_CFG
+        page = 4
+        b, prefix_len, s = 3, 8, 5
+        pps = (prefix_len + s + page - 1) // page + 1
+        n_pages = b * pps
+        rng = np.random.RandomState(0)
+        prefixes = rng.randint(0, cfg.vocab_size, (b, prefix_len))
+        chunks = rng.randint(0, cfg.vocab_size, (b, s))
+        tables = jnp.arange(n_pages, dtype=jnp.int32).reshape(b, pps)
+
+        # Batched: prefill each prefix, then one batched verify.
+        cache = llama.make_kv_pages(cfg, n_pages, page)
+        for i in range(b):
+            cache, _ = llama.prefill_cache(
+                cfg, TARGET_PARAMS, cache,
+                jnp.asarray(prefixes[i], jnp.int32), tables[i], 0,
+            )
+        cache, batched_logits = llama.verify_step_cache(
+            cfg, TARGET_PARAMS, cache, jnp.asarray(chunks, jnp.int32),
+            tables, jnp.full((b,), prefix_len, jnp.int32),
+        )
+
+        # Reference: per-sequence prefill with all_logits.
+        for i in range(b):
+            ref_cache = llama.make_kv_pages(cfg, pps + 1, page)
+            ref_table = jnp.arange(pps + 1, dtype=jnp.int32)
+            ref_cache, _ = llama.prefill_cache(
+                cfg, TARGET_PARAMS, ref_cache,
+                jnp.asarray(prefixes[i], jnp.int32), ref_table, 0,
+            )
+            _, ref_logits = llama.prefill_cache(
+                cfg, TARGET_PARAMS, ref_cache,
+                jnp.asarray(chunks[i], jnp.int32), ref_table, prefix_len,
+                all_logits=True,
+            )
+            np.testing.assert_allclose(
+                np.asarray(batched_logits[i], np.float32),
+                np.asarray(ref_logits, np.float32),
+                rtol=1e-4, atol=1e-4,
+            )
+
+    def test_quantized_cache_rejected(self):
+        cfg = TARGET_CFG
+        cache = llama.make_kv_pages_quantized(cfg, 8, 4)
+        with pytest.raises(NotImplementedError, match="bf16"):
+            llama.verify_step_cache(
+                cfg, TARGET_PARAMS, cache, jnp.ones((1, 2), jnp.int32),
+                jnp.zeros((1, 8), jnp.int32), jnp.zeros((1,), jnp.int32),
+            )
