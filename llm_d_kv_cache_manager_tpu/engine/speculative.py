@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from llm_d_kv_cache_manager_tpu.engine.block_manager import OutOfPagesError
 from llm_d_kv_cache_manager_tpu.engine.engine import EnginePod
 from llm_d_kv_cache_manager_tpu.models import llama
 
@@ -219,3 +220,269 @@ class SpeculativeDecoder:
             return True
         self.pod.block_manager.append_token(state, token)
         return False
+
+
+class SpeculativeScheduler:
+    """Continuous batching WITH speculation: the whole running batch drafts
+    and verifies together.
+
+    Per tick: k batched draft decode steps propose k tokens per running
+    sequence, then ONE `verify_step_cache` pass scores every (sequence,
+    position) — the target's weight stream is amortized over B·(k+1)
+    positions, where per-sequence speculation would stream it B times.
+    Admission (chunked prefill), preemption, paging, and events all ride
+    the inner Scheduler unchanged, and the tick preserves the plain
+    scheduler's invariant — each running sequence always carries exactly
+    one appended-but-not-yet-KV-computed "pending" token — so greedy
+    output is identical to the non-speculative scheduler (pinned by tests
+    on f32): the verify chunk is [pending] + proposals, acceptance emits
+    matching proposals, and the correction token becomes the next pending.
+
+    The draft keeps one private page-pool stripe per batch slot; slots are
+    assigned at admission and recycled on finish/preemption (a preempted
+    request's draft state is discarded and rebuilt on re-admission).
+    """
+
+    def __init__(
+        self,
+        pod: EnginePod,
+        draft_config,
+        draft_params,
+        k: int = 4,
+        max_batch: int = 8,
+        prefill_token_budget: int = 512,
+    ):
+        from llm_d_kv_cache_manager_tpu.engine.scheduler import Scheduler
+
+        if pod.lora_stack is not None:
+            raise NotImplementedError("speculative scheduling with LoRA adapters")
+        if pod._model is not None and len(pod.kv_cache) != 2:
+            raise NotImplementedError(
+                "speculative scheduling requires the bf16 (k, v) cache "
+                "(verify_step_cache has no quantized path yet)"
+            )
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.inner = Scheduler(pod, max_batch=max_batch,
+                               prefill_token_budget=prefill_token_budget)
+        self.pod = pod
+        self.k = k
+        self.draft_config = draft_config
+        self.draft_params = draft_params
+        self.stats = SpeculativeStats()
+
+        page_size = pod.config.page_size
+        self._stripe_pages = pod.config.max_pages_per_seq
+        n_draft_pages = max_batch * self._stripe_pages
+        self._draft_cache = llama.make_kv_pages(
+            draft_config, n_draft_pages, page_size
+        )
+        self._free_slots = list(range(max_batch))
+        # Host-side per-slot stripe index rows (constant): avoids a
+        # device round trip per running request per tick.
+        self._slot_tables = np.stack([
+            np.arange(i * self._stripe_pages, (i + 1) * self._stripe_pages,
+                      dtype=np.int32)
+            for i in range(max_batch)
+        ])
+        # req_id -> [slot, draft_pos]; draft_pos counts positions with
+        # valid draft KV (always == len(state.tokens) - 1: everything but
+        # the pending token).
+        self._draft_state: dict = {}
+
+    # -- public API mirroring Scheduler ------------------------------------
+
+    def submit(self, prompt_tokens, max_new_tokens=16, eos_token=None):
+        return self.inner.submit(prompt_tokens, max_new_tokens, eos_token)
+
+    @property
+    def has_work(self) -> bool:
+        return self.inner.has_work
+
+    def run(self):
+        results = {}
+        while self.has_work:
+            for req in self.step():
+                results[req.req_id] = req.generated
+        return results
+
+    # -- internals ----------------------------------------------------------
+
+    def _draft_table(self, slot: int):
+        start = slot * self._stripe_pages
+        return jnp.arange(start, start + self._stripe_pages, dtype=jnp.int32)
+
+    def _sync_new_runners(self) -> None:
+        """Admissions since last tick: assign a draft slot and ingest the
+        request's history up to (excluding) the pending token — the tick's
+        seed ingest covers pending itself."""
+        for req in self.inner._running:
+            if req.req_id in self._draft_state:
+                continue
+            slot = self._free_slots.pop()
+            history = list(req.state.tokens[:-1])
+            if history:
+                self._draft_cache, _ = llama.prefill_cache(
+                    self.draft_config, self.draft_params, self._draft_cache,
+                    jnp.asarray(history, jnp.int32), self._draft_table(slot), 0,
+                )
+            self._draft_state[req.req_id] = [slot, len(history)]
+        # Reap state of requests that left the running set outside our
+        # acceptance path (e.g. admission-time EOS or preemption).
+        running_ids = {r.req_id for r in self.inner._running}
+        for rid in list(self._draft_state):
+            if rid not in running_ids:
+                self._release(rid)
+
+    def _release(self, req_id: int) -> None:
+        slot_pos = self._draft_state.pop(req_id, None)
+        if slot_pos is not None:
+            self._free_slots.append(slot_pos[0])
+
+    def step(self):
+        finished = self.inner._rejected
+        self.inner._rejected = []
+        finished += self.inner._prefill_tick()
+        self._sync_new_runners()
+        finished += self._spec_decode()
+        return finished
+
+    def _spec_decode(self):
+        running = self.inner._running
+        if not running:
+            return []
+        pod = self.pod
+        page_size = pod.config.page_size
+
+        # Per-sequence headroom caps a COMMON chunk width (the batched
+        # verify is rectangular); k_eff == 0 degenerates to exactly one
+        # plain decode step through the verify op.
+        k_eff = self.k
+        for req in running:
+            capacity = self._stripe_pages * page_size - len(req.state.tokens)
+            budget = req.max_new_tokens - len(req.generated) - 1
+            k_eff = max(0, min(k_eff, capacity, budget))
+
+        b = len(running)
+        pending = np.asarray(
+            [req.state.tokens[-1] for req in running], dtype=np.int32
+        )
+
+        # Batched draft proposals: ingest pending as the seed, then k_eff
+        # autoregressive steps.
+        proposals = np.zeros((b, k_eff), dtype=np.int32)
+        if k_eff > 0:
+            tables = jnp.asarray(self._slot_tables[
+                [self._draft_state[r.req_id][0] for r in running]
+            ])
+            cur = jnp.asarray(pending)
+            for j in range(k_eff):
+                lens = jnp.asarray(
+                    [self._draft_state[r.req_id][1] + j for r in running],
+                    jnp.int32,
+                )
+                self._draft_cache, logits = llama.decode_step_cache(
+                    self.draft_config, self.draft_params, self._draft_cache,
+                    cur, tables, lens,
+                )
+                cur = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                proposals[:, j] = np.asarray(cur)
+            # Ingest the final proposal's KV too (its logits are unused):
+            # without this, a fully accepted round leaves a permanent
+            # zero-KV hole in the draft cache at that position.
+            lens = jnp.asarray(
+                [self._draft_state[r.req_id][1] + k_eff for r in running],
+                jnp.int32,
+            )
+            self._draft_cache, _ = llama.decode_step_cache(
+                self.draft_config, self.draft_params, self._draft_cache,
+                cur, tables, lens,
+            )
+            self.stats.proposed += b * k_eff
+        self.stats.rounds += 1
+
+        # One batched target verification over [pending, proposals...].
+        # Reserve verify headroom; pool exhaustion preempts the victim
+        # (recompute, like plain decode) instead of crashing the batch.
+        survivors = []
+        surviving_rows = []
+        for i, req in enumerate(running):
+            try:
+                pod.block_manager.reserve_pages(
+                    req.state,
+                    (len(req.state.tokens) + k_eff + page_size - 1) // page_size,
+                )
+            except OutOfPagesError:
+                self.inner._preempt(req)
+                self._release(req.req_id)
+                continue
+            survivors.append(req)
+            surviving_rows.append(i)
+        if not survivors:
+            self.inner._running = []
+            return []
+        if len(survivors) != len(running):
+            running = survivors
+            b = len(running)
+            pending = pending[surviving_rows]
+            proposals = proposals[surviving_rows]
+
+        chunk = np.concatenate([pending[:, None], proposals], axis=1)
+        starts = np.asarray(
+            [len(r.state.tokens) - 1 for r in running], np.int32
+        )
+        need = max(len(r.state.block_table) for r in running)
+        bucket = pod.table_bucket(need)
+        tables = np.zeros((b, bucket), dtype=np.int32)
+        for i, req in enumerate(running):
+            tables[i, : len(req.state.block_table)] = req.state.block_table
+        pod.kv_cache, verify_logits = llama.verify_step_cache(
+            pod._model_config, pod.params, pod.kv_cache,
+            jnp.asarray(chunk), jnp.asarray(tables), jnp.asarray(starts),
+        )
+        argmaxes = np.asarray(jnp.argmax(verify_logits, axis=-1))  # [B, k+1]
+
+        finished = []
+        still_running = []
+        for i, req in enumerate(running):
+            # argmaxes[i, j] is the target opinion after chunk[i, j]; a
+            # proposal is accepted while it matches the chain.
+            n_accept = 0
+            for j in range(k_eff):
+                if int(argmaxes[i, j]) != int(proposals[i, j]):
+                    break
+                n_accept += 1
+            self.stats.accepted += n_accept
+
+            # Emit accepted proposals, then the correction token (which
+            # becomes the next pending). decode_append is skipped for a
+            # final token, matching the plain scheduler.
+            to_emit = [int(p) for p in proposals[i, :n_accept]]
+            to_emit.append(int(argmaxes[i, n_accept]))
+            done = False
+            preempted = False
+            for tok in to_emit:
+                req.generated.append(tok)
+                if self.inner._done(req, tok):
+                    done = True
+                    break
+                try:
+                    pod.decode_append(req.state, tok)
+                except OutOfPagesError:
+                    self.inner._preempt(req)
+                    preempted = True
+                    break
+            if done:
+                req.finished = True
+                pod.free(req.state)
+                self._release(req.req_id)
+                finished.append(req)
+                continue
+            if preempted:
+                self._release(req.req_id)  # rebuilt on re-admission
+                continue
+            # Draft validity: everything except the new pending token.
+            self._draft_state[req.req_id][1] = len(req.state.tokens) - 1
+            still_running.append(req)
+        self.inner._running = still_running
+        return finished
