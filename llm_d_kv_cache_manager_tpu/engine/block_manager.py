@@ -205,18 +205,35 @@ class BlockManager:
     def commit_prefill(self, state: SequenceState) -> None:
         """Commit the sequence's full pages after prefill compute: hash,
         register for reuse, and emit one BlockStored chaining from the cached
-        prefix."""
-        self._commit_full_pages(state)
+        prefix. Prefill computes KV for every prompt position, so all full
+        pages are device-resident and eligible."""
+        self._commit_full_pages(state, n_computed=len(state.tokens))
 
     def append_token(self, state: SequenceState, token: int) -> None:
         """Record one decoded token; allocates a new page at boundaries and
-        commits pages as they fill."""
+        commits pages as they fill.
+
+        The appended token is *pending*: its KV row is written only by the
+        next decode/verify pass that consumes it. A page whose final slot
+        holds the pending token is therefore NOT committed here — committing
+        it would register (and potentially export, via committed_blocks) a
+        page with a garbage KV row that a same-prefix request could attend.
+        The engine calls `mark_decode_computed` after the device pass that
+        writes the row, which commits the page then."""
         state.tokens.append(int(token))
         pages_needed = (
             len(state.tokens) + self.config.page_size - 1
         ) // self.config.page_size
         self.reserve_pages(state, pages_needed)
-        self._commit_full_pages(state)
+        self._commit_full_pages(state, n_computed=len(state.tokens) - 1)
+
+    def mark_decode_computed(self, state: SequenceState) -> None:
+        """All of `state.tokens` now have device-resident KV (the decode /
+        verify pass that consumed the pending token has written its row).
+        Commit any page that completion fills. Callers must only invoke this
+        after such a pass; for accounting-only pods it is a harmless
+        commit-timing advance."""
+        self._commit_full_pages(state, n_computed=len(state.tokens))
 
     def reserve_pages(self, state: SequenceState, n_total_pages: int) -> None:
         """Extend the sequence's block table with fresh (uncommitted) pages
@@ -363,10 +380,14 @@ class BlockManager:
             else:
                 self._free_fresh.append(page_id)
 
-    def _commit_full_pages(self, state: SequenceState) -> None:
+    def _commit_full_pages(self, state: SequenceState, n_computed: int) -> None:
+        """Commit pages fully covered by the first `n_computed` tokens —
+        the positions whose KV is device-resident. Pages touched by the
+        pending (appended-but-not-computed) token stay uncommitted until
+        mark_decode_computed."""
         if not self.config.enable_prefix_caching:
             return
-        n_full = len(state.tokens) // self.config.page_size
+        n_full = min(n_computed, len(state.tokens)) // self.config.page_size
         if n_full <= state.n_hashed_pages:
             return
 

@@ -123,6 +123,11 @@ class EnginePodConfig:
     # Decode through the Pallas flash-decoding kernel (True on TPU; the jnp
     # oracle path works on any backend and is the test default).
     use_kernel: bool = False
+    # Tensor parallelism over the pod's slice: weights Megatron-sharded and
+    # KV pages head-sharded over a tp-device mesh (parallel/serving.py).
+    # The pod remains ONE pod to the control plane — block tables, events,
+    # and the block manager are tp-invariant host state. tp=1 -> no mesh.
+    tp: int = 1
     # Two-tier data plane (engine/tiering.py): reclaimed HBM pages offload
     # to the C++ host staging store instead of vanishing, and allocation
     # misses restore from host / onboard from peer pods over DCN.
@@ -195,14 +200,29 @@ class EnginePod:
             self.params = params if params is not None else llama.init_params(
                 mc, jax.random.PRNGKey(0)
             )
+            # One sacrificial page beyond the block manager's pool: the
+            # multi-step decode loop steers per-sequence out-of-budget KV
+            # writes there (models/llama.decode_multi_step_cache), so a
+            # rectangular batch can keep stepping past a short sequence's
+            # capacity without corrupting real pages. Never referenced by
+            # any block table.
+            self.trash_page = config.n_pages
             if config.use_quantized_kv:
                 self.kv_cache = llama.make_kv_pages_quantized(
-                    mc, config.n_pages, config.page_size
+                    mc, config.n_pages + 1, config.page_size
                 )
             else:
                 self.kv_cache = llama.make_kv_pages(
-                    mc, config.n_pages, config.page_size
+                    mc, config.n_pages + 1, config.page_size
                 )
+            self.mesh = None
+            if config.tp > 1:
+                from llm_d_kv_cache_manager_tpu.parallel import serving
+
+                serving.validate_tp(config.tp, mc.n_q_heads, mc.n_kv_heads)
+                self.mesh = serving.tp_mesh(config.tp)
+                self.params = serving.shard_serving_params(self.params, self.mesh)
+                self.kv_cache = serving.shard_kv_cache(self.kv_cache, self.mesh)
             self._jnp = jnp
 
         # Multi-LoRA registry: adapter weights served per sequence, with
@@ -311,8 +331,13 @@ class EnginePod:
         self.block_manager.commit_prefill(state)
 
     def decode_append(self, state: SequenceState, token: int) -> None:
-        """Accounting-only decode: record one generated token."""
+        """Record one generated token. For accounting-only pods the token
+        counts as computed immediately (there is no device KV whose residency
+        could lag); model pods leave it pending until the next device pass
+        calls mark_decode_computed."""
         self.block_manager.append_token(state, token)
+        if self._model is None:
+            self.block_manager.mark_decode_computed(state)
 
     def decode_step(self, state: SequenceState) -> int:
         """Model decode: greedy-sample one token for this sequence."""
@@ -333,6 +358,9 @@ class EnginePod:
             self.config.use_kernel,
             lora=self.lora_for_decode([state.lora_id]),
         )
+        # The pending token's KV row is now device-resident: commit any page
+        # it completed before appending the next (pending) token.
+        self.block_manager.mark_decode_computed(state)
         token = int(jnp.argmax(logits[0]))
         self.block_manager.append_token(state, token)
         return token

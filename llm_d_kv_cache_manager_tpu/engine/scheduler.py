@@ -61,13 +61,24 @@ class Scheduler:
         pod: EnginePod,
         max_batch: int = 8,
         prefill_token_budget: int = 512,
+        decode_steps: int = 1,
     ):
         if pod._model is None:
             raise ValueError("Scheduler requires an EnginePod with with_model=True")
         if prefill_token_budget < 1:
             raise ValueError("prefill_token_budget must be >= 1")
+        if decode_steps < 1:
+            raise ValueError("decode_steps must be >= 1")
         self.pod = pod
         self.max_batch = max_batch
+        # decode_steps > 1: each decode tick runs ONE on-device multi-step
+        # dispatch (models/llama.decode_multi_step_cache) emitting up to
+        # decode_steps tokens per sequence — the dispatch-amortization lever
+        # for the tunnel/host overhead that dominates per-step decode.
+        # Output is identical to decode_steps=1 (greedy chain, same math,
+        # pinned by tests); admission latency for waiting requests grows by
+        # up to decode_steps-1 tokens per tick.
+        self.decode_steps = decode_steps
         # vLLM-style chunked prefill: at most this many prompt tokens are
         # computed per tick, so a long-prompt arrival cannot stall the
         # running batch's decode for more than ~budget tokens of compute.
@@ -219,23 +230,29 @@ class Scheduler:
             req.eos_token is not None and token == req.eos_token
         )
 
-    def _decode(self) -> List[Request]:
-        if not self._running:
-            return []
-        jnp = self.pod._jnp
-
-        # Assemble the batch: shared block-table bucket across sequences.
-        need = max(len(r.state.block_table) for r in self._running)
+    def _assemble_batch(self, running: List[Request]):
+        """Bucket-padded decode batch: (tables [B, bucket], pending tokens
+        [B], positions [B]) — shared by the single-step and multi-step
+        decode paths so they can never assemble inconsistently."""
+        need = max(len(r.state.block_table) for r in running)
         bucket = self.pod.table_bucket(need)
-
-        tables = np.zeros((len(self._running), bucket), dtype=np.int32)
-        tokens = np.zeros((len(self._running),), dtype=np.int32)
-        positions = np.zeros((len(self._running),), dtype=np.int32)
-        for i, req in enumerate(self._running):
+        tables = np.zeros((len(running), bucket), dtype=np.int32)
+        tokens = np.zeros((len(running),), dtype=np.int32)
+        positions = np.zeros((len(running),), dtype=np.int32)
+        for i, req in enumerate(running):
             bt = req.state.block_table
             tables[i, : len(bt)] = bt
             tokens[i] = req.state.tokens[-1]
             positions[i] = len(req.state.tokens) - 1
+        return tables, tokens, positions
+
+    def _decode(self) -> List[Request]:
+        if not self._running:
+            return []
+        if self.decode_steps > 1:
+            return self._decode_multi()
+        jnp = self.pod._jnp
+        tables, tokens, positions = self._assemble_batch(self._running)
 
         self.pod.kv_cache, logits = self.pod._model.decode_step_cache(
             self.pod._model_config,
@@ -251,6 +268,12 @@ class Scheduler:
         )
         next_tokens = np.asarray(jnp.argmax(logits, axis=-1))
 
+        # Every running sequence's pending token just had its KV row
+        # written: commit pages that row completed (this is the only point
+        # a decode-filled page becomes advertisable — append_token defers).
+        for req in self._running:
+            self.pod.block_manager.mark_decode_computed(req.state)
+
         finished: List[Request] = []
         still_running: List[Request] = []
         for req, token in zip(self._running, next_tokens):
@@ -265,6 +288,100 @@ class Scheduler:
                 self.pod.decode_append(req.state, token)
             except OutOfPagesError:
                 self._preempt(req)  # tokens incl. this one fold into prompt
+                continue
+            still_running.append(req)
+        self._running = still_running
+        return finished
+
+    def _decode_multi(self) -> List[Request]:
+        """One decode tick emitting up to `decode_steps` tokens per sequence
+        from a single on-device dispatch (lax.scan over the step body with
+        on-device argmax — models/llama.decode_multi_step_cache).
+
+        Per-sequence accept counts: sequence i accepts k_i = min(N,
+        remaining budget, page capacity) tokens; the device still runs all
+        N steps for the rectangular batch, steering row writes past
+        position seq_len + k_i into the pod's trash page. Host-side append
+        then replays the accepted tokens exactly like N plain ticks — the
+        final accepted token becomes the new pending token.
+        """
+        pod = self.pod
+        jnp = pod._jnp
+        n = self.decode_steps
+        ps = pod.config.page_size
+        running = self._running
+
+        # Reserve write headroom per sequence: accepting k tokens writes
+        # rows at positions len-1 .. len+k-2, i.e. len+k-1 positions total.
+        # On pool exhaustion degrade to k=1 (the pending token's page is
+        # already held, so a single step never needs new reservations).
+        accepts: List[int] = []
+        for req in running:
+            length = len(req.state.tokens)
+            capacity = pod.config.max_pages_per_seq * ps - length + 1
+            k = max(1, min(n, req.max_new_tokens - len(req.generated), capacity))
+            try:
+                pod.block_manager.reserve_pages(
+                    req.state, (length + k - 1 + ps - 1) // ps
+                )
+            except OutOfPagesError:
+                k = 1
+            accepts.append(k)
+
+        tables, tokens, positions = self._assemble_batch(running)
+        max_lens = positions + np.asarray(accepts, dtype=np.int32)  # rows allowed
+
+        pod.kv_cache, toks = pod._model.decode_multi_step_cache(
+            pod._model_config,
+            pod.params,
+            pod.kv_cache,
+            jnp.asarray(tokens),
+            jnp.asarray(tables),
+            jnp.asarray(positions),
+            jnp.asarray(max_lens),
+            pod.trash_page,
+            n,
+            pod.config.use_kernel,
+            lora=pod.lora_for_decode([r.lora_id for r in running]),
+        )
+        toks = np.asarray(toks)  # [B, n]
+
+        finished: List[Request] = []
+        still_running: List[Request] = []
+        for i, req in enumerate(running):
+            # The pending token's row was written by step 0 (it is always
+            # within max_lens): pages it completed become advertisable.
+            pod.block_manager.mark_decode_computed(req.state)
+            done = False
+            preempted = False
+            k = accepts[i]
+            for j in range(k):
+                token = int(toks[i, j])
+                req.generated.append(token)
+                if self._done(req, token):
+                    done = True
+                    break
+                try:
+                    self.pod.decode_append(req.state, token)
+                except OutOfPagesError:
+                    self._preempt(req)
+                    preempted = True
+                    break
+                # All accepted tokens except the last have device-resident
+                # KV (each was consumed by a later in-window step); the
+                # last accepted token is the new pending.
+                if j < k - 1:
+                    pod.block_manager.mark_decode_computed(req.state)
+            if done:
+                req.finished = True
+                # Every token still in the sequence has resident KV (the
+                # done token is never appended) — commit the tail page so
+                # it stays reusable.
+                pod.block_manager.mark_decode_computed(req.state)
+                self.pod.free(req.state)
+                finished.append(req)
+                continue
+            if preempted:
                 continue
             still_running.append(req)
         self._running = still_running

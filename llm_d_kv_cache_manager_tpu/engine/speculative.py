@@ -219,6 +219,10 @@ class SpeculativeDecoder:
         if len(generated) >= max_new_tokens:
             return True
         self.pod.block_manager.append_token(state, token)
+        # Unlike plain decode, the pushed token's KV is ALREADY resident
+        # (the verify pass wrote the whole chunk), so it is not pending:
+        # commit any page it completed.
+        self.pod.block_manager.mark_decode_computed(state)
         return False
 
 
@@ -442,6 +446,12 @@ class SpeculativeScheduler:
         )
         argmaxes = np.asarray(jnp.argmax(verify_logits, axis=-1))  # [B, k+1]
 
+        # The verify pass wrote KV for every sequence's pending token (and
+        # its proposals): the pending row is now resident, so commit any
+        # page it completed.
+        for req in running:
+            pod.block_manager.mark_decode_computed(req.state)
+
         finished = []
         still_running = []
         for i, req in enumerate(running):
@@ -461,7 +471,7 @@ class SpeculativeScheduler:
             to_emit.append(int(argmaxes[i, n_accept]))
             done = False
             preempted = False
-            for tok in to_emit:
+            for j, tok in enumerate(to_emit):
                 req.generated.append(tok)
                 if self.inner._done(req, tok):
                     done = True
@@ -472,8 +482,19 @@ class SpeculativeScheduler:
                     self.inner._preempt(req)
                     preempted = True
                     break
+                # Accepted proposals (every emitted token except the final
+                # correction) already have device KV from the verify pass —
+                # commit pages they complete. The correction token is the
+                # new pending and stays uncommitted.
+                if j < n_accept:
+                    pod.block_manager.mark_decode_computed(req.state)
             if done:
                 req.finished = True
+                # Every token still in the sequence has resident KV (the
+                # correction is only ever in `generated`, not appended on
+                # the done path) — commit before freeing so the tail page
+                # stays reusable in the prefix cache.
+                pod.block_manager.mark_decode_computed(req.state)
                 pod.free(req.state)
                 self._release(req.req_id)
                 finished.append(req)

@@ -1,0 +1,87 @@
+"""Tensor-parallel sharding for the SERVING path (paged prefill/decode).
+
+A vLLM-TPU pod runs one model replica tensor-parallel over the chips of its
+slice; the pod is still ONE pod to the control plane (one pod identifier,
+one event stream, one entry in the index). This module provides the
+shardings that put the engine's serving state — weights and the paged KV
+cache — on a tp mesh so the existing jitted serving ops (`prefill_cache`,
+`decode_step_cache`, `verify_step_cache` in models/llama.py) compile to
+SPMD programs with the canonical Megatron collectives over ICI.
+
+Design (scaling-book recipe: pick a mesh, annotate shardings, let XLA
+insert collectives):
+
+- Mesh: 1-D ("tp",) over the slice's chips.
+- Weights: the same Megatron specs the training path uses
+  (parallel/mesh.param_specs — column-parallel wq/wk/wv/w_gate/w_up,
+  row-parallel wo/w_down, vocab-parallel out). Per layer the forward
+  reduces to two all-reduces (post-wo, post-w_down) riding ICI.
+- KV pages: sharded over the KV-HEAD axis — every cache component is
+  [n_layers, n_kv_heads, n_pages, page_size, ...] with heads at axis 1, so
+  P(None, "tp", None, None, None) gives each chip its heads' pages for
+  EVERY page id. The block table stays a host-side, replicated int32 array:
+  page allocation (engine/block_manager.py) is tp-invariant, which is what
+  keeps the control plane's one-index-entry-per-pod model valid — a block
+  is resident on the pod iff every chip holds its head-shard of the page.
+- Activations/tokens/tables/seq_lens: replicated (decode batches are tiny;
+  GSPMD re-shards q/k/v onto heads right after the column-parallel
+  projections).
+
+tp must divide n_kv_heads (and n_q_heads): the head-major page layout
+(ops/paged_attention.py) makes the kv-head axis the natural shard axis, the
+same choice vLLM's TPU backend makes for its KV cache.
+
+Reference anchor: the reference control plane never shards (its pods' TP=4
+is invisible to it, /root/reference/benchmarking/37-capacity/README.md:5);
+this module is the engine-side capability that makes a TP pod real in the
+TPU build.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from llm_d_kv_cache_manager_tpu.parallel.mesh import shard_params
+
+
+def tp_mesh(tp: int, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D ("tp",) mesh over the first `tp` devices (the pod's slice)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < tp:
+        raise ValueError(f"need {tp} devices for tp={tp}, have {len(devices)}")
+    return Mesh(np.array(devices[:tp]), axis_names=("tp",))
+
+
+def kv_cache_shardings(mesh: Mesh, n_components: int) -> Tuple[NamedSharding, ...]:
+    """Shardings for a paged KV cache tuple — bf16 (k, v) or int8-quantized
+    (k_q, k_scale, v_q, v_scale). Every component is laid out
+    [n_layers, n_kv_heads, n_pages, page_size, ...]; shard the head axis."""
+    spec = P(None, "tp", None, None, None)
+    return tuple(NamedSharding(mesh, spec) for _ in range(n_components))
+
+
+def shard_serving_params(params: dict, mesh: Mesh) -> dict:
+    """Place model weights with the Megatron specs — the serving path uses
+    the SAME shardings as training (parallel/mesh.py), so the two can never
+    diverge; the mesh here is 1-D ("tp",) and the specs reference only tp."""
+    return shard_params(params, mesh)
+
+
+def shard_kv_cache(kv_cache: tuple, mesh: Mesh) -> tuple:
+    """Place a paged KV cache (either format) head-sharded on the mesh."""
+    return tuple(
+        jax.device_put(c, s)
+        for c, s in zip(kv_cache, kv_cache_shardings(mesh, len(kv_cache)))
+    )
+
+
+def validate_tp(tp: int, n_q_heads: int, n_kv_heads: int) -> None:
+    if n_kv_heads % tp or n_q_heads % tp:
+        raise ValueError(
+            f"tp={tp} must divide n_kv_heads={n_kv_heads} and "
+            f"n_q_heads={n_q_heads} (head-sharded KV pages)"
+        )

@@ -72,10 +72,31 @@ class TestBlockManager:
         bm.commit_prefill(state)
         assert len(batches) == 1
         bm.append_token(state, 6)
-        bm.append_token(state, 7)  # page 2 fills here
+        bm.append_token(state, 7)  # page 2 fills — but its last row is pending
+        # ADVICE r2 (medium): the filling token's KV is not device-resident
+        # yet; committing here would advertise (and allow export of) a page
+        # with a garbage row. Commit happens at mark_decode_computed, after
+        # the decode pass that writes the row.
+        assert len(batches) == 1
+        assert bm.num_cached_pages == 1
+        bm.mark_decode_computed(state)
         assert len(batches) == 2
         ev = batches[-1].events[0]
         assert ev.token_ids == [4, 5, 6, 7]
+
+    def test_pending_tail_page_not_reusable_until_computed(self):
+        # A same-prefix allocation in the pending window must NOT hit the
+        # page whose final slot awaits its KV row.
+        bm = _manager()
+        state = bm.allocate(list(range(7)))
+        bm.commit_prefill(state)
+        bm.append_token(state, 7)  # fills page 2; token 7 pending
+        probe = bm.allocate(list(range(8)))
+        assert probe.num_cached_tokens == 4  # only the prefill-committed page
+        bm.free(probe)
+        bm.mark_decode_computed(state)
+        probe2 = bm.allocate(list(range(8)))
+        assert probe2.num_cached_tokens == 8  # now safe to reuse
 
     def test_eviction_emits_block_removed(self):
         batches = []
@@ -176,6 +197,7 @@ class TestHashParityKeystone:
         bm.commit_prefill(state)
         for t in (17, 18, 19):
             bm.append_token(state, t)
+        bm.mark_decode_computed(state)  # final row written by a decode pass
 
         db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=page_size))
         expected = [k.chunk_hash for k in db.tokens_to_kv_block_keys(None, state.tokens, "m")]

@@ -103,6 +103,29 @@ class TestScheduler:
         assert len(results[again]) == 3
         assert pod.block_manager.num_cached_pages > 0
 
+    def test_pending_page_not_reused_by_same_prefix_admission(self):
+        # ADVICE r2 (medium) regression: a decode-filled page's final slot
+        # holds the pending token, whose KV row is written only by the NEXT
+        # decode pass — but _prefill_tick runs before _decode, so a
+        # same-prefix request admitted in that window previously reused the
+        # page and attended a garbage row. The page must stay uncommitted
+        # (B recomputes it) and B's output must match an isolated run.
+        pod = _pod()
+        sched = Scheduler(pod, max_batch=2)
+        a = sched.submit(list(range(4)), max_new_tokens=10)
+        sched.step()  # prefill A + first sampled token (len 5, pending)
+        a_req = sched._running[0]
+        while len(a_req.state.tokens) < 8:
+            sched.step()  # each decode tick appends one token
+        # A's tokens now fill page 2 exactly; its last row is pending.
+        prompt_b = list(a_req.state.tokens)
+        b = sched.submit(prompt_b, max_new_tokens=4)
+        sched.step()  # admits B BEFORE the decode that writes A's pending row
+        b_req = next(r for r in sched._running if r.req_id == b)
+        assert b_req.num_cached_tokens == 4  # page 2 NOT advertised
+        results = sched.run()
+        assert results[b] == _isolated_generate(prompt_b, 4)
+
     def test_eos_stops_generation(self):
         pod = _pod()
         sched = Scheduler(pod, max_batch=1)
