@@ -1,4 +1,4 @@
-"""Fleet routing benchmark: prefix-aware scoring vs round-robin.
+"""Fleet routing benchmark: the reference's 4-arm strategy comparison.
 
 Reproduces the reference's headline experiment shape
 (/root/reference/benchmarking/37-capacity, BASELINE.md) at simulation scale:
@@ -10,8 +10,22 @@ real `Indexer.get_pod_scores` read path (tokenization included). Only device
 compute is modeled: TTFT = queue wait + alpha * uncached_prefill_tokens +
 beta, with pods busy for prefill + output decode.
 
+Routing arms, mirroring the reference's comparison table
+(/root/reference/benchmarking/37-capacity/README.md:230-253):
+- "precise":   cache_tracking scoring — the product. Real index fed by real
+               engine events; ties broken least-loaded.
+- "estimated": scheduler-side estimation — an affinity table of which pod
+               each block-key chain was ROUTED to before, never corrected
+               by engine events, so it drifts under eviction (the
+               reference's prefix-cache-scorer default/estimate mode).
+- "load":      least pending work (pod_free_at), cache-oblivious.
+- "random":    uniform random.
+- "round_robin": strict rotation — kept as the historical headline
+               baseline (BASELINE.json's >=2x TTFT target).
+
 Target (BASELINE.json): >=80% prefix-cache hit rate and >=2x TTFT speedup vs
-round-robin on an 8-replica fleet.
+round-robin on an 8-replica fleet; the reference's own table shows precise
+~3x load/random on TTFT — the same ordering must hold here.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -190,6 +204,17 @@ class FleetSim:
                 ))
         self.pod_free_at = [0.0] * N_PODS
         self.rr_counter = 0
+        self.route_rng = random.Random(1234)  # "random" arm; workload rng untouched
+        # "estimated" arm state: block-key -> pod the chain was last ROUTED
+        # to. Never sees engine events (eviction silently invalidates it),
+        # and is LRU-bounded to the fleet's nominal capacity — the
+        # estimator can size its table but cannot know the engines' real
+        # eviction order (reference: prefix-cache-scorer estimate mode's
+        # bounded LRU).
+        from collections import OrderedDict
+
+        self.affinity = OrderedDict()
+        self.affinity_cap = N_PODS * pages_per_pod
         self.read_latencies = []
         self.hit_tokens = 0
         self.total_tokens = 0
@@ -215,6 +240,12 @@ class FleetSim:
             pod = self.rr_counter % N_PODS
             self.rr_counter += 1
             return pod
+        if self.strategy == "random":
+            return self.route_rng.randrange(N_PODS)
+        if self.strategy == "load":
+            return min(range(N_PODS), key=lambda i: self.pod_free_at[i])
+        if self.strategy == "estimated":
+            return self._route_estimated(prompt)
         t0 = time.perf_counter()
         scores = self.indexer.get_pod_scores(prompt, MODEL, [])
         self.read_latencies.append(time.perf_counter() - t0)
@@ -224,6 +255,36 @@ class FleetSim:
         best = max(scores.values())
         candidates = [int(p.split("-")[1]) for p, s in scores.items() if s == best]
         return min(candidates, key=lambda i: self.pod_free_at[i])
+
+    def _route_estimated(self, prompt: str) -> int:
+        """Scheduler-side estimation: score each pod by the longest
+        consecutive run of this prompt's block keys whose affinity entry
+        points at it — routing history standing in for cache state. The
+        estimate is never corrected by engine events: an evicted prefix
+        still attracts traffic, and a never-routed-but-cached one repels
+        it — exactly the failure mode precise tracking removes (reference
+        37-capacity: 'default (estimated scheduling)' arm)."""
+        tokens = self.indexer.tokenizers_pool.tokenize(None, prompt, MODEL)
+        keys = self.indexer.token_processor.tokens_to_kv_block_keys(
+            None, tokens, MODEL
+        )
+        run_len = [0] * N_PODS
+        for i in range(N_PODS):
+            for key in keys:
+                if self.affinity.get(key.chunk_hash) != i:
+                    break
+                run_len[i] += 1
+        best = max(run_len)
+        pod = min(
+            (i for i in range(N_PODS) if run_len[i] == best),
+            key=lambda i: self.pod_free_at[i],
+        )
+        for key in keys:
+            self.affinity[key.chunk_hash] = pod
+            self.affinity.move_to_end(key.chunk_hash)
+        while len(self.affinity) > self.affinity_cap:
+            self.affinity.popitem(last=False)
+        return pod
 
     def serve(self, arrival: float, prompt: str) -> float:
         """Returns TTFT for this request under the simulated clock."""
@@ -316,18 +377,30 @@ def p50(xs):
     return sorted(xs)[len(xs) // 2]
 
 
-def run_two_tier_comparison():
+def p90(xs):
+    return sorted(xs)[min(int(len(xs) * 0.9), len(xs) - 1)]
+
+
+def run_two_tier_comparison(baseline_precise=None, baseline_rr=None):
     """Same fleet under heavy HBM pressure, host tier off vs on: evicted
     blocks restore at DMA/DCN bandwidth instead of recomputing on the MXU.
-    This is the serving behavior kv_connectors enables (VERDICT r1 #2)."""
+    This is the serving behavior kv_connectors enables (VERDICT r1 #2).
+
+    The host-tier-OFF baselines are identical deterministic configurations
+    to the pressured strategy-arms runs; callers that already ran those
+    pass them in as (ttfts, hit_rate) instead of paying two duplicate
+    300-request simulations."""
     from llm_d_kv_cache_manager_tpu.kv_connectors.connector import native_available
 
     if not native_available():
         return {"skipped": "libkvtransfer.so not built"}
 
-    ttft_off, hit_off, _, _ = run_strategy(
-        "precise", pages_per_pod=TWO_TIER_PAGES_PER_POD, host_tier=False
-    )
+    if baseline_precise is None:
+        ttfts, hit, _, _ = run_strategy(
+            "precise", pages_per_pod=TWO_TIER_PAGES_PER_POD, host_tier=False
+        )
+        baseline_precise = (ttfts, hit)
+    ttft_off, hit_off = baseline_precise
     ttft_on, hit_on, _, extras = run_strategy(
         "precise", pages_per_pod=TWO_TIER_PAGES_PER_POD, host_tier=True
     )
@@ -338,9 +411,12 @@ def run_two_tier_comparison():
     ttft_rr_dp, hit_rr_dp, _, extras_rr = run_strategy(
         "round_robin", pages_per_pod=TWO_TIER_PAGES_PER_POD, host_tier=True
     )
-    ttft_rr, hit_rr, _, _ = run_strategy(
-        "round_robin", pages_per_pod=TWO_TIER_PAGES_PER_POD, host_tier=False
-    )
+    if baseline_rr is None:
+        ttfts, hit, _, _ = run_strategy(
+            "round_robin", pages_per_pod=TWO_TIER_PAGES_PER_POD, host_tier=False
+        )
+        baseline_rr = (ttfts, hit)
+    ttft_rr, hit_rr = baseline_rr
     return {
         "hbm_pages_per_pod": TWO_TIER_PAGES_PER_POD,
         "ttft_p50_hbm_only_s": round(p50(ttft_off), 4),
@@ -365,9 +441,34 @@ def run_two_tier_comparison():
 
 def main():
     t_start = time.time()
+    # Headline arms at the default pool size (BASELINE.json continuity).
     ttft_precise, hit_rate, read_p50, _ = run_strategy("precise")
     ttft_rr, _, _, _ = run_strategy("round_robin")
-    two_tier = run_two_tier_comparison()
+
+    # The reference's 4-arm comparison (precise / estimated / load / random,
+    # 37-capacity/README.md:230-253) plus round_robin — run under HBM
+    # pressure (the reference's runs sit at ~73% resident fill) because
+    # that's where the arms genuinely separate: estimation is only wrong
+    # once eviction invalidates routing history.
+    arms = ("precise", "estimated", "load", "random", "round_robin")
+    results = {}
+    raw = {}
+    for arm in arms:
+        ttfts, hit, _, _ = run_strategy(
+            arm, pages_per_pod=TWO_TIER_PAGES_PER_POD
+        )
+        raw[arm] = (ttfts, hit)
+        results[arm] = {
+            "ttft_p50_s": round(p50(ttfts), 4),
+            "ttft_p90_s": round(p90(ttfts), 4),
+            "ttft_mean_s": round(sum(ttfts) / len(ttfts), 4),
+            "prefix_hit_rate": round(hit, 4),
+        }
+    # The pressured precise/round_robin arms double as the two-tier
+    # host-tier-OFF baselines (identical deterministic configs).
+    two_tier = run_two_tier_comparison(
+        baseline_precise=raw["precise"], baseline_rr=raw["round_robin"]
+    )
 
     speedup = p50(ttft_rr) / max(p50(ttft_precise), 1e-9)
     stats = {
@@ -377,6 +478,10 @@ def main():
         "ttft_mean_round_robin_s": round(sum(ttft_rr) / len(ttft_rr), 4),
         "prefix_hit_rate": round(hit_rate, 4),
         "read_path_p50_ms": round(read_p50 * 1e3, 3),
+        "strategies_under_pressure": {
+            "hbm_pages_per_pod": TWO_TIER_PAGES_PER_POD,
+            "arms": results,
+        },
         "two_tier": two_tier,
         "requests": len(ttft_precise),
         "wall_s": round(time.time() - t_start, 1),
