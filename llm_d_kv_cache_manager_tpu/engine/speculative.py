@@ -260,11 +260,6 @@ class SpeculativeScheduler:
 
         if pod.lora_stack is not None:
             raise NotImplementedError("speculative scheduling with LoRA adapters")
-        if pod._model is not None and len(pod.kv_cache) != 2:
-            raise NotImplementedError(
-                "speculative scheduling requires the bf16 (k, v) cache "
-                "(verify_step_cache has no quantized path yet)"
-            )
         if k < 1:
             raise ValueError(f"k must be >= 1, got {k}")
         self.inner = Scheduler(pod, max_batch=max_batch,
@@ -277,7 +272,12 @@ class SpeculativeScheduler:
 
         page_size = pod.config.page_size
         self._stripe_pages = pod.config.max_pages_per_seq
-        n_draft_pages = max_batch * self._stripe_pages
+        # +1: a shared draft trash page. Each slot's table carries it as a
+        # final extra column, so a draft write past the stripe's capacity
+        # (a rectangular k-window overrunning one sequence's headroom)
+        # clamps into the trash page instead of corrupting a real row.
+        n_draft_pages = max_batch * self._stripe_pages + 1
+        self._draft_trash = n_draft_pages - 1
         self._draft_cache = llama.make_kv_pages(
             draft_config, n_draft_pages, page_size
         )
@@ -285,8 +285,11 @@ class SpeculativeScheduler:
         # Host-side per-slot stripe index rows (constant): avoids a
         # device round trip per running request per tick.
         self._slot_tables = np.stack([
-            np.arange(i * self._stripe_pages, (i + 1) * self._stripe_pages,
-                      dtype=np.int32)
+            np.concatenate([
+                np.arange(i * self._stripe_pages, (i + 1) * self._stripe_pages,
+                          dtype=np.int32),
+                np.asarray([self._draft_trash], dtype=np.int32),
+            ])
             for i in range(max_batch)
         ])
         # req_id -> [slot, draft_pos]; draft_pos counts positions with
@@ -358,14 +361,33 @@ class SpeculativeScheduler:
         pod = self.pod
         page_size = pod.config.page_size
 
-        # Per-sequence headroom caps a COMMON chunk width (the batched
-        # verify is rectangular); k_eff == 0 degenerates to exactly one
-        # plain decode step through the verify op.
-        k_eff = self.k
+        # Per-sequence acceptance budgets (ADVICE r2: mask per sequence,
+        # don't clamp the window to the weakest sequence). accepts[i] =
+        # how many PROPOSALS sequence i may keep this round, bounded by its
+        # remaining token budget and page capacity; the rectangular chunk
+        # width is sized to the strongest sequence, and weaker sequences'
+        # overrun rows are steered to the pod's trash page.
+        accepts = []
         for req in running:
             capacity = self._stripe_pages * page_size - len(req.state.tokens)
             budget = req.max_new_tokens - len(req.generated) - 1
-            k_eff = max(0, min(k_eff, capacity, budget))
+            b_i = max(0, min(self.k, capacity, budget))
+            # Reserve real pages for the rows this sequence may retain
+            # (positions len-1 .. len+b_i-1). On pool exhaustion degrade
+            # straight to b_i=0 — a pure decode step through the verify op
+            # needs no new pages (the pending row's page is already held) —
+            # rather than preempting the sequence.
+            if b_i > 0:
+                try:
+                    pod.block_manager.reserve_pages(
+                        req.state,
+                        (len(req.state.tokens) + b_i + page_size - 1)
+                        // page_size,
+                    )
+                except OutOfPagesError:
+                    b_i = 0
+            accepts.append(b_i)
+        k_eff = max(accepts)  # chunk width: strongest sequence's budget
 
         b = len(running)
         pending = np.asarray(
@@ -373,7 +395,9 @@ class SpeculativeScheduler:
         )
 
         # Batched draft proposals: ingest pending as the seed, then k_eff
-        # autoregressive steps.
+        # autoregressive steps. Draft writes past a stripe's capacity clamp
+        # into the shared draft trash column (see __init__) — garbage
+        # proposals there are harmless, acceptance is target-based.
         proposals = np.zeros((b, k_eff), dtype=np.int32)
         if k_eff > 0:
             tables = jnp.asarray(self._slot_tables[
@@ -405,35 +429,17 @@ class SpeculativeScheduler:
             self.stats.proposed += b * k_eff
         self.stats.rounds += 1
 
-        # One batched target verification over [pending, proposals...].
-        # Reserve verify headroom; pool exhaustion preempts the victim
-        # (recompute, like plain decode) instead of crashing the batch.
-        survivors = []
-        surviving_rows = []
-        for i, req in enumerate(running):
-            try:
-                pod.block_manager.reserve_pages(
-                    req.state,
-                    (len(req.state.tokens) + k_eff + page_size - 1) // page_size,
-                )
-            except OutOfPagesError:
-                self.inner._preempt(req)
-                self._release(req.req_id)
-                continue
-            survivors.append(req)
-            surviving_rows.append(i)
-        if not survivors:
-            self.inner._running = []
-            return []
-        if len(survivors) != len(running):
-            running = survivors
-            b = len(running)
-            pending = pending[surviving_rows]
-            proposals = proposals[surviving_rows]
-
+        # One batched target verification over [pending, proposals...],
+        # with per-sequence row allowances: sequence i's rows land in real
+        # pages up to position len+accepts[i]-1 and in the trash page past
+        # that.
         chunk = np.concatenate([pending[:, None], proposals], axis=1)
         starts = np.asarray(
             [len(r.state.tokens) - 1 for r in running], np.int32
+        )
+        max_lens = np.asarray(
+            [len(r.state.tokens) + a for r, a in zip(running, accepts)],
+            np.int32,
         )
         need = max(len(r.state.block_table) for r in running)
         bucket = pod.table_bucket(need)
@@ -443,6 +449,7 @@ class SpeculativeScheduler:
         pod.kv_cache, verify_logits = llama.verify_step_cache(
             pod._model_config, pod.params, pod.kv_cache,
             jnp.asarray(chunk), jnp.asarray(tables), jnp.asarray(starts),
+            jnp.asarray(max_lens), pod.trash_page,
         )
         argmaxes = np.asarray(jnp.argmax(verify_logits, axis=-1))  # [B, k+1]
 
@@ -456,9 +463,11 @@ class SpeculativeScheduler:
         still_running = []
         for i, req in enumerate(running):
             # argmaxes[i, j] is the target opinion after chunk[i, j]; a
-            # proposal is accepted while it matches the chain.
+            # proposal is accepted while it matches the chain, capped by
+            # this sequence's own budget (columns past accepts[i] exist
+            # only because the batch is rectangular).
             n_accept = 0
-            for j in range(k_eff):
+            for j in range(accepts[i]):
                 if int(argmaxes[i, j]) != int(proposals[i, j]):
                     break
                 n_accept += 1
