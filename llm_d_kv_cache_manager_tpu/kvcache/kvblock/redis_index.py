@@ -76,7 +76,8 @@ class RedisIndex(Index):
     def __init__(self, config: Optional[RedisIndexConfig] = None):
         self.config = config or RedisIndexConfig()
         self._conn = RespConnection(self.config.url, self.config.timeout_s)
-        self._mu = threading.Lock()  # serialize reconnect attempts
+        self._mu = threading.Lock()  # guards backoff/reconnect bookkeeping
+        self._reconnecting = False
         self._down_until = 0.0
         # Negative sentinel: monotonic() is time-since-boot, so 0.0 would
         # suppress the FIRST outage warning during early uptime.
@@ -89,34 +90,48 @@ class RedisIndex(Index):
         self._conn.close()
 
     def _pipeline(self, commands):
-        if time.monotonic() < self._down_until:
-            raise ConnectionError(
-                f"redis backend in reconnect backoff ({self.config.url})"
-            )
+        # ADVICE r2: _down_until (and _reconnecting/_last_warn) are only
+        # read/written under _mu — with the threaded scoring pool,
+        # unguarded reads let concurrent lookups race the backoff window
+        # and each pay a full connect timeout. _mu is NEVER held across
+        # socket I/O: exactly one thread claims the reconnect (flag below)
+        # and pays the connect timeout while every other thread fails fast
+        # to cache-miss degradation.
+        with self._mu:
+            if time.monotonic() < self._down_until or self._reconnecting:
+                raise ConnectionError(
+                    f"redis backend in reconnect backoff ({self.config.url})"
+                )
         try:
-            replies = self._conn.pipeline(commands)
+            return self._conn.pipeline(commands)
         except OSError:
             with self._mu:
-                try:
-                    self._conn.connect()
-                except OSError:
-                    self._down_until = time.monotonic() + RECONNECT_BACKOFF_S
-                    raise
+                if time.monotonic() < self._down_until or self._reconnecting:
+                    raise  # another thread is on it / already failed
+                self._reconnecting = True
             try:
+                self._conn.connect()
                 replies = self._conn.pipeline(commands)
             except OSError:
-                self._down_until = time.monotonic() + RECONNECT_BACKOFF_S
+                with self._mu:
+                    self._down_until = time.monotonic() + RECONNECT_BACKOFF_S
                 raise
-        self._down_until = 0.0
-        return replies
+            finally:
+                with self._mu:
+                    self._reconnecting = False
+            with self._mu:
+                self._down_until = 0.0
+            return replies
 
     def _warn_cut(self, e: Exception) -> None:
         now = time.monotonic()
-        if now - self._last_warn >= _WARN_INTERVAL_S:
+        with self._mu:
+            if now - self._last_warn < _WARN_INTERVAL_S:
+                return
             self._last_warn = now
-            logger.warning(
-                "redis index unavailable, scoring degrades to cache misses: %s", e
-            )
+        logger.warning(
+            "redis index unavailable, scoring degrades to cache misses: %s", e
+        )
 
     def lookup(
         self, request_keys: Sequence[Key], pod_identifier_set: Set[str]

@@ -36,21 +36,27 @@ class RespConnection:
     # -- connection management ----------------------------------------------
 
     def connect(self) -> None:
-        parsed = urlparse(self.url)
-        if parsed.scheme == "unix":
-            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-            sock.settimeout(self.timeout_s)
-            sock.connect(parsed.path)
-        else:
-            host = parsed.hostname or "localhost"
-            port = parsed.port or 6379
-            sock = socket.create_connection((host, port), timeout=self.timeout_s)
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._sock = sock
-        self._buf = b""
-        db = (urlparse(self.url).path or "").lstrip("/")
-        if db and db.isdigit() and db != "0":
-            self._execute_locked([("SELECT", db)])
+        # Under _mu: swapping the socket/buffer while another thread is
+        # mid-pipeline would tear its frames (it could read replies
+        # belonging to the new connection's SELECT, or crash mid-write).
+        with self._mu:
+            parsed = urlparse(self.url)
+            if parsed.scheme == "unix":
+                sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                sock.settimeout(self.timeout_s)
+                sock.connect(parsed.path)
+            else:
+                host = parsed.hostname or "localhost"
+                port = parsed.port or 6379
+                sock = socket.create_connection(
+                    (host, port), timeout=self.timeout_s
+                )
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = sock
+            self._buf = b""
+            db = (urlparse(self.url).path or "").lstrip("/")
+            if db and db.isdigit() and db != "0":
+                self._execute_locked([("SELECT", db)])
 
     def close(self) -> None:
         with self._mu:
