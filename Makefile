@@ -1,7 +1,8 @@
 # Build/test entry points (parity with the reference's Makefile targets:
-# build/test/bench — /root/reference/Makefile).
+# build/test/bench/lint + pre-commit install — /root/reference/Makefile,
+# /root/reference/hooks/pre-commit.sh).
 
-.PHONY: native test bench clean proto
+.PHONY: native test bench clean proto lint precommit-install
 
 native:
 	cd native && python setup.py build_ext
@@ -9,6 +10,18 @@ native:
 
 test: native
 	python -m pytest tests/ -q
+
+lint:
+	@if command -v ruff >/dev/null 2>&1; then \
+		ruff check llm_d_kv_cache_manager_tpu tests examples services benchmarking bench.py; \
+	else \
+		echo "ruff not installed; falling back to compileall"; \
+		python -m compileall -q llm_d_kv_cache_manager_tpu tests examples services benchmarking bench.py; \
+	fi
+
+precommit-install:
+	ln -sf ../../hooks/pre-commit.sh .git/hooks/pre-commit
+	@echo "pre-commit hook installed (runs make lint + make test)"
 
 bench: native
 	python bench.py

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Pre-commit hook: lint + full test suite before every commit.
+# Install with `make precommit-install`.
+# Parity: /root/reference/hooks/pre-commit.sh:18-23 (make lint + make test).
+set -e
+
+echo "Running lint..."
+make lint
+
+echo "Running tests..."
+make test
+
+echo "All checks passed."
