@@ -239,11 +239,26 @@ class BlockManager:
         """Extend the sequence's block table with fresh (uncommitted) pages
         so device writes beyond the current token count have somewhere to
         land — speculative decoding writes proposed tokens' KV before
-        acceptance. Unused reservations return to the pool on free()."""
-        while len(state.block_table) < n_total_pages:
-            page_id = self._take_free_page()
-            self._pages[page_id].ref_count += 1
-            state.block_table.append(page_id)
+        acceptance, padded prefill writes bucket-tail rows. Unused
+        reservations return to the pool on free().
+
+        Atomic: on pool exhaustion the pages grabbed so far are returned
+        before raising, so a failed reservation never shrinks the pool for
+        other sequences (callers fall back to smaller windows / unpadded
+        compute and would otherwise strand the partial grab)."""
+        taken: List[int] = []
+        try:
+            while len(state.block_table) < n_total_pages:
+                page_id = self._take_free_page()
+                self._pages[page_id].ref_count += 1
+                state.block_table.append(page_id)
+                taken.append(page_id)
+        except OutOfPagesError:
+            for page_id in reversed(taken):
+                state.block_table.pop()
+                self._pages[page_id].ref_count -= 1
+                self._free_fresh.append(page_id)
+            raise
 
     def free(self, state: SequenceState) -> None:
         """Release the sequence. Committed pages stay cached (reclaimable);

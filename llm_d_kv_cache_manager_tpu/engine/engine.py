@@ -313,15 +313,46 @@ class EnginePod:
         """Compute KV (and logits) for tokens[start:end], attending over the
         first `start` already-resident positions. vLLM-style chunked
         prefill: the scheduler bounds end-start by its token budget so
-        decode ticks interleave with long prompts."""
+        decode ticks interleave with long prompts.
+
+        The chunk is padded to a power-of-2 length bucket so XLA compiles
+        one program per (bucket, table-bucket) pair instead of one per
+        prompt length — on TPU a compile costs seconds, so per-length
+        compilation would dominate a live fleet's TTFT. Pad rows write
+        garbage KV into reserved-ahead pages at positions beyond `end`;
+        every later real write lands at its position before that position
+        is ever attended, and page commits only ever cover real computed
+        tokens, so the garbage is never advertised or read."""
         if self._model is None:
             return  # accounting-only pods have no compute to chunk
         jnp = self._jnp
+        length = end - start
+        bucket = 1
+        while bucket < length:
+            bucket *= 2
+        if bucket > length:
+            from llm_d_kv_cache_manager_tpu.engine.block_manager import (
+                OutOfPagesError,
+            )
+
+            ps = self.config.page_size
+            pages_needed = (start + bucket + ps - 1) // ps
+            if pages_needed > self.config.max_pages_per_seq:
+                bucket = length  # capacity-capped: compute unpadded
+            else:
+                try:
+                    self.block_manager.reserve_pages(state, pages_needed)
+                except OutOfPagesError:
+                    bucket = length  # pool too tight: compute unpadded
         block_table = self._padded_table(state)
-        chunk = jnp.asarray(state.tokens[start:end], dtype=jnp.int32)
+        chunk_tokens = state.tokens[start:end] + [0] * (bucket - length)
+        chunk = jnp.asarray(chunk_tokens, dtype=jnp.int32)
+        # n_valid is passed even when the chunk is exactly bucket-sized:
+        # a None/array split would compile TWO programs per bucket pair.
         self.kv_cache, self.last_logits = self._model.prefill_cache(
             self._model_config, self.params, self.kv_cache, chunk,
             block_table, start, lora=self._lora_for_prefill(state.lora_id),
+            n_valid=jnp.asarray(length, jnp.int32),
         )
 
     def finish_prefill(self, state: SequenceState) -> None:

@@ -278,12 +278,20 @@ def prefill_cache(
     start_pos,  # int32: number of already-cached tokens (prefix-cache hit)
     lora=None,  # models.lora per-layer adapter (select_adapter) or None
     all_logits: bool = False,  # True: logits for EVERY position (spec verify)
+    n_valid: jax.Array | None = None,  # real token count when `tokens` is
+    # padded to a shape bucket (XLA compiles once per bucket instead of
+    # once per prompt length — essential on TPU where a compile costs
+    # seconds). Pad rows write garbage KV at positions beyond
+    # start_pos+n_valid: callers must have reserved those pages, and the
+    # rows are stale-but-masked (every later real write lands before its
+    # position is ever attended). None -> every position is real.
 ) -> Tuple[tuple, jax.Array]:
     """Prefill new tokens, attending to the cached prefix; returns
-    (kv_cache, last_token_logits) — or [L, vocab] logits with
-    `all_logits=True`, the speculative-decoding verification pass (the MXU
-    scores every proposed position in one shot). `lora` applies q/v
-    adapter deltas (models/lora.py) for this sequence's adapter."""
+    (kv_cache, last_token_logits) — logits of token n_valid-1 (or L-1
+    unpadded) — or [L, vocab] logits with `all_logits=True`, the
+    speculative-decoding verification pass (the MXU scores every proposed
+    position in one shot). `lora` applies q/v adapter deltas
+    (models/lora.py) for this sequence's adapter."""
     c = config
     l = tokens.shape[0]
     x = params["embed"][tokens][None]  # [1, L, d]
@@ -324,8 +332,9 @@ def prefill_cache(
     x = rms_norm(x, params["final_norm"], c.rms_eps)
     if all_logits:
         return kv_cache, x[0] @ params["out"]  # [L, vocab]
-    logits = x[:, -1] @ params["out"]  # [1, vocab]
-    return kv_cache, logits[0]
+    last = l - 1 if n_valid is None else n_valid - 1
+    logits = x[0, last] @ params["out"]  # [vocab]
+    return kv_cache, logits
 
 
 def _decode_once(

@@ -294,6 +294,62 @@ class TestEnginePodWithModel:
         pod.free(state2)
 
 
+class TestBucketedPrefill:
+    CFG = None
+
+    def _pod(self):
+        from llm_d_kv_cache_manager_tpu.models.llama import LlamaConfig
+
+        if TestBucketedPrefill.CFG is None:
+            TestBucketedPrefill.CFG = LlamaConfig(
+                vocab_size=128, d_model=32, n_layers=1, n_q_heads=2,
+                n_kv_heads=2, head_dim=16, d_ff=64, dtype=jnp.float32,
+            )
+        return EnginePod(
+            EnginePodConfig(
+                n_pages=64, page_size=4, with_model=True,
+                model_config=TestBucketedPrefill.CFG, max_pages_per_seq=16,
+            )
+        )
+
+    def test_padded_prefill_logits_equal_unpadded(self):
+        from llm_d_kv_cache_manager_tpu.models import llama
+
+        pod = self._pod()
+        prompt = list(range(5))  # pads to bucket 8
+        state, _ = pod.prefill(prompt)
+        padded_logits = np.asarray(pod.last_logits)
+        pod.free(state)
+
+        cache = llama.make_kv_pages(TestBucketedPrefill.CFG, 8, 4)
+        _, ref_logits = llama.prefill_cache(
+            TestBucketedPrefill.CFG, pod.params, cache,
+            jnp.asarray(prompt, jnp.int32), jnp.arange(2, dtype=jnp.int32), 0,
+        )
+        np.testing.assert_allclose(padded_logits, np.asarray(ref_logits),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_compile_count_bounded_by_buckets(self):
+        # TPU serving economics: a compile costs seconds, so prefill must
+        # compile per LENGTH BUCKET, not per prompt length. 7 distinct
+        # lengths in (4, 16] span exactly two buckets (8, 16) — and the
+        # exact-pow2 length must share the padded bucket's program
+        # (n_valid is always an array, never a None variant).
+        from llm_d_kv_cache_manager_tpu.models import llama
+
+        pod = self._pod()
+        before = llama.prefill_cache._cache_size()
+        # Disjoint token ranges: no prefix-cache hits, so every prompt
+        # prefills its full length (a shared prefix would shrink the
+        # computed residual and legitimately hit smaller buckets).
+        for i, length in enumerate((5, 6, 7, 8, 9, 11, 13)):
+            base = i * 20
+            state, _ = pod.prefill(list(range(base, base + length)))
+            pod.free(state)
+        grew = llama.prefill_cache._cache_size() - before
+        assert grew <= 2, f"prefill compiled {grew} distinct programs for 7 lengths"
+
+
 class TestFreshPageRefcounts:
     def test_shared_committed_page_not_reclaimed_under_live_reader(self):
         # Regression (found in r2): fresh pages joined the table with
