@@ -197,6 +197,31 @@ def device_section() -> str:
                 f"{an['multistep_marginal_x_of_hbm_floor']}× the HBM floor** "
                 f"(fixed dispatch ≈ {an['multistep_fixed_dispatch_ms']}ms).",
             ]
+    dp = d.get("data_plane")
+    if dp and "extract_mbps" in dp:
+        out += [
+            "",
+            f"Block data plane (VERDICT r2 #7; page = "
+            f"{dp['page_nbytes'] / 1e6:.2f} MB / {dp['page_size_tokens']} "
+            "tokens). These measured rates feed bench.py's two-tier "
+            "gamma/delta constants:",
+            "",
+            "| leg | ms/page | MB/s | s/token |",
+            "|---|---:|---:|---:|",
+            f"| extract (device→host) | {dp['extract_ms_per_page']} "
+            f"| {dp['extract_mbps']} | — |",
+            f"| insert (host→device) | {dp['insert_ms_per_page']} "
+            f"| {dp['insert_mbps']} | {dp['host_restore_s_per_token']:.1e} |",
+        ]
+        if "onboard_mbps" in dp:
+            out += [
+                f"| staged fetch (loopback TCP) | {dp['staged_fetch_ms_per_page']} "
+                f"| {dp['staged_fetch_mbps']} | — |",
+                f"| onboard (fetch + insert) | {dp['onboard_ms_per_page']} "
+                f"| {dp['onboard_mbps']} | {dp['dcn_onboard_s_per_token']:.1e} |",
+                "",
+                f"_{dp['note']}._",
+            ]
     out += [
         "",
         f"Fidelity flags: {d['fidelity_flags'] or 'none — all numbers are physically plausible'}.",
