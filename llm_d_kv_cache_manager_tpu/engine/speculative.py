@@ -258,8 +258,6 @@ class SpeculativeScheduler:
     ):
         from llm_d_kv_cache_manager_tpu.engine.scheduler import Scheduler
 
-        if pod.lora_stack is not None:
-            raise NotImplementedError("speculative scheduling with LoRA adapters")
         if k < 1:
             raise ValueError(f"k must be >= 1, got {k}")
         self.inner = Scheduler(pod, max_batch=max_batch,
@@ -299,8 +297,14 @@ class SpeculativeScheduler:
 
     # -- public API mirroring Scheduler ------------------------------------
 
-    def submit(self, prompt_tokens, max_new_tokens=16, eos_token=None):
-        return self.inner.submit(prompt_tokens, max_new_tokens, eos_token)
+    def submit(self, prompt_tokens, max_new_tokens=16, eos_token=None,
+               lora_id=None):
+        """LoRA requests speculate too: the TARGET verifies with the
+        sequence's adapter (verify_step_cache lora), so emitted tokens are
+        exactly adapter-greedy; the draft proposes with its base weights —
+        adapter drift only lowers acceptance, never correctness."""
+        return self.inner.submit(prompt_tokens, max_new_tokens, eos_token,
+                                 lora_id=lora_id)
 
     @property
     def has_work(self) -> bool:
@@ -450,6 +454,7 @@ class SpeculativeScheduler:
             pod._model_config, pod.params, pod.kv_cache,
             jnp.asarray(chunk), jnp.asarray(tables), jnp.asarray(starts),
             jnp.asarray(max_lens), pod.trash_page,
+            lora=pod.lora_for_decode([r.lora_id for r in running]),
         )
         argmaxes = np.asarray(jnp.argmax(verify_logits, axis=-1))  # [B, k+1]
 

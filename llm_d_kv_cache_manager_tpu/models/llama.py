@@ -363,14 +363,9 @@ def _decode_once(
         x, = carry
         layer, cache = inputs["layer"], inputs["cache"]
         h = rms_norm(x, layer["attn_norm"], c.rms_eps)
-        q_flat = h @ layer["wq"]
-        v_flat = h @ layer["wv"]
-        if lora_layers is not None:
-            from llm_d_kv_cache_manager_tpu.models.lora import apply_decode_delta
-
-            dq, dv = apply_decode_delta(h, inputs["lora"])
-            q_flat = q_flat + dq
-            v_flat = v_flat + dv
+        q_flat, v_flat = _qv_proj_with_lora(
+            h, layer, inputs["lora"] if lora_layers is not None else None
+        )
         q = q_flat.reshape(b, 1, c.n_q_heads, c.head_dim)
         k = (h @ layer["wk"]).reshape(b, 1, c.n_kv_heads, c.head_dim)
         v = v_flat.reshape(b, 1, c.n_kv_heads, c.head_dim)
@@ -417,6 +412,23 @@ def _gathered_lora(lora):
 
     lora_stack, adapter_indices = lora
     return gather_adapters(lora_stack, adapter_indices)
+
+
+def _qv_proj_with_lora(h, layer, lora_slice):
+    """q/v projections with optional per-sequence LoRA deltas — the ONE
+    definition both the decode step and the speculative verify use, so the
+    two paths can never drift apart on LoRA math (their output identity is
+    a pinned invariant). h: [B, S, d]; lora_slice: gathered per-sequence
+    adapter arrays or None."""
+    q_flat = h @ layer["wq"]
+    v_flat = h @ layer["wv"]
+    if lora_slice is not None:
+        from llm_d_kv_cache_manager_tpu.models.lora import apply_decode_delta
+
+        dq, dv = apply_decode_delta(h, lora_slice)
+        q_flat = q_flat + dq
+        v_flat = v_flat + dv
+    return q_flat, v_flat
 
 
 @functools.partial(
@@ -532,6 +544,8 @@ def verify_step_cache(
     # short sequence's budget without corrupting real pages. None -> all
     # rows land in real pages.
     trash_page: int = 0,
+    lora=None,  # (adapter registry stack, [B] int32 indices) or None —
+    # same contract as decode_step_cache; a verify batch can mix adapters.
 ) -> Tuple[tuple, jax.Array]:
     """Batched multi-position verification: compute KV + logits for S new
     tokens of EVERY sequence in one pass — the op that makes speculative
@@ -560,13 +574,18 @@ def verify_step_cache(
     page_ids = page_ids.reshape(-1)  # [B*S]
     slots = (positions % page_size).reshape(-1)
 
+    lora_layers = _gathered_lora(lora)
+
     def layer_fn(carry, inputs):
         x, = carry
         layer, cache = inputs["layer"], inputs["cache"]
         h = rms_norm(x, layer["attn_norm"], c.rms_eps)
-        q = (h @ layer["wq"]).reshape(b, s, c.n_q_heads, c.head_dim)
+        q_flat, v_flat = _qv_proj_with_lora(
+            h, layer, inputs["lora"] if lora_layers is not None else None
+        )
+        q = q_flat.reshape(b, s, c.n_q_heads, c.head_dim)
         k = (h @ layer["wk"]).reshape(b, s, c.n_kv_heads, c.head_dim)
-        v = (h @ layer["wv"]).reshape(b, s, c.n_kv_heads, c.head_dim)
+        v = v_flat.reshape(b, s, c.n_kv_heads, c.head_dim)
         q = _rope(q, positions, c.rope_theta)
         k = _rope(k, positions, c.rope_theta)
 
@@ -629,6 +648,8 @@ def verify_step_cache(
         return (x,), cache
 
     xs = {"layer": params["layers"], "cache": tuple(kv_cache)}
+    if lora_layers is not None:
+        xs["lora"] = lora_layers
     (x,), kv_cache = jax.lax.scan(layer_fn, (x,), xs)
     x = rms_norm(x, params["final_norm"], c.rms_eps)
     return kv_cache, x @ params["out"]  # [B, S, vocab]

@@ -99,6 +99,30 @@ class TestTPServingOps:
 
         np.testing.assert_allclose(run(1), run(4), rtol=1e-5, atol=1e-5)
 
+    def test_multi_step_decode_matches_single_device(self):
+        """The on-device N-step decode loop (scan + argmax + page walk)
+        must produce identical tokens under tp sharding."""
+
+        def run(tp):
+            params = llama.init_params(CFG, jax.random.PRNGKey(2))
+            cache = llama.make_kv_pages(CFG, 17, 4)  # 16 real + trash 16
+            if tp > 1:
+                mesh = serving.tp_mesh(tp)
+                params = serving.shard_serving_params(params, mesh)
+                cache = serving.shard_kv_cache(cache, mesh)
+            prompt = jnp.arange(7, dtype=jnp.int32)
+            table = jnp.arange(4, dtype=jnp.int32)
+            cache, logits = llama.prefill_cache(CFG, params, cache, prompt, table, 0)
+            pending = jnp.argmax(logits)[None].astype(jnp.int32)
+            _, toks = llama.decode_multi_step_cache(
+                CFG, params, cache, pending, table[None],
+                jnp.asarray([7], jnp.int32), jnp.asarray([12], jnp.int32),
+                16, 5,
+            )
+            return list(np.asarray(toks)[0])
+
+        assert run(4) == run(1)
+
     def test_tp_must_divide_heads(self):
         with pytest.raises(ValueError, match="divide"):
             serving.validate_tp(3, CFG.n_q_heads, CFG.n_kv_heads)
