@@ -439,6 +439,17 @@ class EnginePod:
 
     # -- helpers -------------------------------------------------------------
 
+    @staticmethod
+    def batch_bucket(n: int) -> int:
+        """Power-of-2 batch-size bucket: the batch axis of decode/verify
+        dispatches pads to this so XLA compiles O(log max_batch) programs
+        as the running set shrinks, not one per distinct count. Single
+        definition for the plain and speculative schedulers."""
+        bucket = 1
+        while bucket < n:
+            bucket *= 2
+        return bucket
+
     def table_bucket(self, n_pages_needed: int) -> int:
         """Padded block-table width: next power of two covering the need, so
         short prompts don't pay attention compute over the maximal static

@@ -231,14 +231,23 @@ class Scheduler:
         )
 
     def _assemble_batch(self, running: List[Request]):
-        """Bucket-padded decode batch: (tables [B, bucket], pending tokens
-        [B], positions [B]) — shared by the single-step and multi-step
-        decode paths so they can never assemble inconsistently."""
+        """Bucket-padded decode batch: (tables [Bp, bucket], pending tokens
+        [Bp], positions [Bp]) — shared by the single-step and multi-step
+        decode paths so they can never assemble inconsistently.
+
+        Both axes are padded to power-of-2 buckets: the table width (page
+        count) AND the batch size. Without batch bucketing every distinct
+        running count compiles its own XLA program (seconds each on TPU) as
+        sequences finish. Pad rows carry seq_len 0 and an all-trash-page
+        block table, so their (discarded) step still writes only the
+        sacrificial page — they can never corrupt real pages; callers index
+        outputs by the real running list, which drops pad rows naturally."""
         need = max(len(r.state.block_table) for r in running)
         bucket = self.pod.table_bucket(need)
-        tables = np.zeros((len(running), bucket), dtype=np.int32)
-        tokens = np.zeros((len(running),), dtype=np.int32)
-        positions = np.zeros((len(running),), dtype=np.int32)
+        b_pad = self.pod.batch_bucket(len(running))
+        tables = np.full((b_pad, bucket), self.pod.trash_page, dtype=np.int32)
+        tokens = np.zeros((b_pad,), dtype=np.int32)
+        positions = np.zeros((b_pad,), dtype=np.int32)
         for i, req in enumerate(running):
             bt = req.state.block_table
             tables[i, : len(bt)] = bt
@@ -253,6 +262,9 @@ class Scheduler:
             return self._decode_multi()
         jnp = self.pod._jnp
         tables, tokens, positions = self._assemble_batch(self._running)
+        # Pad-row adapters are base (index 0) — their output is discarded.
+        lora_ids = [r.lora_id for r in self._running]
+        lora_ids += [None] * (len(tokens) - len(lora_ids))
 
         self.pod.kv_cache, logits = self.pod._model.decode_step_cache(
             self.pod._model_config,
@@ -262,9 +274,7 @@ class Scheduler:
             jnp.asarray(tables),
             jnp.asarray(positions),
             self.pod.config.use_kernel,
-            lora=self.pod.lora_for_decode(
-                [r.lora_id for r in self._running]
-            ),
+            lora=self.pod.lora_for_decode(lora_ids),
         )
         next_tokens = np.asarray(jnp.argmax(logits, axis=-1))
 
@@ -329,7 +339,12 @@ class Scheduler:
             accepts.append(k)
 
         tables, tokens, positions = self._assemble_batch(running)
-        max_lens = positions + np.asarray(accepts, dtype=np.int32)  # rows allowed
+        # Pad rows: 0 rows allowed (every write lands in the trash page)
+        # and base adapter; their sampled tokens are never read.
+        padded_accepts = accepts + [0] * (len(tokens) - len(accepts))
+        max_lens = positions + np.asarray(padded_accepts, dtype=np.int32)
+        lora_ids = [r.lora_id for r in running]
+        lora_ids += [None] * (len(tokens) - len(lora_ids))
 
         pod.kv_cache, toks = pod._model.decode_multi_step_cache(
             pod._model_config,
@@ -342,7 +357,7 @@ class Scheduler:
             pod.trash_page,
             n,
             pod.config.use_kernel,
-            lora=pod.lora_for_decode([r.lora_id for r in running]),
+            lora=pod.lora_for_decode(lora_ids),
         )
         toks = np.asarray(toks)  # [B, n]
 

@@ -394,25 +394,34 @@ class SpeculativeScheduler:
         k_eff = max(accepts)  # chunk width: strongest sequence's budget
 
         b = len(running)
-        pending = np.asarray(
-            [req.state.tokens[-1] for req in running], dtype=np.int32
-        )
+        # Batch axis padded to a power-of-2 bucket, like the plain
+        # scheduler's decode: otherwise every distinct running count
+        # compiles its own draft-step and verify program. Pad rows carry
+        # all-trash tables (draft trash column / pod trash page) and
+        # max_len 0, so their discarded steps never touch real pages.
+        b_pad = pod.batch_bucket(b)
+        pending = np.zeros((b_pad,), dtype=np.int32)
+        pending[:b] = [req.state.tokens[-1] for req in running]
 
         # Batched draft proposals: ingest pending as the seed, then k_eff
         # autoregressive steps. Draft writes past a stripe's capacity clamp
         # into the shared draft trash column (see __init__) — garbage
         # proposals there are harmless, acceptance is target-based.
-        proposals = np.zeros((b, k_eff), dtype=np.int32)
+        proposals = np.zeros((b_pad, k_eff), dtype=np.int32)
         if k_eff > 0:
-            tables = jnp.asarray(self._slot_tables[
+            draft_tables = np.full(
+                (b_pad, self._slot_tables.shape[1]), self._draft_trash,
+                dtype=np.int32,
+            )
+            draft_tables[:b] = self._slot_tables[
                 [self._draft_state[r.req_id][0] for r in running]
-            ])
+            ]
+            tables = jnp.asarray(draft_tables)
+            draft_pos = np.zeros((b_pad,), dtype=np.int32)
+            draft_pos[:b] = [self._draft_state[r.req_id][1] for r in running]
             cur = jnp.asarray(pending)
             for j in range(k_eff):
-                lens = jnp.asarray(
-                    [self._draft_state[r.req_id][1] + j for r in running],
-                    jnp.int32,
-                )
+                lens = jnp.asarray(draft_pos + j)
                 self._draft_cache, logits = llama.decode_step_cache(
                     self.draft_config, self.draft_params, self._draft_cache,
                     cur, tables, lens,
@@ -422,13 +431,9 @@ class SpeculativeScheduler:
             # Ingest the final proposal's KV too (its logits are unused):
             # without this, a fully accepted round leaves a permanent
             # zero-KV hole in the draft cache at that position.
-            lens = jnp.asarray(
-                [self._draft_state[r.req_id][1] + k_eff for r in running],
-                jnp.int32,
-            )
             self._draft_cache, _ = llama.decode_step_cache(
                 self.draft_config, self.draft_params, self._draft_cache,
-                cur, tables, lens,
+                cur, tables, jnp.asarray(draft_pos + k_eff),
             )
             self.stats.proposed += b * k_eff
         self.stats.rounds += 1
@@ -438,23 +443,23 @@ class SpeculativeScheduler:
         # pages up to position len+accepts[i]-1 and in the trash page past
         # that.
         chunk = np.concatenate([pending[:, None], proposals], axis=1)
-        starts = np.asarray(
-            [len(r.state.tokens) - 1 for r in running], np.int32
-        )
-        max_lens = np.asarray(
-            [len(r.state.tokens) + a for r, a in zip(running, accepts)],
-            np.int32,
-        )
+        starts = np.zeros((b_pad,), np.int32)
+        starts[:b] = [len(r.state.tokens) - 1 for r in running]
+        max_lens = np.zeros((b_pad,), np.int32)  # pad rows: all writes → trash
+        max_lens[:b] = [
+            len(r.state.tokens) + a for r, a in zip(running, accepts)
+        ]
         need = max(len(r.state.block_table) for r in running)
         bucket = pod.table_bucket(need)
-        tables = np.zeros((b, bucket), dtype=np.int32)
+        tables = np.full((b_pad, bucket), pod.trash_page, dtype=np.int32)
         for i, req in enumerate(running):
             tables[i, : len(req.state.block_table)] = req.state.block_table
+        lora_ids = [r.lora_id for r in running] + [None] * (b_pad - b)
         pod.kv_cache, verify_logits = llama.verify_step_cache(
             pod._model_config, pod.params, pod.kv_cache,
             jnp.asarray(chunk), jnp.asarray(tables), jnp.asarray(starts),
             jnp.asarray(max_lens), pod.trash_page,
-            lora=pod.lora_for_decode([r.lora_id for r in running]),
+            lora=pod.lora_for_decode(lora_ids),
         )
         argmaxes = np.asarray(jnp.argmax(verify_logits, axis=-1))  # [B, k+1]
 
