@@ -1,6 +1,6 @@
-"""Advanced serving demo: two-tier KV data plane + multi-LoRA + speculative.
+"""Advanced serving demo: data plane + LoRA + speculation + TP + multi-step.
 
-Runs offline on any backend (tiny f32 models) and exercises the round-2
+Runs offline on any backend (tiny f32 models) and exercises the advanced
 serving features end to end:
 
 1. **Two-tier data plane**: pod A computes a prefix, exports it to its C++
@@ -11,6 +11,11 @@ serving features end to end:
    continuous batch; outputs match dedicated merged-weight pods.
 3. **Speculative decoding**: a small draft proposes, the target verifies
    all positions in one pass; output is identical to plain greedy.
+4. **Multi-step decode**: one on-device dispatch emits N tokens
+   (Scheduler(decode_steps=N)); output identical to plain ticks.
+5. **Tensor-parallel serving**: the same engine on a tp=2 mesh
+   (kv-head-sharded pages; the demo config has 2 kv heads), identical
+   greedy output.
 
 Run: python examples/advanced_serving_demo.py
 """
@@ -19,6 +24,12 @@ import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# The TP section needs virtual devices; must be set before backend init.
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+    ).strip()
 
 import jax
 
@@ -160,8 +171,52 @@ def demo_speculative():
     assert out == ref
 
 
+def demo_multi_step():
+    prompts = [list(range(5)), list(range(30, 39))]
+
+    def run(decode_steps):
+        pod = EnginePod(EnginePodConfig(
+            n_pages=64, page_size=PAGE, with_model=True, model_config=CFG,
+            max_pages_per_seq=16,
+        ), params=PARAMS)
+        sched = Scheduler(pod, max_batch=4, decode_steps=decode_steps)
+        ids = [sched.submit(p, max_new_tokens=9) for p in prompts]
+        results = sched.run()
+        return [results[i] for i in ids]
+
+    plain, multi = run(1), run(4)
+    print(f"[4] multi-step decode: 9 tokens/seq in "
+          f"{(9 + 3) // 4} dispatches instead of 9, identical output: "
+          f"{multi == plain}")
+    assert multi == plain
+
+
+def demo_tp_serving():
+    if len(jax.devices()) < 2:
+        print("[5] tp serving: skipped (<2 devices)")
+        return
+    prompts = [list(range(5)), list(range(30, 39))]
+
+    def run(tp):
+        pod = EnginePod(EnginePodConfig(
+            n_pages=64, page_size=PAGE, with_model=True, model_config=CFG,
+            max_pages_per_seq=16, tp=tp,
+        ), params=PARAMS)
+        sched = Scheduler(pod, max_batch=4)
+        ids = [sched.submit(p, max_new_tokens=6) for p in prompts]
+        results = sched.run()
+        return [results[i] for i in ids]
+
+    single, tp4 = run(1), run(2)
+    print(f"[5] tp serving: engine on a tp=2 mesh (kv-head-sharded pages), "
+          f"identical output: {tp4 == single}")
+    assert tp4 == single
+
+
 if __name__ == "__main__":
     demo_two_tier()
     demo_multi_lora()
     demo_speculative()
+    demo_multi_step()
+    demo_tp_serving()
     print("OK: advanced serving demo complete")

@@ -327,9 +327,7 @@ class EnginePod:
             return  # accounting-only pods have no compute to chunk
         jnp = self._jnp
         length = end - start
-        bucket = 1
-        while bucket < length:
-            bucket *= 2
+        bucket = self.batch_bucket(length)
         if bucket > length:
             from llm_d_kv_cache_manager_tpu.engine.block_manager import (
                 OutOfPagesError,
@@ -354,6 +352,62 @@ class EnginePod:
             block_table, start, lora=self._lora_for_prefill(state.lora_id),
             n_valid=jnp.asarray(length, jnp.int32),
         )
+
+    def prefill_chunk_batch(self, jobs):
+        """Compute several sequences' prefill chunks in ONE dispatch.
+
+        `jobs`: [(state, start, end)] — each sequence's tokens[start:end)
+        computed while attending its own cached prefix. Returns one
+        last-position logits vector per job (None-padded rows excluded).
+
+        This is packed prefill: a tick admitting several prompts pays one
+        weight stream instead of one per prompt. The op is
+        `verify_step_cache` — batched multi-position KV+logits with
+        per-sequence causal offsets — with per-sequence `max_lens` steering
+        the rectangular batch's pad-tail rows into the trash page, so no
+        page reservation beyond each sequence's real tokens is needed.
+        Single-job ticks ride the single-sequence `prefill_chunk` (its
+        length-bucketed program is cheaper than the batched gather).
+        """
+        if len(jobs) == 1:
+            state, start, end = jobs[0]
+            self.prefill_chunk(state, start, end)
+            return [self.last_logits]
+        jnp = self._jnp
+        lengths = [end - start for _, start, end in jobs]
+        l_bucket = self.batch_bucket(max(lengths))
+        b_pad = self.batch_bucket(len(jobs))
+        # Skew guard: a rectangular batch pays bucket-width compute for
+        # every row. When padding more than doubles the real token count
+        # (e.g. three 1-token chunks packed beside a 256-token slice),
+        # per-sequence length-bucketed dispatches are the cheaper shape.
+        if b_pad * l_bucket > 2 * sum(lengths):
+            out = []
+            for state, start, end in jobs:
+                self.prefill_chunk(state, start, end)
+                out.append(self.last_logits)
+            return out
+        t_bucket = self.table_bucket(
+            max(len(state.block_table) for state, _, _ in jobs)
+        )
+        chunk = np.zeros((b_pad, l_bucket), dtype=np.int32)
+        tables = np.full((b_pad, t_bucket), self.trash_page, dtype=np.int32)
+        starts = np.zeros((b_pad,), dtype=np.int32)
+        max_lens = np.zeros((b_pad,), dtype=np.int32)  # pad rows all-trash
+        for i, (state, start, end) in enumerate(jobs):
+            chunk[i, : end - start] = state.tokens[start:end]
+            tables[i, : len(state.block_table)] = state.block_table
+            starts[i] = start
+            max_lens[i] = end  # real rows: positions start .. end-1
+        lora_ids = [state.lora_id for state, _, _ in jobs]
+        lora_ids += [None] * (b_pad - len(jobs))
+        self.kv_cache, logits = self._model.verify_step_cache(
+            self._model_config, self.params, self.kv_cache,
+            jnp.asarray(chunk), jnp.asarray(tables), jnp.asarray(starts),
+            jnp.asarray(max_lens), self.trash_page,
+            lora=self.lora_for_decode(lora_ids),
+        )
+        return [logits[i, lengths[i] - 1] for i in range(len(jobs))]
 
     def finish_prefill(self, state: SequenceState) -> None:
         """Commit full pages + emit BlockStored — only now, when every
@@ -441,10 +495,10 @@ class EnginePod:
 
     @staticmethod
     def batch_bucket(n: int) -> int:
-        """Power-of-2 batch-size bucket: the batch axis of decode/verify
-        dispatches pads to this so XLA compiles O(log max_batch) programs
-        as the running set shrinks, not one per distinct count. Single
-        definition for the plain and speculative schedulers."""
+        """Next power-of-2 shape bucket (>=1). Batch axes of decode/verify
+        dispatches and prefill chunk lengths all pad to this, so XLA
+        compiles O(log) programs per axis instead of one per distinct
+        size. The ONE definition every padded axis uses."""
         bucket = 1
         while bucket < n:
             bucket *= 2

@@ -177,12 +177,36 @@ class Scheduler:
     def _prefill_tick(self) -> List[Request]:
         """Spend up to prefill_token_budget prompt tokens of compute. Long
         prompts span ticks (decode keeps running in between); short prompts
-        pack — several can admit in one tick if the budget covers them."""
+        pack — several can admit in one tick if the budget covers them.
+
+        Packed prefill: the tick first PLANS every chunk it will compute
+        (allocation + budget walk, no device work), then runs them all in
+        ONE dispatch (EnginePod.prefill_chunk_batch — one weight stream for
+        the whole admission wave), then resolves each completed prompt
+        (commit, first-token sample from its logits row, admission)."""
         finished: List[Request] = []
         budget = self.prefill_token_budget
-        while budget > 0 and self._waiting and len(self._running) < self.max_batch:
+
+        # Plan: decide every (req, start, end) chunk this tick computes.
+        jobs: List = []
+        completed: List[Request] = []
+        # First-page signatures of prompts planned this wave: a later
+        # arrival sharing a full-block prefix with one of them must wait
+        # for the NEXT wave — its prefix pages commit only after this
+        # wave's dispatch, and allocating it now would duplicate the pages
+        # and recompute the shared prefix (any shared full-block prefix
+        # implies equal first pages, so this check cannot miss).
+        ps = self.pod.config.page_size
+        wave_first_pages = set()
+        while (
+            budget > 0 and self._waiting
+            and len(self._running) + len(completed) < self.max_batch
+        ):
             req = self._waiting[0]
             if req.state is None:
+                first_page = tuple(req.prompt_tokens[:ps])
+                if first_page in wave_first_pages:
+                    break  # flush the wave; reuse its commits next tick
                 try:
                     state, start = self.pod.begin_prefill(
                         req.prompt_tokens, lora_id=req.lora_id
@@ -192,22 +216,36 @@ class Scheduler:
                 req.state = state
                 req.num_cached_tokens = state.num_cached_tokens
                 req.prefill_pos = start
+                wave_first_pages.add(first_page)
 
             end = min(req.prefill_pos + budget, len(req.prompt_tokens))
             if end > req.prefill_pos:
-                self.pod.prefill_chunk(req.state, req.prefill_pos, end)
+                jobs.append((req, req.prefill_pos, end))
                 budget -= end - req.prefill_pos
                 req.prefill_pos = end
             if req.prefill_pos < len(req.prompt_tokens):
                 break  # budget exhausted mid-prompt; resume next tick
-
-            # Prompt fully prefilled: commit pages/events, sample the first
-            # token from the final chunk's logits (for a re-admitted
-            # preempted request this continues its generation).
-            self.pod.finish_prefill(req.state)
+            completed.append(req)
             self._waiting.popleft()
+
+        if not jobs:
+            return finished
+
+        # Dispatch: one batched device call for the whole wave.
+        logits_rows = self.pod.prefill_chunk_batch(
+            [(req.state, start, end) for req, start, end in jobs]
+        )
+        logits_by_req = {
+            id(req): row for (req, _, _), row in zip(jobs, logits_rows)
+        }
+
+        # Resolve completed prompts: commit pages/events, sample the first
+        # token from the final chunk's logits (for a re-admitted preempted
+        # request this continues its generation).
+        for req in completed:
+            self.pod.finish_prefill(req.state)
             req.prefill_pos = None
-            token = int(self.pod._jnp.argmax(self.pod.last_logits))
+            token = int(self.pod._jnp.argmax(logits_by_req[id(req)]))
             req.generated.append(token)
             # A finished sequence never attends again — skip the (possibly
             # page-allocating) KV write for its final token.

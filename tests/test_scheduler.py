@@ -214,6 +214,62 @@ class TestChunkedPrefill:
         sched.step()
         assert len(sched._running) == 3  # all admitted in a single tick
 
+    def test_same_prefix_wave_flushes_and_reuses(self):
+        # Two identical prompts arriving in one tick: the second must NOT
+        # allocate before the first's pages commit (that would duplicate
+        # pages and recompute the prefix). The wave flushes; next tick the
+        # second request hits the committed prefix.
+        pod = _pod()
+        sched = Scheduler(pod, max_batch=4, prefill_token_budget=512)
+        prompt = list(range(12))
+        expected = _isolated_generate(prompt, 4)
+        a = sched.submit(prompt, max_new_tokens=4)
+        b = sched.submit(prompt, max_new_tokens=4)
+        sched.step()
+        b_req = sched._waiting[0] if sched._waiting else None
+        assert b_req is not None and b_req.req_id == b  # deferred one tick
+        sched.step()
+        assert b_req.num_cached_tokens >= 8  # reused A's committed pages
+        results = sched.run()
+        assert results[a] == expected
+        assert results[b] == expected
+
+    def test_packed_prefill_is_one_dispatch_and_identical(self):
+        # A multi-prompt admission wave must run as ONE device dispatch
+        # (prefill_chunk_batch -> verify_step_cache), not one per prompt,
+        # and emit exactly the sequential outputs.
+        from llm_d_kv_cache_manager_tpu.models import llama
+
+        prompts = [list(range(i * 16, i * 16 + 6)) for i in range(4)]
+        expected = [_isolated_generate(p, 4) for p in prompts]
+
+        pod = _pod()
+        sched = Scheduler(pod, max_batch=4, prefill_token_budget=512)
+        calls = {"verify": 0, "prefill": 0}
+        orig_verify, orig_prefill = llama.verify_step_cache, llama.prefill_cache
+
+        def spy_verify(*a, **k):
+            calls["verify"] += 1
+            return orig_verify(*a, **k)
+
+        def spy_prefill(*a, **k):
+            calls["prefill"] += 1
+            return orig_prefill(*a, **k)
+
+        llama.verify_step_cache = spy_verify
+        llama.prefill_cache = spy_prefill
+        try:
+            ids = [sched.submit(p, max_new_tokens=4) for p in prompts]
+            sched.step()  # the admission wave
+        finally:
+            llama.verify_step_cache = orig_verify
+            llama.prefill_cache = orig_prefill
+        assert calls["verify"] == 1  # one packed dispatch for 4 prompts
+        assert calls["prefill"] == 0
+        results = sched.run()
+        for rid, exp in zip(ids, expected):
+            assert results[rid] == exp
+
     def test_budget_validation(self):
         with pytest.raises(ValueError, match="prefill_token_budget"):
             Scheduler(_pod(), prefill_token_budget=0)

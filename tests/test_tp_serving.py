@@ -161,6 +161,34 @@ class TestTPEnginePod:
         assert cached2 == 12  # head-sharded pages reused through the table
         pod.free(state2)
 
+    def test_event_stream_is_tp_invariant(self):
+        """The control plane must not be able to tell a TP pod from a
+        single-device pod: identical prompts produce identical BlockStored
+        hash chains and token ids (the pod is ONE pod to the index)."""
+
+        def events_for(tp):
+            batches = []
+            pod = EnginePod(
+                EnginePodConfig(
+                    n_pages=32, page_size=4, with_model=True,
+                    model_config=CFG, max_pages_per_seq=16, tp=tp,
+                ),
+                event_sink=batches.append,
+            )
+            state, _ = pod.prefill(list(range(10)))
+            first = int(jnp.argmax(pod.last_logits))
+            pod.decode_append(state, first)
+            for _ in range(4):
+                pod.decode_step(state)
+            pod.free(state)
+            return [
+                (type(e).__name__, getattr(e, "block_hashes", None),
+                 getattr(e, "token_ids", None))
+                for b in batches for e in b.events
+            ]
+
+        assert events_for(4) == events_for(1)
+
     def test_cache_stays_head_sharded_through_decode(self):
         pod = self._pod(4)
         state, _ = pod.prefill(list(range(6)))
