@@ -190,12 +190,13 @@ class Scheduler:
         # Plan: decide every (req, start, end) chunk this tick computes.
         jobs: List = []
         completed: List[Request] = []
-        # First-page signatures of prompts planned this wave: a later
-        # arrival sharing a full-block prefix with one of them must wait
-        # for the NEXT wave — its prefix pages commit only after this
-        # wave's dispatch, and allocating it now would duplicate the pages
-        # and recompute the shared prefix (any shared full-block prefix
-        # implies equal first pages, so this check cannot miss).
+        # First-page signatures of prompts with UNCOMMITTED compute in this
+        # wave (newly admitted or resuming mid-prefill): a later arrival
+        # sharing a full-block prefix with one of them must wait for the
+        # NEXT wave — those pages commit only after this wave's dispatch,
+        # and allocating it now would duplicate the pages and recompute the
+        # shared prefix (any shared full-block prefix implies equal first
+        # pages, so the signature cannot miss).
         ps = self.pod.config.page_size
         wave_first_pages = set()
         while (
@@ -204,8 +205,7 @@ class Scheduler:
         ):
             req = self._waiting[0]
             if req.state is None:
-                first_page = tuple(req.prompt_tokens[:ps])
-                if first_page in wave_first_pages:
+                if tuple(req.prompt_tokens[:ps]) in wave_first_pages:
                     break  # flush the wave; reuse its commits next tick
                 try:
                     state, start = self.pod.begin_prefill(
@@ -216,11 +216,11 @@ class Scheduler:
                 req.state = state
                 req.num_cached_tokens = state.num_cached_tokens
                 req.prefill_pos = start
-                wave_first_pages.add(first_page)
 
             end = min(req.prefill_pos + budget, len(req.prompt_tokens))
             if end > req.prefill_pos:
                 jobs.append((req, req.prefill_pos, end))
+                wave_first_pages.add(tuple(req.prompt_tokens[:ps]))
                 budget -= end - req.prefill_pos
                 req.prefill_pos = end
             if req.prefill_pos < len(req.prompt_tokens):
@@ -241,11 +241,19 @@ class Scheduler:
 
         # Resolve completed prompts: commit pages/events, sample the first
         # token from the final chunk's logits (for a re-admitted preempted
-        # request this continues its generation).
+        # request this continues its generation). One argmax dispatch + one
+        # host sync for the whole wave — per-prompt argmax would pay the
+        # round-trip overhead the packed dispatch just amortized.
+        jnp = self.pod._jnp
+        first_tokens = {}
+        if completed:
+            stacked = jnp.stack([logits_by_req[id(r)] for r in completed])
+            toks = np.asarray(jnp.argmax(stacked, axis=-1))
+            first_tokens = {id(r): int(t) for r, t in zip(completed, toks)}
         for req in completed:
             self.pod.finish_prefill(req.state)
             req.prefill_pos = None
-            token = int(self.pod._jnp.argmax(logits_by_req[id(req)]))
+            token = first_tokens[id(req)]
             req.generated.append(token)
             # A finished sequence never attends again — skip the (possibly
             # page-allocating) KV write for its final token.

@@ -234,6 +234,29 @@ class TestChunkedPrefill:
         assert results[a] == expected
         assert results[b] == expected
 
+    def test_resumed_prompt_guards_same_prefix_arrival(self):
+        # Review repro (r3): a long prompt RESUMING mid-prefill in the wave
+        # must also block a same-prefix arrival — its pages commit only
+        # after the dispatch, so admitting B in the same wave would
+        # duplicate pages and recompute the prefix.
+        prompt = list(range(12))
+        expected = _isolated_generate(prompt, 3)
+        sched = Scheduler(_pod(), max_batch=4, prefill_token_budget=8)
+        a = sched.submit(prompt, max_new_tokens=3)
+        b = sched.submit(prompt, max_new_tokens=3)
+        sched.step()  # A computes [0, 8)
+        sched.step()  # A resumes + completes; B must NOT join this wave
+        b_req = next(
+            r for r in list(sched._waiting) + sched._running if r.req_id == b
+        )
+        results = {}
+        while sched.has_work:
+            for r in sched.step():
+                results[r.req_id] = r.generated
+        assert b_req.num_cached_tokens >= 8  # reused A's committed prefix
+        assert results[a] == expected
+        assert results[b] == expected
+
     def test_packed_prefill_is_one_dispatch_and_identical(self):
         # A multi-prompt admission wave must run as ONE device dispatch
         # (prefill_chunk_batch -> verify_step_cache), not one per prompt,
