@@ -195,11 +195,19 @@ class EnginePod:
             from llm_d_kv_cache_manager_tpu.models import llama
 
             mc = config.model_config or llama.LlamaConfig()
+            # Both model families serve through llama.py's paged ops (the
+            # MLP dispatches on the layer dict's structure): a config
+            # carrying n_experts is the MoE family (models/mixtral.py).
             self._model = llama
             self._model_config = mc
-            self.params = params if params is not None else llama.init_params(
-                mc, jax.random.PRNGKey(0)
-            )
+            if params is None:
+                if llama.is_moe_config(mc):
+                    from llm_d_kv_cache_manager_tpu.models import mixtral
+
+                    params = mixtral.init_params(mc, jax.random.PRNGKey(0))
+                else:
+                    params = llama.init_params(mc, jax.random.PRNGKey(0))
+            self.params = params
             # One sacrificial page beyond the block manager's pool: the
             # multi-step decode loop steers per-sequence out-of-budget KV
             # writes there (models/llama.decode_multi_step_cache), so a
@@ -219,6 +227,12 @@ class EnginePod:
             if config.tp > 1:
                 from llm_d_kv_cache_manager_tpu.parallel import serving
 
+                if llama.is_moe_config(mc):
+                    raise NotImplementedError(
+                        "tp serving for the MoE family needs an expert "
+                        "sharding spec set (parallel/serving.py covers the "
+                        "dense family); run MoE pods at tp=1"
+                    )
                 serving.validate_tp(config.tp, mc.n_q_heads, mc.n_kv_heads)
                 self.mesh = serving.tp_mesh(config.tp)
                 self.params = serving.shard_serving_params(self.params, self.mesh)

@@ -109,6 +109,40 @@ def _mlp(layer: Dict, x: jax.Array) -> jax.Array:
     return (gate * (x @ layer["w_up"])) @ layer["w_down"]
 
 
+def is_moe_config(config) -> bool:
+    """THE family predicate: a config carrying n_experts is the MoE family
+    (models/mixtral.py). Single definition — the engine's init/tp decisions
+    and the serving dispatch must never drift apart on what counts as MoE."""
+    return getattr(config, "n_experts", None) is not None
+
+
+def _mlp_dispatch(config, layer: Dict, x: jax.Array) -> jax.Array:
+    """Family dispatch for the serving paths: a layer dict carrying a
+    "router" key is a MoE layer (models/mixtral.py params) and routes
+    through the mixture; otherwise dense SwiGLU. The branch resolves at
+    trace time (dict structure is static), so every paged serving op —
+    prefill, decode, multi-step, verify — serves BOTH families from one
+    implementation; `config` is then the family's own (frozen, static)
+    config carrying the MoE fields.
+
+    Serving always routes DROPLESS (capacity_factor ignored): the
+    static-capacity dispatch contends per-expert slots across whatever
+    shares the dispatch, so a token's output would depend on co-batched
+    traffic and shape-bucket padding — breaking the paged == dense
+    contract and run-to-run reproducibility. Token dropping is a
+    throughput lever for training ticks; serving engines (vLLM's Mixtral
+    included) route every token."""
+    if "router" in layer:
+        import dataclasses
+
+        from llm_d_kv_cache_manager_tpu.models import mixtral
+
+        if config.capacity_factor is not None:
+            config = dataclasses.replace(config, capacity_factor=None)
+        return mixtral._moe_mlp(config, layer, x)
+    return _mlp(layer, x)
+
+
 # ---------------------------------------------------------------------------
 # Dense path (training / prefill math)
 # ---------------------------------------------------------------------------
@@ -322,7 +356,7 @@ def prefill_cache(
         attn = _dense_attention(q, k_all, v_all, start_pos)
         x = x + attn.reshape(1, l, c.q_dim) @ layer["wo"]
         h = rms_norm(x, layer["mlp_norm"], c.rms_eps)
-        x = x + _mlp(layer, h)
+        x = x + _mlp_dispatch(c, layer, h)
         return (x,), cache
 
     xs = {"layer": params["layers"], "cache": tuple(kv_cache)}
@@ -393,7 +427,7 @@ def _decode_once(
         attn = _cache_attend(cache, q[:, 0], block_tables, seq_lens + 1, use_kernel)
         x = x + attn.reshape(b, 1, c.q_dim) @ layer["wo"]
         h = rms_norm(x, layer["mlp_norm"], c.rms_eps)
-        x = x + _mlp(layer, h)
+        x = x + _mlp_dispatch(c, layer, h)
         return (x,), cache
 
     xs = {"layer": params["layers"], "cache": tuple(kv_cache)}
@@ -644,7 +678,7 @@ def verify_step_cache(
         attn = _dense_attention(q, k_all, v_all, start_positions)
         x = x + attn.reshape(b, s, c.q_dim) @ layer["wo"]
         h = rms_norm(x, layer["mlp_norm"], c.rms_eps)
-        x = x + _mlp(layer, h)
+        x = x + _mlp_dispatch(c, layer, h)
         return (x,), cache
 
     xs = {"layer": params["layers"], "cache": tuple(kv_cache)}

@@ -152,3 +152,119 @@ class TestCapacityDispatch:
         for tokens in batches:
             out = compiled(params, tokens)
             assert out.shape == (2, 8, cfg.vocab_size)
+
+
+class TestMoEServing:
+    """Round 3: the MoE family serves through the SAME paged engine as the
+    dense family (llama.py's serving ops dispatch on the layer dict's
+    "router" key). Contract: paged generation == dense-forward greedy."""
+
+    CFG = mixtral.MixtralConfig(
+        vocab_size=128, d_model=32, n_layers=2, n_q_heads=4, n_kv_heads=2,
+        head_dim=8, d_ff=64, n_experts=4, top_k=2, dtype=jnp.float32,
+    )
+
+    def _dense_greedy(self, params, prompt, n_new):
+        """Oracle: argmax chain through mixtral.forward_dense."""
+        tokens = list(prompt)
+        for _ in range(n_new):
+            logits = mixtral.forward_dense(
+                self.CFG, params, jnp.asarray([tokens], jnp.int32)
+            )
+            tokens.append(int(jnp.argmax(logits[0, -1])))
+        return tokens[len(prompt):]
+
+    def test_paged_generation_matches_dense_forward(self):
+        from llm_d_kv_cache_manager_tpu.engine.engine import (
+            EnginePod,
+            EnginePodConfig,
+        )
+
+        pod = EnginePod(EnginePodConfig(
+            n_pages=32, page_size=4, with_model=True, model_config=self.CFG,
+            max_pages_per_seq=16,
+        ))
+        prompt = list(range(9))
+        expected = self._dense_greedy(pod.params, prompt, 6)
+        state, _ = pod.prefill(prompt)
+        out = [int(jnp.argmax(pod.last_logits))]
+        pod.decode_append(state, out[0])
+        while len(out) < 6:
+            out.append(pod.decode_step(state))
+        pod.free(state)
+        assert out == expected
+
+    def test_scheduler_batch_matches_isolated(self):
+        from llm_d_kv_cache_manager_tpu.engine.engine import (
+            EnginePod,
+            EnginePodConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.engine.scheduler import Scheduler
+
+        def pod():
+            return EnginePod(EnginePodConfig(
+                n_pages=64, page_size=4, with_model=True,
+                model_config=self.CFG, max_pages_per_seq=16,
+            ))
+
+        prompts = [list(range(5)), list(range(20, 31)), list(range(40, 47))]
+
+        def isolated(prompt):
+            p = pod()
+            state, _ = p.prefill(list(prompt))
+            out = [int(jnp.argmax(p.last_logits))]
+            p.decode_append(state, out[0])
+            while len(out) < 5:
+                out.append(p.decode_step(state))
+            p.free(state)
+            return out
+
+        expected = [isolated(p) for p in prompts]
+        sched = Scheduler(pod(), max_batch=4, decode_steps=2)
+        ids = [sched.submit(p, max_new_tokens=5) for p in prompts]
+        results = sched.run()
+        assert [results[i] for i in ids] == expected
+
+    def test_serving_is_dropless_even_with_tight_capacity(self):
+        # Serving ignores capacity_factor by design: token-dropping MoE
+        # makes a token's output depend on co-batched traffic and shape
+        # padding (pad tokens would contend for expert slots), breaking
+        # reproducibility and the paged == dense contract. A TIGHT factor
+        # (1.0 — training ticks would drop tokens) must therefore serve
+        # exactly like the dropless config.
+        from llm_d_kv_cache_manager_tpu.engine.engine import (
+            EnginePod,
+            EnginePodConfig,
+        )
+        import dataclasses
+
+        cfg_cap = dataclasses.replace(self.CFG, capacity_factor=1.0)
+        params = mixtral.init_params(self.CFG, jax.random.PRNGKey(0))
+        prompt = list(range(8))
+
+        def run(cfg):
+            pod = EnginePod(EnginePodConfig(
+                n_pages=32, page_size=4, with_model=True, model_config=cfg,
+                max_pages_per_seq=16,
+            ), params=params)
+            state, _ = pod.prefill(prompt)
+            out = [int(jnp.argmax(pod.last_logits))]
+            pod.decode_append(state, out[0])
+            for _ in range(4):
+                out.append(pod.decode_step(state))
+            pod.free(state)
+            return out
+
+        assert run(cfg_cap) == run(self.CFG)
+
+    def test_moe_tp_serving_rejected_clearly(self):
+        from llm_d_kv_cache_manager_tpu.engine.engine import (
+            EnginePod,
+            EnginePodConfig,
+        )
+
+        with pytest.raises(NotImplementedError, match="MoE"):
+            EnginePod(EnginePodConfig(
+                n_pages=8, page_size=4, with_model=True,
+                model_config=self.CFG, tp=2,
+            ))
