@@ -200,6 +200,15 @@ class EnginePod:
             # carrying n_experts is the MoE family (models/mixtral.py).
             self._model = llama
             self._model_config = mc
+            if config.tp > 1 and llama.is_moe_config(mc):
+                # Reject BEFORE params init / page allocation: a real-size
+                # MoE pod would otherwise build GB-scale expert weights
+                # just to throw them away.
+                raise NotImplementedError(
+                    "tp serving for the MoE family needs an expert "
+                    "sharding spec set (parallel/serving.py covers the "
+                    "dense family); run MoE pods at tp=1"
+                )
             if params is None:
                 if llama.is_moe_config(mc):
                     from llm_d_kv_cache_manager_tpu.models import mixtral
@@ -207,6 +216,11 @@ class EnginePod:
                     params = mixtral.init_params(mc, jax.random.PRNGKey(0))
                 else:
                     params = llama.init_params(mc, jax.random.PRNGKey(0))
+            if llama.is_moe_config(mc) != ("router" in params["layers"]):
+                raise ValueError(
+                    "model_config family does not match params structure "
+                    "(MoE config needs router/expert params and vice versa)"
+                )
             self.params = params
             # One sacrificial page beyond the block manager's pool: the
             # multi-step decode loop steers per-sequence out-of-budget KV
@@ -227,12 +241,6 @@ class EnginePod:
             if config.tp > 1:
                 from llm_d_kv_cache_manager_tpu.parallel import serving
 
-                if llama.is_moe_config(mc):
-                    raise NotImplementedError(
-                        "tp serving for the MoE family needs an expert "
-                        "sharding spec set (parallel/serving.py covers the "
-                        "dense family); run MoE pods at tp=1"
-                    )
                 serving.validate_tp(config.tp, mc.n_q_heads, mc.n_kv_heads)
                 self.mesh = serving.tp_mesh(config.tp)
                 self.params = serving.shard_serving_params(self.params, self.mesh)

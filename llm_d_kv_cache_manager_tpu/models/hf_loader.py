@@ -56,10 +56,11 @@ def _to_np(t) -> np.ndarray:
     return t.detach().to("cpu").to(dtype=__import__("torch").float32).numpy()
 
 
-def params_from_hf(model_or_state_dict, config: LlamaConfig) -> Dict:
-    """Build the layer-stacked params pytree from an HF Llama model (or its
-    state_dict). Raises KeyError with the missing weight name if the
-    checkpoint is not Llama-shaped."""
+def _params_from_sd(model_or_state_dict, config, mlp_keys, mlp_rows) -> Dict:
+    """Shared HF->pytree machinery for both families: attention/norm rows,
+    embed, tied-or-untied lm_head, final assembly. `mlp_rows(w, prefix,
+    per_layer)` appends one layer's family-specific MLP entries (dense
+    SwiGLU or router + stacked experts) — the ONLY part that differs."""
     sd = (
         model_or_state_dict
         if isinstance(model_or_state_dict, dict)
@@ -71,8 +72,7 @@ def params_from_hf(model_or_state_dict, config: LlamaConfig) -> Dict:
         return arr.T if transpose else arr
 
     per_layer = {k: [] for k in (
-        "attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
-        "w_gate", "w_up", "w_down",
+        "attn_norm", "wq", "wk", "wv", "wo", "mlp_norm", *mlp_keys,
     )}
     for i in range(config.n_layers):
         p = f"model.layers.{i}."
@@ -82,9 +82,7 @@ def params_from_hf(model_or_state_dict, config: LlamaConfig) -> Dict:
         per_layer["wv"].append(w(p + "self_attn.v_proj.weight"))
         per_layer["wo"].append(w(p + "self_attn.o_proj.weight"))
         per_layer["mlp_norm"].append(w(p + "post_attention_layernorm.weight", False))
-        per_layer["w_gate"].append(w(p + "mlp.gate_proj.weight"))
-        per_layer["w_up"].append(w(p + "mlp.up_proj.weight"))
-        per_layer["w_down"].append(w(p + "mlp.down_proj.weight"))
+        mlp_rows(w, p, per_layer)
 
     embed = _to_np(sd["model.embed_tokens.weight"])
     if "lm_head.weight" in sd:
@@ -102,17 +100,91 @@ def params_from_hf(model_or_state_dict, config: LlamaConfig) -> Dict:
     }
 
 
+def params_from_hf(model_or_state_dict, config: LlamaConfig) -> Dict:
+    """Build the layer-stacked params pytree from an HF Llama model (or its
+    state_dict). Raises KeyError with the missing weight name if the
+    checkpoint is not Llama-shaped."""
+
+    def mlp_rows(w, p, per_layer):
+        per_layer["w_gate"].append(w(p + "mlp.gate_proj.weight"))
+        per_layer["w_up"].append(w(p + "mlp.up_proj.weight"))
+        per_layer["w_down"].append(w(p + "mlp.down_proj.weight"))
+
+    return _params_from_sd(
+        model_or_state_dict, config, ("w_gate", "w_up", "w_down"), mlp_rows
+    )
+
+
+def mixtral_config_from_hf(hf_config, dtype=jnp.bfloat16):
+    """Map transformers.MixtralConfig onto the engine's MixtralConfig.
+
+    Gating parity note: HF's MixtralSparseMoeBlock softmaxes over ALL
+    experts, takes top-k, and renormalizes by the selected sum; our
+    _moe_mlp takes top-k of the raw logits and softmaxes those. The two
+    are algebraically identical (softmax is monotonic; renormalized
+    selected softmax values equal exp(l_i)/sum_topk exp(l_j)), which the
+    parity test pins numerically."""
+    from llm_d_kv_cache_manager_tpu.models.mixtral import MixtralConfig
+
+    head_dim = getattr(hf_config, "head_dim", None) or (
+        hf_config.hidden_size // hf_config.num_attention_heads
+    )
+    return MixtralConfig(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_q_heads=hf_config.num_attention_heads,
+        n_kv_heads=hf_config.num_key_value_heads,
+        head_dim=head_dim,
+        d_ff=hf_config.intermediate_size,
+        n_experts=hf_config.num_local_experts,
+        top_k=hf_config.num_experts_per_tok,
+        rope_theta=float(hf_config.rope_theta),
+        rms_eps=float(hf_config.rms_norm_eps),
+        dtype=dtype,
+    )
+
+
+def mixtral_params_from_hf(model_or_state_dict, config) -> Dict:
+    """Build the MoE params pytree from an HF Mixtral model/state_dict.
+    HF stores experts as separate modules (block_sparse_moe.experts.{e}.w1/
+    w3/w2); ours stack them on a leading expert axis. w1=gate, w3=up,
+    w2=down (HF naming)."""
+
+    def mlp_rows(w, p, per_layer):
+        per_layer["router"].append(w(p + "block_sparse_moe.gate.weight"))
+        moe = p + "block_sparse_moe.experts."
+        per_layer["w_gate"].append(np.stack([
+            w(f"{moe}{e}.w1.weight") for e in range(config.n_experts)
+        ]))
+        per_layer["w_up"].append(np.stack([
+            w(f"{moe}{e}.w3.weight") for e in range(config.n_experts)
+        ]))
+        per_layer["w_down"].append(np.stack([
+            w(f"{moe}{e}.w2.weight") for e in range(config.n_experts)
+        ]))
+
+    return _params_from_sd(
+        model_or_state_dict, config,
+        ("router", "w_gate", "w_up", "w_down"), mlp_rows,
+    )
+
+
 def load_hf_llama(
     model_name_or_path: str, dtype=jnp.bfloat16
-) -> Tuple[LlamaConfig, Dict]:
+) -> Tuple[object, Dict]:
     """(config, params) from a local path or hub id (downloads only when
-    the environment permits)."""
+    the environment permits). Dispatches on the checkpoint's model_type:
+    llama -> (LlamaConfig, params); mixtral -> (MixtralConfig, params)."""
     from transformers import AutoConfig, AutoModelForCausalLM
 
     hf_config = AutoConfig.from_pretrained(model_name_or_path)
-    config = config_from_hf(hf_config, dtype=dtype)
     model = AutoModelForCausalLM.from_pretrained(model_name_or_path)
     try:
+        if hf_config.model_type == "mixtral":
+            config = mixtral_config_from_hf(hf_config, dtype=dtype)
+            return config, mixtral_params_from_hf(model, config)
+        config = config_from_hf(hf_config, dtype=dtype)
         return config, params_from_hf(model, config)
     finally:
         del model

@@ -268,3 +268,42 @@ class TestMoEServing:
                 n_pages=8, page_size=4, with_model=True,
                 model_config=self.CFG, tp=2,
             ))
+
+    def test_speculative_scheduling_on_moe_pod(self):
+        # Speculation composes with the MoE family: a dense draft proposes,
+        # the MoE target verifies — output equals the plain MoE scheduler.
+        from llm_d_kv_cache_manager_tpu.engine.engine import (
+            EnginePod,
+            EnginePodConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.engine.scheduler import Scheduler
+        from llm_d_kv_cache_manager_tpu.engine.speculative import (
+            SpeculativeScheduler,
+        )
+        from llm_d_kv_cache_manager_tpu.models import llama
+
+        params = mixtral.init_params(self.CFG, jax.random.PRNGKey(0))
+
+        def pod():
+            return EnginePod(EnginePodConfig(
+                n_pages=64, page_size=4, with_model=True,
+                model_config=self.CFG, max_pages_per_seq=16,
+            ), params=params)
+
+        draft_cfg = llama.LlamaConfig(
+            vocab_size=128, d_model=16, n_layers=1, n_q_heads=2,
+            n_kv_heads=2, head_dim=8, d_ff=32, dtype=jnp.float32,
+        )
+        draft_params = llama.init_params(draft_cfg, jax.random.PRNGKey(9))
+
+        prompts = [list(range(5)), list(range(20, 28))]
+        plain = Scheduler(pod(), max_batch=4)
+        pids = [plain.submit(p, max_new_tokens=6) for p in prompts]
+        pres = plain.run()
+
+        spec = SpeculativeScheduler(pod(), draft_cfg, draft_params, k=3,
+                                    max_batch=4)
+        sids = [spec.submit(p, max_new_tokens=6) for p in prompts]
+        sres = spec.run()
+        for pid, sid in zip(pids, sids):
+            assert sres[sid] == pres[pid]
