@@ -322,6 +322,22 @@ def bench_data_plane(config, fidelity_flags, n_pages: int = 8) -> dict:
 
     insert_s = per_page(insert)
 
+    # Batched forms: ONE gather/scatter dispatch moves every page — on a
+    # tunneled chip each eager op is a host RPC, so this amortizes the
+    # fixed round trip over the whole wave (chain restore / reclaim wave /
+    # export_sequence all ride these).
+    all_pages = list(range(n_pages))
+    extract_batch_s = timeit(
+        lambda: codec.extract_many(all_pages), warmup=1, iters=3
+    ) / n_pages
+    batch_items = [(i, payload) for i in all_pages]
+
+    def insert_batch():
+        codec.insert_many(batch_items)
+        jax.block_until_ready(shim.kv_cache)
+
+    insert_batch_s = timeit(insert_batch, warmup=1, iters=3) / n_pages
+
     def check_physical(leg: str, seconds: float):
         # Device-touching legs cannot beat the HBM bus (and host↔device
         # paths are far below it); above-HBM rates mean the tunnel
@@ -335,6 +351,8 @@ def bench_data_plane(config, fidelity_flags, n_pages: int = 8) -> dict:
 
     check_physical("extract", extract_s)
     check_physical("insert", insert_s)
+    check_physical("extract_batch", extract_batch_s)
+    check_physical("insert_batch", insert_batch_s)
 
     out = {
         "page_nbytes": codec.page_nbytes,
@@ -344,6 +362,12 @@ def bench_data_plane(config, fidelity_flags, n_pages: int = 8) -> dict:
         "insert_ms_per_page": round(insert_s * 1e3, 3),
         "insert_mbps": round(page_mb / insert_s, 1),
         "host_restore_s_per_token": round(insert_s / PAGE_SIZE, 8),
+        "batch_pages": n_pages,
+        "extract_batch_ms_per_page": round(extract_batch_s * 1e3, 3),
+        "extract_batch_mbps": round(page_mb / extract_batch_s, 1),
+        "insert_batch_ms_per_page": round(insert_batch_s * 1e3, 3),
+        "insert_batch_mbps": round(page_mb / insert_batch_s, 1),
+        "host_restore_batch_s_per_token": round(insert_batch_s / PAGE_SIZE, 8),
     }
 
     if conn_mod.native_available():
@@ -366,12 +390,36 @@ def bench_data_plane(config, fidelity_flags, n_pages: int = 8) -> dict:
 
             onboard_s = per_page(onboard)
             check_physical("onboard", onboard_s)
+
+            # Chain onboard: per-block TCP fetches + ONE insert dispatch —
+            # the path tiering.load_chain actually takes for a missed
+            # prefix chain.
+            def onboard_chain():
+                items = [
+                    (i, conn_mod.fetch_block(
+                        "127.0.0.1", server.port, i + 1,
+                        codec.page_nbytes + 64,
+                    ))
+                    for i in range(n_pages)
+                ]
+                codec.insert_many(items)
+                jax.block_until_ready(shim.kv_cache)
+
+            onboard_chain_s = timeit(
+                onboard_chain, warmup=1, iters=3
+            ) / n_pages
+            check_physical("onboard_chain", onboard_chain_s)
             out.update({
                 "staged_fetch_ms_per_page": round(fetch_s * 1e3, 3),
                 "staged_fetch_mbps": round(page_mb / fetch_s, 1),
                 "onboard_ms_per_page": round(onboard_s * 1e3, 3),
                 "onboard_mbps": round(page_mb / onboard_s, 1),
                 "dcn_onboard_s_per_token": round(onboard_s / PAGE_SIZE, 8),
+                "onboard_chain_ms_per_page": round(onboard_chain_s * 1e3, 3),
+                "onboard_chain_mbps": round(page_mb / onboard_chain_s, 1),
+                "dcn_onboard_chain_s_per_token": round(
+                    onboard_chain_s / PAGE_SIZE, 8
+                ),
                 "note": (
                     "fetch is loopback TCP — an upper bound on single-host "
                     "staging; cross-host DCN adds network RTT/bandwidth"

@@ -91,8 +91,23 @@ class OutOfPagesError(RuntimeError):
 #  PageLoader(chunk_hash, token_ids, parent_hash, page_id) -> bool — the hash
 #    chain missed in HBM; materialize the block into `page_id` from the host
 #    store or a remote pod and return True, else False.
+#  Batched forms (one device dispatch per wave instead of per page):
+#  ReclaimManyHook([(hash, token_ids, parent, page_id, lora_id)]) — offload a
+#    whole reclaim wave.
+#  ChainPlanner([hashes]) -> int — longest restorable prefix, membership
+#    checks only (no bytes moved).
+#  ChainLoader([(hash, token_ids, parent)], take_pages) -> [page_ids] —
+#    fetch a chain prefix's payloads FIRST, then call take_pages(k) for
+#    exactly the pages the fetched payloads need, land them in one insert
+#    dispatch, and return the landed page ids (aligned with the block
+#    prefix). Fetch-before-take means a stale plan (dead peer, desynced
+#    host store) cannot evict LRU-cached HBM pages for a restore that
+#    lands nothing.
 ReclaimHook = Callable[[int, List[int], Optional[int], int, Optional[int]], None]
 PageLoader = Callable[[int, List[int], Optional[int], int], bool]
+ReclaimManyHook = Callable[[List[tuple]], None]
+ChainPlanner = Callable[[List[int]], int]
+ChainLoader = Callable[[List[tuple], Callable[[int], List[int]]], List[int]]
 
 
 class BlockManager:
@@ -102,11 +117,17 @@ class BlockManager:
         event_sink: Optional[EventSink] = None,
         reclaim_hook: Optional[ReclaimHook] = None,
         page_loader: Optional[PageLoader] = None,
+        reclaim_many_hook: Optional[ReclaimManyHook] = None,
+        chain_planner: Optional[ChainPlanner] = None,
+        chain_loader: Optional[ChainLoader] = None,
     ):
         self.config = config
         self.event_sink = event_sink
         self.reclaim_hook = reclaim_hook
         self.page_loader = page_loader
+        self.reclaim_many_hook = reclaim_many_hook
+        self.chain_planner = chain_planner
+        self.chain_loader = chain_loader
         self.token_db = ChunkedTokenDatabase(
             TokenProcessorConfig(block_size=config.page_size, hash_seed=config.hash_seed)
         )
@@ -158,17 +179,21 @@ class BlockManager:
         # giving up on the chain.
         n_cached_pages = 0
         ps = self.config.page_size
+        chain_allowed = True
         for i, key in enumerate(hashes):
             page_id = self._hash_to_page.get(key.chunk_hash)
+            if page_id is None and chain_allowed:
+                # The data plane restores the longest restorable prefix of
+                # the remaining chain in ONE batch; restored blocks register
+                # in _hash_to_page, so re-checking picks them up in order.
+                # A restore's own reclaims can offload LATER chain blocks to
+                # the host tier (making them restorable one step behind), so
+                # keep retrying as long as each attempt makes progress —
+                # bounded by the chain length.
+                chain_allowed = self._try_load_chain(hashes, tokens, i, lora_id) > 0
+                page_id = self._hash_to_page.get(key.chunk_hash)
             if page_id is None:
-                page_id = self._try_load_page(
-                    key.chunk_hash,
-                    tokens[i * ps:(i + 1) * ps],
-                    hashes[i - 1].chunk_hash if i > 0 else None,
-                    lora_id,
-                )
-                if page_id is None:
-                    break
+                break
             page = self._pages[page_id]
             if page.ref_count == 0:
                 self._reclaimable.pop(page_id, None)
@@ -182,8 +207,9 @@ class BlockManager:
         # to zero while the other still holds it — the page becomes
         # reclaimable under a live reader (use-after-reclaim).
         try:
-            while len(block_table) < n_pages_needed:
-                page_id = self._take_free_page()
+            for page_id in self._take_free_pages(
+                n_pages_needed - len(block_table)
+            ):
                 self._pages[page_id].ref_count += 1
                 block_table.append(page_id)
         except OutOfPagesError:
@@ -242,23 +268,15 @@ class BlockManager:
         acceptance, padded prefill writes bucket-tail rows. Unused
         reservations return to the pool on free().
 
-        Atomic: on pool exhaustion the pages grabbed so far are returned
-        before raising, so a failed reservation never shrinks the pool for
+        Atomic: on pool exhaustion nothing is taken (the bulk grab is
+        all-or-nothing), so a failed reservation never shrinks the pool for
         other sequences (callers fall back to smaller windows / unpadded
         compute and would otherwise strand the partial grab)."""
-        taken: List[int] = []
-        try:
-            while len(state.block_table) < n_total_pages:
-                page_id = self._take_free_page()
-                self._pages[page_id].ref_count += 1
-                state.block_table.append(page_id)
-                taken.append(page_id)
-        except OutOfPagesError:
-            for page_id in reversed(taken):
-                state.block_table.pop()
-                self._pages[page_id].ref_count -= 1
-                self._free_fresh.append(page_id)
-            raise
+        for page_id in self._take_free_pages(
+            n_total_pages - len(state.block_table)
+        ):
+            self._pages[page_id].ref_count += 1
+            state.block_table.append(page_id)
 
     def free(self, state: SequenceState) -> None:
         """Release the sequence. Committed pages stay cached (reclaimable);
@@ -286,7 +304,8 @@ class BlockManager:
         """
         cached_hashes = list(self._hash_to_page)
         self.__init__(self.config, self.event_sink, self.reclaim_hook,
-                      self.page_loader)
+                      self.page_loader, self.reclaim_many_hook,
+                      self.chain_planner, self.chain_loader)
         events: List[Event] = []
         if cached_hashes:
             events.append(
@@ -308,82 +327,187 @@ class BlockManager:
 
     # -- internals -----------------------------------------------------------
 
-    def _try_load_page(
+    def _try_load_chain(
         self,
-        chunk_hash: int,
-        token_ids: List[int],
-        parent_hash: Optional[int],
+        hashes: List,
+        tokens: List[int],
+        start: int,
         lora_id: Optional[int],
-    ) -> Optional[int]:
-        """On an HBM-chain miss, ask the data plane (engine/tiering.py) to
-        materialize the block into a free page. Returns the committed page id
-        on success — the page enters the cache exactly as if prefill had
-        computed it, including the BlockStored event at the device tier."""
-        if self.page_loader is None:
-            return None
-        try:
-            page_id = self._take_free_page()
-        except OutOfPagesError:
-            return None
-        loaded = False
-        try:
-            loaded = self.page_loader(chunk_hash, token_ids, parent_hash, page_id)
-        except Exception as e:  # noqa: BLE001 - a data-plane fault must not
-            logger.debug("page loader failed for %x: %s", chunk_hash, e)
-            # fail the allocation; the chain just stops here.
-        if not loaded:
-            self._free_fresh.append(page_id)
-            return None
-        page = self._pages[page_id]
-        page.chunk_hash = chunk_hash
-        page.token_ids = list(token_ids)
-        page.parent_hash = parent_hash
-        page.lora_id = lora_id
-        self._hash_to_page[chunk_hash] = page_id
-        self._emit([
-            BlockStored(
-                block_hashes=[chunk_hash],
-                parent_block_hash=parent_hash,
-                token_ids=list(token_ids),
-                block_size=self.config.page_size,
-                lora_id=lora_id,
-                medium=self.config.device_tier,
+    ) -> int:
+        """On an HBM miss, materialize the longest restorable prefix of the
+        remaining hash chain from the data plane in ONE batch: plan
+        (membership checks), fetch the payloads, take exactly the pages the
+        fetched payloads need, land them in a single device dispatch
+        (tiering.load_chain), and commit with one chained multi-block
+        BlockStored — the shape vLLM itself emits for a stored chain.
+        Restored blocks register in _hash_to_page; the allocate loop
+        re-checks and consumes them. Returns the number of blocks landed."""
+        if self.chain_loader is None and self.page_loader is None:
+            return 0
+        ps = self.config.page_size
+        rest = hashes[start:]
+        # Truncate the batch at the first duplicate hash (both occurrences
+        # registering would strand a page) and at the first HBM-resident
+        # hash (re-fetching it would clobber the live page's registration —
+        # the outer loop consumes it from HBM). Later occurrences hit
+        # _hash_to_page on the outer loop's re-check.
+        seen = set()
+        uniq = []
+        for key in rest:
+            if key.chunk_hash in seen or key.chunk_hash in self._hash_to_page:
+                break
+            seen.add(key.chunk_hash)
+            uniq.append(key)
+        if not uniq:
+            return 0
+        if self.chain_planner is not None:
+            n_plan = min(
+                self.chain_planner([k.chunk_hash for k in uniq]), len(uniq)
             )
-        ])
-        return page_id
+        elif self.chain_loader is not None:
+            n_plan = len(uniq)
+        else:
+            n_plan = 1  # legacy single-page loader probes one block
+        if n_plan <= 0:
+            return 0
+        blocks = []
+        for j in range(n_plan):
+            i = start + j
+            blocks.append((
+                uniq[j].chunk_hash,
+                tokens[i * ps:(i + 1) * ps],
+                hashes[i - 1].chunk_hash if i > 0 else None,
+            ))
+
+        landed_pages: List[int] = []
+        taken_log: List[int] = []
+        if self.chain_loader is not None:
+            def take_pages(k: int) -> List[int]:
+                got = self._take_free_pages(min(k, self.num_free_pages))
+                taken_log.extend(got)
+                return got
+
+            try:
+                landed_pages = list(self.chain_loader(blocks, take_pages))
+            except Exception as e:  # noqa: BLE001 - a data-plane fault must
+                logger.debug("chain loader failed: %s", e)  # not fail allocate
+                landed_pages = []
+        else:
+            for chunk_hash, token_ids, parent_hash in blocks:
+                if self.num_free_pages <= 0:
+                    break
+                page_id = self._take_free_pages(1)[0]
+                taken_log.append(page_id)
+                try:
+                    ok = self.page_loader(
+                        chunk_hash, token_ids, parent_hash, page_id
+                    )
+                except Exception as e:  # noqa: BLE001
+                    logger.debug("page loader failed for %x: %s",
+                                 chunk_hash, e)
+                    ok = False
+                if not ok:
+                    break
+                landed_pages.append(page_id)
+
+        n_loaded = len(landed_pages)
+        stored_hashes: List[int] = []
+        stored_tokens: List[int] = []
+        for j in range(n_loaded):
+            chunk_hash, token_ids, parent_hash = blocks[j]
+            page = self._pages[landed_pages[j]]
+            page.chunk_hash = chunk_hash
+            page.token_ids = list(token_ids)
+            page.parent_hash = parent_hash
+            page.lora_id = lora_id
+            self._hash_to_page[chunk_hash] = page_id = landed_pages[j]
+            stored_hashes.append(chunk_hash)
+            stored_tokens.extend(token_ids)
+        # Anything taken but not landed (loader fault, short fetch) goes
+        # straight back to the pool.
+        landed_set = set(landed_pages)
+        for page_id in taken_log:
+            if page_id not in landed_set:
+                self._free_fresh.append(page_id)
+        if stored_hashes:
+            self._emit([
+                BlockStored(
+                    block_hashes=stored_hashes,
+                    parent_block_hash=blocks[0][2],
+                    token_ids=stored_tokens,
+                    block_size=ps,
+                    lora_id=lora_id,
+                    medium=self.config.device_tier,
+                )
+            ])
+        return n_loaded
 
     def _take_free_page(self) -> int:
-        if self._free_fresh:
-            return self._free_fresh.pop()
-        if self._reclaimable:
-            page_id, _ = self._reclaimable.popitem(last=False)  # LRU
+        return self._take_free_pages(1)[0]
+
+    def _take_free_pages(self, k: int) -> List[int]:
+        """k pages in one grab, fresh pool first then LRU reclaim. Atomic:
+        on shortfall nothing is taken. The whole reclaim wave offloads in
+        ONE batched hook call (one device extract dispatch) and drops with
+        ONE multi-hash BlockRemoved — per-page hooks/events made a K-page
+        admission pay K device round trips and K wire events."""
+        if k <= 0:
+            return []
+        got = [
+            self._free_fresh.pop()
+            for _ in range(min(k, len(self._free_fresh)))
+        ]
+        need = k - len(got)
+        if need == 0:
+            return got
+        if len(self._reclaimable) < need:
+            self._free_fresh.extend(reversed(got))
+            raise OutOfPagesError(
+                f"no free pages (pool={self.config.n_pages})"
+            )
+        victims = [
+            self._reclaimable.popitem(last=False)[0] for _ in range(need)
+        ]  # LRU order
+        offload_blocks: List[tuple] = []
+        removed_hashes: List[int] = []
+        for page_id in victims:
             page = self._pages[page_id]
             assert page.chunk_hash is not None
-            # Only drop the mapping (and tell the control plane) if this page
-            # is the registered holder of its hash — a duplicate-content page
-            # may have lost the registration race, and its reclaim must not
-            # evict the live page's index entry.
+            # Only drop the mapping (and tell the control plane) if this
+            # page is the registered holder of its hash — a duplicate-content
+            # page may have lost the registration race, and its reclaim must
+            # not evict the live page's index entry.
             if self._hash_to_page.get(page.chunk_hash) == page_id:
                 self._hash_to_page.pop(page.chunk_hash)
-                if self.reclaim_hook is not None and page.token_ids is not None:
-                    try:
-                        self.reclaim_hook(
-                            page.chunk_hash, page.token_ids, page.parent_hash,
-                            page_id, page.lora_id,
-                        )
-                    except Exception as e:  # noqa: BLE001 - offload is best-effort
-                        logger.debug("reclaim offload failed for %x: %s",
-                                     page.chunk_hash, e)
-                self._emit([BlockRemoved(block_hashes=[page.chunk_hash],
-                                         medium=self.config.device_tier)])
+                if page.token_ids is not None:
+                    offload_blocks.append((
+                        page.chunk_hash, page.token_ids, page.parent_hash,
+                        page_id, page.lora_id,
+                    ))
+                removed_hashes.append(page.chunk_hash)
             page.chunk_hash = None
             page.token_ids = None
             page.parent_hash = None
             page.lora_id = None
-            return page_id
-        raise OutOfPagesError(
-            f"no free pages (pool={self.config.n_pages})"
-        )
+        if offload_blocks:
+            if self.reclaim_many_hook is not None:
+                try:
+                    self.reclaim_many_hook(offload_blocks)
+                except Exception as e:  # noqa: BLE001 - offload is best-effort
+                    logger.debug("reclaim offload failed: %s", e)
+            elif self.reclaim_hook is not None:
+                # Per-block isolation: one failing offload must not drop
+                # the rest of the wave from both tiers.
+                for block in offload_blocks:
+                    try:
+                        self.reclaim_hook(*block)
+                    except Exception as e:  # noqa: BLE001
+                        logger.debug("reclaim offload failed for %x: %s",
+                                     block[0], e)
+        if removed_hashes:
+            self._emit([BlockRemoved(block_hashes=removed_hashes,
+                                     medium=self.config.device_tier)])
+        return got + victims
 
     def _rollback(self, block_table: List[int], n_cached: int) -> None:
         for i, page_id in enumerate(block_table):

@@ -31,28 +31,69 @@ from llm_d_kv_cache_manager_tpu.engine.tiering import PageCodec
 from llm_d_kv_cache_manager_tpu.kvevents.events import EventBatch
 from llm_d_kv_cache_manager_tpu.kvevents.publisher import Publisher, make_topic
 
-_SET_PAGE = None
+_GATHER_PAGES = None
+_SCATTER_PAGES = None
 
 
-def _set_page(comp, block, page_id):
-    """Jitted, buffer-donating `comp[:, :, page_id] = block` (lazy jax import)."""
-    global _SET_PAGE
-    if _SET_PAGE is None:
+def _gather_pages(cache: tuple, page_ids):
+    """Jitted gather of N pages from every cache component in ONE dispatch.
+
+    Returns one [N, n_layers, n_kv, ...] array per component; each row's
+    C-order bytes are exactly the per-page payload slice. One dispatch per
+    (cache-shape, N-bucket) pair — on a tunneled chip every eager op is an
+    RPC, so the per-component/per-page slicing this replaces paid
+    O(components x pages) round trips per batch."""
+    global _GATHER_PAGES
+    if _GATHER_PAGES is None:
         import jax
 
-        _SET_PAGE = jax.jit(
-            lambda c, b, i: c.at[:, :, i].set(b), donate_argnums=(0,)
+        _GATHER_PAGES = jax.jit(
+            lambda c, ids: tuple(
+                jax.numpy.moveaxis(comp[:, :, ids], 2, 0) for comp in c
+            )
         )
-    return _SET_PAGE(comp, block, page_id)
+    return _GATHER_PAGES(cache, page_ids)
+
+
+def _scatter_pages(cache: tuple, page_ids, blocks: tuple):
+    """Jitted, donating write of N page payloads into every component in ONE
+    dispatch: comp[:, :, page_ids[n]] = blocks[comp][n] for all n."""
+    global _SCATTER_PAGES
+    if _SCATTER_PAGES is None:
+        import jax
+
+        _SCATTER_PAGES = jax.jit(
+            lambda c, ids, bs: tuple(
+                comp.at[:, :, ids].set(jax.numpy.moveaxis(b, 0, 2))
+                for comp, b in zip(c, bs)
+            ),
+            donate_argnums=(0,),
+        )
+    return _SCATTER_PAGES(cache, page_ids, blocks)
+
+
+def _pad_bucket(n: int) -> int:
+    """Power-of-2 page-count bucket so the gather/scatter jits compile O(log)
+    programs, not one per batch size."""
+    bucket = 1
+    while bucket < n:
+        bucket *= 2
+    return bucket
 
 
 class _DevicePageCodec(PageCodec):
-    """Serializes one logical page across every layer of the pod's KV cache.
+    """Serializes logical pages across every layer of the pod's KV cache.
 
     Works for both layouts (bf16 (k, v) pair and int8 quantized 4-tuple):
     each cache component is [n_layers, n_kv_heads, n_pages, page_size, ...]
     with the page axis at position 2, so a block's bytes are the
     concatenation of each component's [:, :, page_id] slice.
+
+    All device crossings are batched: extract_many/insert_many move N pages
+    in one jitted dispatch + one transfer (the single-page forms are the
+    N=1 case). The reference plans this data plane but never builds it
+    (kv_connectors/ is empty); on TPU the batching is the difference
+    between O(pages) and O(1) host round trips per restored prefix chain.
     """
 
     def __init__(self, pod: "EnginePod"):
@@ -73,36 +114,66 @@ class _DevicePageCodec(PageCodec):
         return sum(self._slice_nbytes(c) for c in self.pod.kv_cache)
 
     def extract(self, page_id: int) -> bytes:
+        return self.extract_many([page_id])[0]
+
+    def extract_many(self, page_ids) -> List[bytes]:
         import jax
-
-        return b"".join(
-            np.asarray(jax.device_get(c[:, :, page_id])).tobytes()
-            for c in self.pod.kv_cache
-        )
-
-    def insert(self, page_id: int, payload: bytes) -> None:
-        if len(payload) != self.page_nbytes:
-            raise ValueError(
-                f"block payload is {len(payload)} bytes, expected "
-                f"{self.page_nbytes}"
-            )
         import jax.numpy as jnp
 
-        updated = []
-        offset = 0
-        for comp in self.pod.kv_cache:
-            n = self._slice_nbytes(comp)
-            block = np.frombuffer(payload[offset:offset + n], dtype=comp.dtype)
-            # Donated jit update: XLA writes the page slice in place
-            # (dynamic-update-slice) instead of copying the whole pool per
-            # landed block; page_id is traced so one compile per component
-            # shape serves every page.
-            updated.append(_set_page(
-                comp, jnp.asarray(block.reshape(self._slice_shape(comp))),
-                jnp.int32(page_id),
-            ))
-            offset += n
-        self.pod.kv_cache = tuple(updated)
+        if not page_ids:
+            return []
+        n = len(page_ids)
+        bucket = _pad_bucket(n)
+        ids = np.asarray(
+            list(page_ids) + [page_ids[-1]] * (bucket - n), dtype=np.int32
+        )
+        parts = jax.device_get(
+            _gather_pages(self.pod.kv_cache, jnp.asarray(ids))
+        )
+        return [
+            b"".join(np.ascontiguousarray(p[i]).tobytes() for p in parts)
+            for i in range(n)
+        ]
+
+    def insert(self, page_id: int, payload: bytes) -> None:
+        self.insert_many([(page_id, payload)])
+
+    def insert_many(self, items) -> None:
+        import jax.numpy as jnp
+
+        if not items:
+            return
+        for _, payload in items:
+            if len(payload) != self.page_nbytes:
+                raise ValueError(
+                    f"block payload is {len(payload)} bytes, expected "
+                    f"{self.page_nbytes}"
+                )
+        n = len(items)
+        bucket = _pad_bucket(n)
+        # Pad with a repeat of the last item: duplicate scatter indices
+        # write identical content, so the pad rows are harmless.
+        padded = list(items) + [items[-1]] * (bucket - n)
+        ids = np.asarray([pid for pid, _ in padded], dtype=np.int32)
+        blocks = []
+        for ci, comp in enumerate(self.pod.kv_cache):
+            nbytes = self._slice_nbytes(comp)
+            offset = sum(
+                self._slice_nbytes(c) for c in self.pod.kv_cache[:ci]
+            )
+            blocks.append(
+                np.stack(
+                    [
+                        np.frombuffer(
+                            payload[offset:offset + nbytes], dtype=comp.dtype
+                        ).reshape(self._slice_shape(comp))
+                        for _, payload in padded
+                    ]
+                )
+            )
+        self.pod.kv_cache = _scatter_pages(
+            self.pod.kv_cache, jnp.asarray(ids), tuple(blocks)
+        )
 
 
 @dataclass
@@ -185,6 +256,15 @@ class EnginePod:
             event_sink=self._emit,
             reclaim_hook=self.tier_store.reclaim_hook if self.tier_store else None,
             page_loader=self.tier_store.page_loader if self.tier_store else None,
+            reclaim_many_hook=(
+                self.tier_store.reclaim_many_hook if self.tier_store else None
+            ),
+            chain_planner=(
+                self.tier_store.plan_restore if self.tier_store else None
+            ),
+            chain_loader=(
+                self.tier_store.load_chain if self.tier_store else None
+            ),
         )
 
         self._model = None
@@ -497,15 +577,9 @@ class EnginePod:
         prefill/decode-disaggregation push. Returns the number staged."""
         if self.tier_store is None:
             raise RuntimeError("enable_host_tier=False: no data plane to export to")
-        n = 0
-        for chunk_hash, token_ids, parent_hash, page_id, lora_id in (
-            self.block_manager.committed_blocks(state)
-        ):
-            self.tier_store.export_block(
-                chunk_hash, token_ids, parent_hash, page_id, lora_id=lora_id,
-            )
-            n += 1
-        return n
+        blocks = list(self.block_manager.committed_blocks(state))
+        self.tier_store.export_blocks(blocks)
+        return len(blocks)
 
     def close(self) -> None:
         if self._publisher is not None:

@@ -47,26 +47,40 @@ PeerResolver = Callable[[int], Optional[Tuple[str, int]]]
 
 
 class PageCodec:
-    """Serializes one logical KV page (all layers) to/from opaque bytes."""
+    """Serializes logical KV pages (all layers) to/from opaque bytes.
+
+    The batch forms are the device-crossing unit: a real codec moves N
+    pages in one dispatch (engine._DevicePageCodec), so chain restores and
+    bulk reclaims pay O(1) round trips instead of O(pages). The single-page
+    forms default to the N=1 batch."""
 
     page_nbytes: int = 0
 
     def extract(self, page_id: int) -> bytes:
-        raise NotImplementedError
+        return self.extract_many([page_id])[0]
 
     def insert(self, page_id: int, payload: bytes) -> None:
+        self.insert_many([(page_id, payload)])
+
+    def extract_many(self, page_ids) -> List[bytes]:
+        raise NotImplementedError
+
+    def insert_many(self, items) -> None:
         raise NotImplementedError
 
 
 class NullPageCodec(PageCodec):
     """Accounting-only pods: zero-byte payloads, full event behavior."""
 
-    def extract(self, page_id: int) -> bytes:
-        return b""
+    def extract_many(self, page_ids) -> List[bytes]:
+        return [b"" for _ in page_ids]
 
-    def insert(self, page_id: int, payload: bytes) -> None:
-        if payload:
-            raise ValueError("accounting-only pod received a non-empty block")
+    def insert_many(self, items) -> None:
+        for _, payload in items:
+            if payload:
+                raise ValueError(
+                    "accounting-only pod received a non-empty block"
+                )
 
 
 class TieredKVStore:
@@ -101,8 +115,16 @@ class TieredKVStore:
         parent_hash: Optional[int], page_id: int,
         lora_id: Optional[int] = None,
     ) -> None:
-        self._stage(chunk_hash, token_ids, parent_hash, page_id, lora_id)
-        self.stats["offloads"] += 1
+        self.reclaim_many_hook(
+            [(chunk_hash, token_ids, parent_hash, page_id, lora_id)]
+        )
+
+    def reclaim_many_hook(self, blocks: List[tuple]) -> None:
+        """Batched reclaim→offload: one device extract dispatch for the
+        whole reclaim wave. `blocks`: (hash, token_ids, parent, page_id,
+        lora_id) tuples."""
+        self._stage_many(blocks)
+        self.stats["offloads"] += len(blocks)
 
     # -- P/D disaggregation: stage without reclaiming ----------------------
 
@@ -111,7 +133,14 @@ class TieredKVStore:
         parent_hash: Optional[int], page_id: int,
         lora_id: Optional[int] = None,
     ) -> None:
-        self._stage(chunk_hash, token_ids, parent_hash, page_id, lora_id)
+        self._stage_many(
+            [(chunk_hash, token_ids, parent_hash, page_id, lora_id)]
+        )
+
+    def export_blocks(self, blocks: List[tuple]) -> None:
+        """Stage a sequence's committed pages in one extract dispatch
+        (engine.export_sequence — the P/D disaggregation push)."""
+        self._stage_many(blocks)
 
     # -- BlockManager hook: miss → restore/onboard -------------------------
 
@@ -119,47 +148,99 @@ class TieredKVStore:
         self, chunk_hash: int, token_ids: List[int],
         parent_hash: Optional[int], page_id: int,
     ) -> bool:
-        # _staged exactly mirrors the local server's contents, so a miss
-        # there skips the loopback round trip on the allocation hot path.
-        if chunk_hash in self._staged:
-            payload = self.connector.fetch_staged(
-                chunk_hash, max(self.codec.page_nbytes, 1)
-            )
-            if payload is not None:
-                self.codec.insert(page_id, payload)
-                self.stats["restores"] += 1
-                return True
-        if self.peer_resolver is not None:
-            addr = self.peer_resolver(chunk_hash)
-            if addr is not None:
-                payload = self.connector.onboard_payload(
-                    addr[0], addr[1], chunk_hash, max(self.codec.page_nbytes, 1)
-                )
-                if payload is not None:
-                    self.codec.insert(page_id, payload)
-                    self.stats["onboards"] += 1
-                    return True
-        return False
+        landed = self.load_chain(
+            [(chunk_hash, token_ids, parent_hash)], lambda k: [page_id]
+        )
+        return len(landed) == 1
+
+    def plan_restore(self, chunk_hashes: List[int]) -> int:
+        """Longest prefix of `chunk_hashes` this store can materialize —
+        membership checks only (local host store, then peer index), no
+        bytes moved. The block manager calls this before grabbing pages so
+        a chain restore allocates exactly what will land."""
+        n = 0
+        for h in chunk_hashes:
+            if h in self._staged:
+                n += 1
+                continue
+            if self.peer_resolver is not None and self.peer_resolver(h) is not None:
+                n += 1
+                continue
+            break
+        return n
+
+    def load_chain(self, blocks: List[tuple], take_pages) -> List[int]:
+        """Materialize a chain prefix: fetch every payload (host store or
+        peer) FIRST, then call `take_pages(k)` for exactly the pages the
+        fetched payloads need, and land them in ONE insert_many dispatch.
+        `blocks`: (chunk_hash, token_ids, parent_hash) in chain order.
+        Returns the landed page ids (aligned with the block prefix) —
+        fetches stop at the first miss so the hash chain never gets a
+        hole, and fetch-before-take means a stale plan cannot evict
+        HBM-cached pages for a restore that lands nothing."""
+        fetched: List[tuple] = []  # (payload, source)
+        max_size = max(self.codec.page_nbytes, 1)
+        for chunk_hash, _tokens, _parent in blocks:
+            payload = None
+            source = None
+            if chunk_hash in self._staged:
+                payload = self.connector.fetch_staged(chunk_hash, max_size)
+                source = "restores"
+            if payload is None and self.peer_resolver is not None:
+                addr = self.peer_resolver(chunk_hash)
+                if addr is not None:
+                    payload = self.connector.onboard_payload(
+                        addr[0], addr[1], chunk_hash, max_size
+                    )
+                    source = "onboards"
+            if payload is None:
+                break
+            fetched.append((payload, source))
+        if not fetched:
+            return []
+        page_ids = take_pages(len(fetched))
+        fetched = fetched[: len(page_ids)]
+        if not fetched:
+            return []
+        self.codec.insert_many(
+            [(pid, payload) for pid, (payload, _) in zip(page_ids, fetched)]
+        )
+        for _, source in fetched:
+            self.stats[source] += 1
+        return list(page_ids[: len(fetched)])
 
     # -- internals ---------------------------------------------------------
 
-    def _stage(
-        self, chunk_hash: int, token_ids: List[int],
-        parent_hash: Optional[int], page_id: int,
-        lora_id: Optional[int] = None,
-    ) -> None:
-        if chunk_hash in self._staged:
-            self._staged.move_to_end(chunk_hash)
+    def _stage_many(self, blocks: List[tuple]) -> None:
+        """Stage blocks not already host-resident; ONE extract dispatch for
+        all of them. `blocks`: (hash, token_ids, parent, page_id, lora_id)."""
+        fresh = []
+        for block in blocks:
+            if block[0] in self._staged:
+                self._staged.move_to_end(block[0])
+            else:
+                fresh.append(block)
+        if not fresh:
             return
-        while len(self._staged) >= self.capacity_blocks:
-            victim, _ = self._staged.popitem(last=False)
-            self.connector.drop(victim)
-            self.stats["host_evictions"] += 1
-        self.connector.stage(
-            chunk_hash, self.codec.extract(page_id), token_ids,
-            len(token_ids), parent_hash, lora_id,
-        )
-        self._staged[chunk_hash] = None
+        payloads = self.codec.extract_many([b[3] for b in fresh])
+        for (chunk_hash, token_ids, parent_hash, _pid, lora_id), payload in zip(
+            fresh, payloads
+        ):
+            while len(self._staged) >= self.capacity_blocks:
+                victim, _ = self._staged.popitem(last=False)
+                self.connector.drop(victim)
+                self.stats["host_evictions"] += 1
+            # Per-block isolation: one failed stage must not drop the rest
+            # of the wave from the host tier.
+            try:
+                self.connector.stage(
+                    chunk_hash, payload, token_ids,
+                    len(token_ids), parent_hash, lora_id,
+                )
+            except Exception as e:  # noqa: BLE001 - staging is best-effort
+                logger.debug("stage failed for %x: %s", chunk_hash, e)
+                continue
+            self._staged[chunk_hash] = None
 
     @property
     def staged_count(self) -> int:

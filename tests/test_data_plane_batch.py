@@ -1,0 +1,328 @@
+"""Batched data-plane paths: N-page extract/insert in one dispatch, chain
+restore, bulk reclaim offload.
+
+The reference plans a kv_connectors data plane but never builds it (its
+directory is empty). Round 3 batches every device crossing: a restored
+prefix chain lands via ONE insert dispatch and a reclaim wave offloads via
+ONE extract dispatch — on a tunneled TPU each eager op is a host round
+trip, so the per-page forms paid O(components x pages) RPCs per chain.
+"""
+
+import numpy as np
+import pytest
+
+from llm_d_kv_cache_manager_tpu.engine.block_manager import (
+    BlockManager,
+    BlockManagerConfig,
+    OutOfPagesError,
+)
+from llm_d_kv_cache_manager_tpu.engine.engine import (
+    EnginePod,
+    EnginePodConfig,
+    _DevicePageCodec,
+)
+from llm_d_kv_cache_manager_tpu.kv_connectors.connector import native_available
+
+
+def _model_pod(quantized=False, **over):
+    from llm_d_kv_cache_manager_tpu.models import llama
+
+    cfg = dict(
+        pod_id="pod-c", n_pages=8, page_size=4, device_tier="hbm",
+        with_model=True, model_config=llama.LlamaConfig(),
+        use_quantized_kv=quantized,
+    )
+    cfg.update(over)
+    return EnginePod(EnginePodConfig(**cfg))
+
+
+class TestCodecBatch:
+    @pytest.mark.parametrize("quantized", [False, True])
+    def test_extract_many_matches_manual_page_bytes(self, quantized):
+        """Batch extraction byte-for-byte equals the per-component
+        [:, :, page_id] C-order concatenation the payload format specifies."""
+        pod = _model_pod(quantized)
+        state, _ = pod.prefill(list(range(12)))  # fills 3 pages with real KV
+        codec = _DevicePageCodec(pod)
+        page_ids = state.block_table[:3]
+        payloads = codec.extract_many(page_ids)
+        for pid, payload in zip(page_ids, payloads):
+            manual = b"".join(
+                np.ascontiguousarray(np.asarray(c)[:, :, pid]).tobytes()
+                for c in pod.kv_cache
+            )
+            assert payload == manual
+            assert len(payload) == codec.page_nbytes
+
+    @pytest.mark.parametrize("quantized", [False, True])
+    def test_insert_many_round_trips(self, quantized):
+        pod_a = _model_pod(quantized)
+        state, _ = pod_a.prefill(list(range(12)))
+        codec_a = _DevicePageCodec(pod_a)
+        payloads = codec_a.extract_many(state.block_table[:3])
+
+        pod_b = _model_pod(quantized)
+        codec_b = _DevicePageCodec(pod_b)
+        # Land pod A's pages at different page ids on pod B.
+        codec_b.insert_many(list(zip([5, 1, 6], payloads)))
+        assert codec_b.extract_many([5, 1, 6]) == payloads
+
+    def test_extract_many_empty_and_single(self):
+        pod = _model_pod()
+        codec = _DevicePageCodec(pod)
+        assert codec.extract_many([]) == []
+        state, _ = pod.prefill(list(range(4)))
+        pid = state.block_table[0]
+        assert codec.extract(pid) == codec.extract_many([pid])[0]
+
+    def test_insert_many_rejects_bad_payload_size(self):
+        pod = _model_pod()
+        codec = _DevicePageCodec(pod)
+        with pytest.raises(ValueError):
+            codec.insert_many([(0, b"short")])
+
+
+class TestBulkReclaim:
+    def test_take_free_pages_atomic_on_shortfall(self):
+        bm = BlockManager(BlockManagerConfig(n_pages=4, page_size=4))
+        s1 = bm.allocate(list(range(12)))  # 3 pages
+        free_before = bm.num_free_pages
+        with pytest.raises(OutOfPagesError):
+            bm._take_free_pages(2)
+        assert bm.num_free_pages == free_before  # nothing leaked
+        assert len(bm._take_free_pages(1)) == 1
+        bm.free(s1)
+
+    def test_reclaim_wave_offloads_in_one_batched_hook_call(self):
+        calls = []
+        bm = BlockManager(
+            BlockManagerConfig(n_pages=4, page_size=4),
+            reclaim_many_hook=lambda blocks: calls.append(list(blocks)),
+        )
+        s1 = bm.allocate(list(range(16)))
+        bm.commit_prefill(s1)
+        bm.free(s1)
+        bm.allocate([99] * 12)  # needs 3 pages -> one 3-victim wave
+        assert len(calls) == 1 and len(calls[0]) == 3
+        # LRU order: the wave carries the oldest committed pages first.
+        assert calls[0][0][1] == list(range(4))
+
+    def test_single_hook_still_honored_without_batch_hook(self):
+        calls = []
+        bm = BlockManager(
+            BlockManagerConfig(n_pages=4, page_size=4),
+            reclaim_hook=lambda *a: calls.append(a),
+        )
+        s1 = bm.allocate(list(range(16)))
+        bm.commit_prefill(s1)
+        bm.free(s1)
+        bm.allocate([99] * 8)
+        assert len(calls) == 2  # falls back to per-page invocation
+
+
+class TestChainRestore:
+    def test_chain_loader_called_once_with_full_prefix(self):
+        """The whole missing chain arrives in ONE loader call (one insert
+        dispatch), not one call per block."""
+        loads = []
+
+        def planner(hashes):
+            return len(hashes)  # everything restorable
+
+        def loader(blocks, take_pages):
+            loads.append(list(blocks))
+            return take_pages(len(blocks))
+
+        bm = BlockManager(
+            BlockManagerConfig(n_pages=8, page_size=4),
+            chain_planner=planner, chain_loader=loader,
+        )
+        s = bm.allocate(list(range(16)))
+        assert len(loads) == 1 and len(loads[0]) == 4
+        assert s.num_cached_tokens == 16
+        # Restored blocks are committed: a second allocate is a pure HBM hit.
+        loads.clear()
+        s2 = bm.allocate(list(range(16)))
+        assert s2.num_cached_tokens == 16 and not loads
+
+    def test_partial_chain_load_returns_unused_pages(self):
+        calls = []
+
+        def loader(blocks, take_pages):
+            calls.append(len(blocks))
+            # First call: one payload "fetched"; later calls: dry.
+            return take_pages(1) if len(calls) == 1 else []
+
+        bm = BlockManager(
+            BlockManagerConfig(n_pages=8, page_size=4),
+            chain_planner=lambda h: len(h), chain_loader=loader,
+        )
+        free_before = bm.num_free_pages
+        s = bm.allocate(list(range(16)))
+        assert s.num_cached_tokens == 4
+        # The retry-on-progress loop tried the remaining chain once more
+        # (the first load's reclaims could have staged later blocks), then
+        # stopped on the dry call.
+        assert calls == [4, 3]
+        # 4 pages allocated to the sequence; nothing leaked from the pool.
+        assert bm.num_free_pages == free_before - 4
+        bm.free(s)
+
+    def test_dry_fetch_takes_no_pages(self):
+        """Fetch-before-take: a plan that fetches nothing must not evict
+        cached pages (the stale-peer thrash amplification)."""
+        bm = BlockManager(
+            BlockManagerConfig(n_pages=4, page_size=4),
+            chain_planner=lambda h: len(h),
+            chain_loader=lambda blocks, take_pages: [],  # fetch lands nothing
+        )
+        s1 = bm.allocate(list(range(16)))
+        bm.commit_prefill(s1)
+        bm.free(s1)
+        cached_before = bm.num_cached_pages
+        s2 = bm.allocate([500 + i for i in range(4)])  # 1 fresh page needed
+        # The dry restore evicted nothing beyond the one page the fresh
+        # allocation itself required.
+        assert bm.num_cached_pages == cached_before - 1
+        bm.free(s2)
+
+    def test_resident_chain_suffix_not_refetched(self):
+        """A chain whose interior block is missing but whose later blocks
+        are HBM-resident must only restore up to the first resident hash —
+        re-fetching a live block would clobber its registration."""
+        loads = []
+
+        def loader(blocks, take_pages):
+            loads.append([b[0] for b in blocks])
+            return take_pages(len(blocks))
+
+        bm = BlockManager(
+            BlockManagerConfig(n_pages=16, page_size=4),
+            chain_planner=lambda h: len(h), chain_loader=loader,
+        )
+        s1 = bm.allocate(list(range(16)))  # restores all 4 via loader
+        assert len(loads[0]) == 4
+        bm.free(s1)
+        # Evict ONLY the first block by registering pressure selectively:
+        # drop block 0's mapping directly (simulating interior eviction).
+        first_hash = loads[0][0]
+        page_id = bm._hash_to_page.pop(first_hash)
+        bm._reclaimable.pop(page_id, None)
+        bm._free_fresh.append(page_id)
+        loads.clear()
+        s2 = bm.allocate(list(range(16)))
+        # Only the missing head was re-fetched; the resident suffix was
+        # consumed from HBM.
+        assert loads and loads[0] == [first_hash]
+        assert s2.num_cached_tokens == 16
+
+    def test_chain_restore_emits_one_chained_blockstored(self):
+        batches = []
+        bm = BlockManager(
+            BlockManagerConfig(n_pages=8, page_size=4, device_tier="hbm"),
+            event_sink=batches.append,
+            chain_planner=lambda h: len(h),
+            chain_loader=lambda blocks, take_pages: take_pages(len(blocks)),
+        )
+        bm.allocate(list(range(12)))
+        from llm_d_kv_cache_manager_tpu.kvevents.events import BlockStored
+
+        stored = [
+            e for b in batches for e in b.events if isinstance(e, BlockStored)
+        ]
+        assert len(stored) == 1
+        assert len(stored[0].block_hashes) == 3
+        assert stored[0].parent_block_hash is None
+        assert stored[0].token_ids == list(range(12))
+
+    def test_plan_zero_skips_loader(self):
+        loads = []
+        bm = BlockManager(
+            BlockManagerConfig(n_pages=8, page_size=4),
+            chain_planner=lambda h: 0,
+            chain_loader=lambda blocks, take_pages: loads.append(blocks) or [],
+        )
+        s = bm.allocate(list(range(16)))
+        assert s.num_cached_tokens == 0 and not loads
+
+    def test_loader_fault_returns_taken_pages(self):
+        def loader(blocks, take_pages):
+            take_pages(len(blocks))  # grabs pages...
+            raise RuntimeError("device fault mid-insert")
+
+        bm = BlockManager(
+            BlockManagerConfig(n_pages=8, page_size=4),
+            chain_planner=lambda h: len(h), chain_loader=loader,
+        )
+        free_before = bm.num_free_pages
+        s = bm.allocate(list(range(16)))
+        assert s.num_cached_tokens == 0  # restore failed, chain cut
+        bm.free(s)
+        assert bm.num_free_pages == free_before  # nothing leaked
+
+
+@pytest.mark.skipif(not native_available(), reason="libkvtransfer.so not built")
+class TestTieredBatchIntegration:
+    def test_onboard_chain_lands_in_one_insert_dispatch(self):
+        """Pod B onboards pod A's 3-block prefix through ONE codec
+        insert_many call — the cross-pod fetch is per-block TCP, but the
+        device crossing is batched."""
+        from llm_d_kv_cache_manager_tpu.models import llama
+
+        mc = llama.LlamaConfig()
+        import jax
+
+        params = llama.init_params(mc, jax.random.PRNGKey(0))
+
+        def pod(pod_id):
+            return EnginePod(
+                EnginePodConfig(
+                    pod_id=pod_id, n_pages=8, page_size=4, device_tier="hbm",
+                    with_model=True, model_config=mc, enable_host_tier=True,
+                ),
+                params=params,
+            )
+
+        pod_a, pod_b = pod("pod-a"), pod("pod-b")
+        try:
+            prompt = list(range(12))
+            state_a, _ = pod_a.prefill(prompt)
+            assert pod_a.export_sequence(state_a) == 3
+
+            codec = pod_b.tier_store.codec
+            insert_calls = []
+            orig = codec.insert_many
+
+            def spy(items):
+                insert_calls.append(len(items))
+                return orig(items)
+
+            codec.insert_many = spy
+            pod_b.set_peer_resolver(
+                lambda h: ("127.0.0.1", pod_a.connector.port)
+            )
+            state_b, cached = pod_b.prefill(prompt)
+            assert cached == 12
+            assert insert_calls == [3]  # one dispatch, three pages
+            assert pod_b.tier_store.stats["onboards"] == 3
+        finally:
+            pod_a.close()
+            pod_b.close()
+
+    def test_export_sequence_extracts_in_one_dispatch(self):
+        pod = _model_pod(enable_host_tier=True)
+        try:
+            codec = pod.tier_store.codec
+            extract_calls = []
+            orig = codec.extract_many
+
+            def spy(page_ids):
+                extract_calls.append(len(page_ids))
+                return orig(page_ids)
+
+            codec.extract_many = spy
+            state, _ = pod.prefill(list(range(12)))
+            assert pod.export_sequence(state) == 3
+            assert extract_calls == [3]
+        finally:
+            pod.close()

@@ -109,7 +109,9 @@ class TestBlockManager:
         removed = [
             e for b in batches for e in b.events if isinstance(e, BlockRemoved)
         ]
-        assert len(removed) == 2  # two pages reclaimed
+        # Two pages reclaimed in one wave -> ONE multi-hash BlockRemoved
+        # (the reference schema's BlockHashes list, events.go:77-81).
+        assert sum(len(e.block_hashes) for e in removed) == 2
 
     def test_free_keeps_pages_cached_for_reuse(self):
         bm = _manager()
