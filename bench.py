@@ -122,10 +122,19 @@ def _load_measured_data_plane() -> None:
             dp = json.load(f).get("data_plane", {})
     except (OSError, ValueError):
         return
-    if "host_restore_s_per_token" in dp:
+    # Prefer the batched-leg rates: the serving path restores/onboards
+    # chains through one insert_many dispatch (engine/tiering.load_chain),
+    # so the per-page single-dispatch rates overstate its cost ~2x.
+    if "host_restore_batch_s_per_token" in dp:
+        GAMMA_HOST_RESTORE_S_PER_TOKEN = dp["host_restore_batch_s_per_token"]
+        _GAMMA_SOURCE = "measured (DEVICE_BENCH.json data_plane, batched)"
+    elif "host_restore_s_per_token" in dp:
         GAMMA_HOST_RESTORE_S_PER_TOKEN = dp["host_restore_s_per_token"]
         _GAMMA_SOURCE = "measured (DEVICE_BENCH.json data_plane)"
-    if "dcn_onboard_s_per_token" in dp:
+    if "dcn_onboard_chain_s_per_token" in dp:
+        DELTA_DCN_ONBOARD_S_PER_TOKEN = dp["dcn_onboard_chain_s_per_token"]
+        _DELTA_SOURCE = "measured (DEVICE_BENCH.json data_plane, batched)"
+    elif "dcn_onboard_s_per_token" in dp:
         DELTA_DCN_ONBOARD_S_PER_TOKEN = dp["dcn_onboard_s_per_token"]
         _DELTA_SOURCE = "measured (DEVICE_BENCH.json data_plane)"
 

@@ -213,14 +213,37 @@ def device_section() -> str:
             f"| insert (host→device) | {dp['insert_ms_per_page']} "
             f"| {dp['insert_mbps']} | {dp['host_restore_s_per_token']:.1e} |",
         ]
+        if "extract_batch_mbps" in dp:
+            n_b = dp["batch_pages"]
+            out += [
+                f"| extract, batched ×{n_b} (one dispatch) "
+                f"| {dp['extract_batch_ms_per_page']} "
+                f"| {dp['extract_batch_mbps']} | — |",
+                f"| insert, batched ×{n_b} (one dispatch) "
+                f"| {dp['insert_batch_ms_per_page']} "
+                f"| {dp['insert_batch_mbps']} "
+                f"| {dp['host_restore_batch_s_per_token']:.1e} |",
+            ]
         if "onboard_mbps" in dp:
             out += [
                 f"| staged fetch (loopback TCP) | {dp['staged_fetch_ms_per_page']} "
                 f"| {dp['staged_fetch_mbps']} | — |",
                 f"| onboard (fetch + insert) | {dp['onboard_ms_per_page']} "
                 f"| {dp['onboard_mbps']} | {dp['dcn_onboard_s_per_token']:.1e} |",
+            ]
+        if "onboard_chain_mbps" in dp:
+            out += [
+                f"| onboard chain (fetches + ONE insert) "
+                f"| {dp['onboard_chain_ms_per_page']} "
+                f"| {dp['onboard_chain_mbps']} "
+                f"| {dp['dcn_onboard_chain_s_per_token']:.1e} |",
+            ]
+        if "onboard_mbps" in dp:
+            out += [
                 "",
-                f"_{dp['note']}._",
+                f"_{dp['note']}. The engine's chain restore/onboard path "
+                "(tiering.load_chain) takes the batched legs — those rates "
+                "are the gamma/delta fed to bench.py's two-tier model._",
             ]
     out += [
         "",

@@ -122,9 +122,9 @@ class TieredKVStore:
     def reclaim_many_hook(self, blocks: List[tuple]) -> None:
         """Batched reclaim→offload: one device extract dispatch for the
         whole reclaim wave. `blocks`: (hash, token_ids, parent, page_id,
-        lora_id) tuples."""
-        self._stage_many(blocks)
-        self.stats["offloads"] += len(blocks)
+        lora_id) tuples. Only blocks actually host-resident afterwards
+        count as offloads — a failed stage is not an offload."""
+        self.stats["offloads"] += self._stage_many(blocks)
 
     # -- P/D disaggregation: stage without reclaiming ----------------------
 
@@ -211,17 +211,20 @@ class TieredKVStore:
 
     # -- internals ---------------------------------------------------------
 
-    def _stage_many(self, blocks: List[tuple]) -> None:
+    def _stage_many(self, blocks: List[tuple]) -> int:
         """Stage blocks not already host-resident; ONE extract dispatch for
-        all of them. `blocks`: (hash, token_ids, parent, page_id, lora_id)."""
+        all of them. `blocks`: (hash, token_ids, parent, page_id, lora_id).
+        Returns how many of `blocks` are host-resident afterwards."""
         fresh = []
+        n_resident = 0
         for block in blocks:
             if block[0] in self._staged:
                 self._staged.move_to_end(block[0])
+                n_resident += 1
             else:
                 fresh.append(block)
         if not fresh:
-            return
+            return n_resident
         payloads = self.codec.extract_many([b[3] for b in fresh])
         for (chunk_hash, token_ids, parent_hash, _pid, lora_id), payload in zip(
             fresh, payloads
@@ -241,6 +244,8 @@ class TieredKVStore:
                 logger.debug("stage failed for %x: %s", chunk_hash, e)
                 continue
             self._staged[chunk_hash] = None
+            n_resident += 1
+        return n_resident
 
     @property
     def staged_count(self) -> int:

@@ -283,21 +283,32 @@ def _cache_gather_dense(cache: tuple, block_table, dtype):
     return jnp.swapaxes(k_all, 0, 1)[None], jnp.swapaxes(v_all, 0, 1)[None]
 
 
-def _cache_attend(cache: tuple, q, block_tables, seq_lens, use_kernel: bool):
-    """Batched decode attention over one layer's cache slice."""
+def _cache_attend(cache: tuple, q, block_tables, seq_lens, use_kernel: bool,
+                  pipelined: bool = False):
+    """Batched decode attention over one layer's cache slice.
+
+    `pipelined=True` selects the per-sequence manual-DMA kernel variant (2
+    strided descriptors move a page's K/V for ALL kv heads) — the right
+    shape inside a decode loop, where the tiled kernel's per-(head, page)
+    descriptors cost ~1ms/layer at batch 8 x ctx 2048 (measured; see
+    benchmarking/DEVICE_BENCH.json multistep analysis)."""
     if len(cache) == 2:
-        attend = paged_attention if use_kernel else paged_attention_reference
-        return attend(q, cache[0], cache[1], block_tables, seq_lens)
+        if use_kernel:
+            return paged_attention(q, cache[0], cache[1], block_tables,
+                                   seq_lens, pipelined=pipelined)
+        return paged_attention_reference(
+            q, cache[0], cache[1], block_tables, seq_lens
+        )
     from llm_d_kv_cache_manager_tpu.ops.quantized_kv import (
         paged_attention_quantized,
         paged_attention_quantized_reference,
     )
 
-    attend = (
-        paged_attention_quantized if use_kernel
-        else paged_attention_quantized_reference
-    )
-    return attend(q, *cache, block_tables, seq_lens)
+    if use_kernel:
+        return paged_attention_quantized(
+            q, *cache, block_tables, seq_lens, pipelined=pipelined
+        )
+    return paged_attention_quantized_reference(q, *cache, block_tables, seq_lens)
 
 
 @functools.partial(
@@ -382,60 +393,78 @@ def _decode_once(
     lora_layers,  # per-layer gathered adapter pytree or None (pre-gathered)
     write_page_ids: jax.Array,  # [B] page each new KV row lands in
     write_slots: jax.Array,  # [B]
+    pipelined: bool = True,  # kernel variant; see _cache_attend
 ) -> Tuple[tuple, jax.Array]:
     """Single batched decode step body (traced; shared by the one-shot
     `decode_step_cache` dispatch and the on-device `decode_multi_step_cache`
     loop). Writes each sequence's new K/V row at (write_page_ids,
-    write_slots) and attends over seq_lens+1 positions."""
+    write_slots) and attends over seq_lens+1 positions.
+
+    The layer loop is UNROLLED (n_layers is static) instead of a
+    `lax.scan` over stacked layers: threading the KV cache through a scan's
+    xs/ys forced XLA to materialize per-layer cache copies every step —
+    measured at ~2x the whole step's HBM floor at flagship size — while
+    the unrolled body scatters each new row directly into the
+    layer-stacked page arrays and reads only the layer's slice for
+    attention. Together with the pipelined kernel this took the in-loop
+    decode step from ~6.5x to ~2x of the HBM floor (device-bench
+    multistep analysis)."""
     c = config
     b = tokens.shape[0]
     x = params["embed"][tokens][:, None]  # [B, 1, d]
     positions = seq_lens[:, None]  # [B, 1]
     page_ids, slots = write_page_ids, write_slots
+    cache = tuple(kv_cache)
+    quantized = len(cache) != 2
+    if quantized:
+        from llm_d_kv_cache_manager_tpu.ops.quantized_kv import quantize_rows
 
-    def layer_fn(carry, inputs):
-        x, = carry
-        layer, cache = inputs["layer"], inputs["cache"]
-        h = rms_norm(x, layer["attn_norm"], c.rms_eps)
-        q_flat, v_flat = _qv_proj_with_lora(
-            h, layer, inputs["lora"] if lora_layers is not None else None
+    for layer_idx in range(c.n_layers):
+        layer = jax.tree_util.tree_map(
+            lambda w: w[layer_idx], params["layers"]
         )
+        lora_slice = (
+            jax.tree_util.tree_map(lambda w: w[layer_idx], lora_layers)
+            if lora_layers is not None else None
+        )
+        h = rms_norm(x, layer["attn_norm"], c.rms_eps)
+        q_flat, v_flat = _qv_proj_with_lora(h, layer, lora_slice)
         q = q_flat.reshape(b, 1, c.n_q_heads, c.head_dim)
         k = (h @ layer["wk"]).reshape(b, 1, c.n_kv_heads, c.head_dim)
         v = v_flat.reshape(b, 1, c.n_kv_heads, c.head_dim)
         q = _rope(q, positions, c.rope_theta)
         k = _rope(k, positions, c.rope_theta)
 
-        # Scatter each sequence's new K/V row into its page (per format).
-        if len(cache) == 2:
+        # Scatter each sequence's new K/V row straight into the stacked
+        # page array (per format) — no per-layer slice round trip. With
+        # the integer layer index in the index tuple, the advanced-index
+        # result dims move to the FRONT (numpy mixed-indexing rule), so
+        # the value shape is [B, n_kv, hd] — k[:, 0] as-is.
+        if not quantized:
             kp, vp = cache
-            kp = kp.at[:, page_ids, slots, :].set(jnp.swapaxes(k[:, 0], 0, 1))
-            vp = vp.at[:, page_ids, slots, :].set(jnp.swapaxes(v[:, 0], 0, 1))
+            kp = kp.at[layer_idx, :, page_ids, slots, :].set(k[:, 0])
+            vp = vp.at[layer_idx, :, page_ids, slots, :].set(v[:, 0])
             cache = (kp, vp)
         else:
-            from llm_d_kv_cache_manager_tpu.ops.quantized_kv import quantize_rows
-
             kq, ks, vq, vs = cache
-            k_rows, k_s = quantize_rows(jnp.swapaxes(k[:, 0], 0, 1))
-            v_rows, v_s = quantize_rows(jnp.swapaxes(v[:, 0], 0, 1))
-            kq = kq.at[:, page_ids, slots, :].set(k_rows)
-            ks = ks.at[:, page_ids, slots, 0].set(k_s)
-            vq = vq.at[:, page_ids, slots, :].set(v_rows)
-            vs = vs.at[:, page_ids, slots, 0].set(v_s)
+            k_rows, k_s = quantize_rows(k[:, 0])  # [B, n_kv, hd], [B, n_kv]
+            v_rows, v_s = quantize_rows(v[:, 0])
+            kq = kq.at[layer_idx, :, page_ids, slots, :].set(k_rows)
+            ks = ks.at[layer_idx, :, page_ids, slots, 0].set(k_s)
+            vq = vq.at[layer_idx, :, page_ids, slots, :].set(v_rows)
+            vs = vs.at[layer_idx, :, page_ids, slots, 0].set(v_s)
             cache = (kq, ks, vq, vs)
 
-        attn = _cache_attend(cache, q[:, 0], block_tables, seq_lens + 1, use_kernel)
+        attn = _cache_attend(
+            tuple(comp[layer_idx] for comp in cache), q[:, 0],
+            block_tables, seq_lens + 1, use_kernel, pipelined=pipelined,
+        )
         x = x + attn.reshape(b, 1, c.q_dim) @ layer["wo"]
         h = rms_norm(x, layer["mlp_norm"], c.rms_eps)
         x = x + _mlp_dispatch(c, layer, h)
-        return (x,), cache
 
-    xs = {"layer": params["layers"], "cache": tuple(kv_cache)}
-    if lora_layers is not None:
-        xs["lora"] = lora_layers
-    (x,), kv_cache = jax.lax.scan(layer_fn, (x,), xs)
     x = rms_norm(x, params["final_norm"], c.rms_eps)
-    return kv_cache, (x[:, 0] @ params["out"])
+    return cache, (x[:, 0] @ params["out"])
 
 
 def _gathered_lora(lora):
@@ -466,7 +495,8 @@ def _qv_proj_with_lora(h, layer, lora_slice):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("config", "use_kernel"), donate_argnums=(2,)
+    jax.jit, static_argnames=("config", "use_kernel", "pipelined"),
+    donate_argnums=(2,),
 )
 def decode_step_cache(
     config: LlamaConfig,
@@ -477,6 +507,9 @@ def decode_step_cache(
     seq_lens: jax.Array,  # [B] tokens already cached (position of new token)
     use_kernel: bool = False,
     lora=None,  # (adapter registry stack, [B] int32 indices) or None
+    pipelined: bool = True,  # per-sequence manual-DMA kernel variant — the
+    # measured-faster shape inside real decode (see _cache_attend); False
+    # selects the tiled kernel
 ) -> Tuple[tuple, jax.Array]:
     """One batched decode step; returns (kv_cache, logits [B, vocab]).
     `lora` is (stack, adapter_indices): the per-sequence gather happens
@@ -490,6 +523,7 @@ def decode_step_cache(
     return _decode_once(
         config, params, kv_cache, tokens, block_tables, seq_lens,
         use_kernel, _gathered_lora(lora), page_ids, slots,
+        pipelined=pipelined,
     )
 
 
