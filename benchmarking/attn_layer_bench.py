@@ -1,0 +1,86 @@
+"""Isolate the decode attention kernel's share of the multi-step marginal.
+
+DEVICE_BENCH.json says the multi-step decode loop costs 8.45ms/token
+marginal vs a 3.93ms HBM floor (batch 8, ctx 2048, flagship). The floor
+splits into weights (2.28GB -> 2.8ms) and KV pages (1.07GB -> 1.3ms); this
+bench times JUST the 16 layers of paged attention (one pipelined-kernel
+call per layer inside a single jit, distinct KV arrays so nothing caches)
+to attribute the gap: if attention alone is ~> 5ms the page-DMA pipeline is
+the target; if it's ~1.5ms the gap lives in the matmul/XLA side.
+
+Run on the TPU host: python benchmarking/attn_layer_bench.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+
+BATCH = 8
+N_LAYERS = 16
+N_KV = 8
+N_Q = 16
+HEAD = 128
+PAGE = 64
+CTX = 2048
+HBM_GBPS = 819.0
+
+
+def main():
+    from llm_d_kv_cache_manager_tpu.ops.paged_attention import paged_attention
+
+    pages_per_seq = CTX // PAGE
+    n_pages = BATCH * pages_per_seq + 1
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(keys[0], (BATCH, N_Q, HEAD), jnp.bfloat16)
+    kvs = []
+    for layer in range(N_LAYERS):
+        k = jax.random.split(jax.random.PRNGKey(layer + 1), 2)
+        kvs.append((
+            jax.random.normal(k[0], (N_KV, n_pages, PAGE, HEAD), jnp.bfloat16),
+            jax.random.normal(k[1], (N_KV, n_pages, PAGE, HEAD), jnp.bfloat16),
+        ))
+    bt = jnp.arange(BATCH * pages_per_seq, dtype=jnp.int32).reshape(
+        BATCH, pages_per_seq
+    )
+    lens = jnp.full((BATCH,), CTX, dtype=jnp.int32)
+
+    kv_bytes = sum(a.nbytes + b.nbytes for a, b in kvs)
+    floor_ms = kv_bytes / (HBM_GBPS * 1e9) * 1e3
+
+    def run(pipelined):
+        @jax.jit
+        def f(q, bt, lens, kvs):
+            acc = jnp.zeros_like(q)
+            for k, v in kvs:
+                acc = acc + paged_attention(
+                    q, k, v, bt, lens, pipelined=pipelined
+                )
+            return acc
+
+        for _ in range(3):
+            out = f(q, bt, lens, kvs)
+        jax.block_until_ready(out)
+        iters = 20
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = f(q, bt, lens, kvs)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    print(f"KV working set {kv_bytes / 1e9:.2f} GB, HBM floor {floor_ms:.2f} ms")
+    for name, pipelined in (("pipelined", True), ("tiled", False)):
+        ms = run(pipelined)
+        print(
+            f"{name:>10}: {ms:7.2f} ms for {N_LAYERS} layers "
+            f"({ms / floor_ms:.2f}x floor, "
+            f"{kv_bytes / 1e9 / (ms / 1e3):.0f} GB/s)"
+        )
+
+
+if __name__ == "__main__":
+    main()
