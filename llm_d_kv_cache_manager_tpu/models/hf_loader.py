@@ -32,9 +32,16 @@ from llm_d_kv_cache_manager_tpu.models.llama import LlamaConfig
 
 
 def config_from_hf(hf_config, dtype=jnp.bfloat16) -> LlamaConfig:
-    """Map transformers.LlamaConfig onto the engine's LlamaConfig."""
+    """Map a transformers Llama-family config (Llama/Mistral/Qwen2) onto
+    the engine's LlamaConfig. Qwen2 is the same decoder with additive
+    q/k/v biases: its config predates `attention_bias` so the bias is
+    implied by the model_type."""
     head_dim = getattr(hf_config, "head_dim", None) or (
         hf_config.hidden_size // hf_config.num_attention_heads
+    )
+    attn_bias = bool(
+        getattr(hf_config, "attention_bias", False)
+        or getattr(hf_config, "model_type", "") == "qwen2"
     )
     return LlamaConfig(
         vocab_size=hf_config.vocab_size,
@@ -47,6 +54,7 @@ def config_from_hf(hf_config, dtype=jnp.bfloat16) -> LlamaConfig:
         rope_theta=float(hf_config.rope_theta),
         rms_eps=float(hf_config.rms_norm_eps),
         dtype=dtype,
+        attn_bias=attn_bias,
     )
 
 
@@ -71,8 +79,28 @@ def _params_from_sd(model_or_state_dict, config, mlp_keys, mlp_rows) -> Dict:
         arr = _to_np(sd[name])
         return arr.T if transpose else arr
 
+    attn_bias = bool(getattr(config, "attn_bias", False))
+    bias_keys = ("bq", "bk", "bv") if attn_bias else ()
+    if attn_bias and "model.layers.0.self_attn.o_proj.bias" in sd:
+        # Llama-architecture attention_bias=True checkpoints bias all FOUR
+        # projections; the engine applies q/k/v biases only (Qwen2's
+        # layout). Loading such a checkpoint would silently drop the o
+        # bias — fail loud instead.
+        raise NotImplementedError(
+            "checkpoint has self_attn.o_proj.bias; only q/k/v attention "
+            "biases (Qwen2 layout) are supported"
+        )
+    if not attn_bias and "model.layers.0.self_attn.q_proj.bias" in sd:
+        # Mirror guard: bias tensors present but the mapped config didn't
+        # ask for them (custom export whose config lost attention_bias).
+        # Silently dropping them would mis-serve every logit.
+        raise ValueError(
+            "checkpoint carries self_attn q/k/v biases but the mapped "
+            "config has attn_bias=False; refusing to drop them silently"
+        )
     per_layer = {k: [] for k in (
-        "attn_norm", "wq", "wk", "wv", "wo", "mlp_norm", *mlp_keys,
+        "attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
+        *bias_keys, *mlp_keys,
     )}
     for i in range(config.n_layers):
         p = f"model.layers.{i}."
@@ -82,6 +110,10 @@ def _params_from_sd(model_or_state_dict, config, mlp_keys, mlp_rows) -> Dict:
         per_layer["wv"].append(w(p + "self_attn.v_proj.weight"))
         per_layer["wo"].append(w(p + "self_attn.o_proj.weight"))
         per_layer["mlp_norm"].append(w(p + "post_attention_layernorm.weight", False))
+        if attn_bias:  # Qwen2-family q/k/v biases
+            per_layer["bq"].append(w(p + "self_attn.q_proj.bias", False))
+            per_layer["bk"].append(w(p + "self_attn.k_proj.bias", False))
+            per_layer["bv"].append(w(p + "self_attn.v_proj.bias", False))
         mlp_rows(w, p, per_layer)
 
     embed = _to_np(sd["model.embed_tokens.weight"])
@@ -184,6 +216,8 @@ def load_hf_llama(
         if hf_config.model_type == "mixtral":
             config = mixtral_config_from_hf(hf_config, dtype=dtype)
             return config, mixtral_params_from_hf(model, config)
+        # llama / mistral / qwen2 share the decoder; config_from_hf sets
+        # attn_bias for qwen2 and params_from_hf picks up the bias rows.
         config = config_from_hf(hf_config, dtype=dtype)
         return config, params_from_hf(model, config)
     finally:

@@ -47,6 +47,11 @@ class LlamaConfig:
     rope_theta: float = 500_000.0
     rms_eps: float = 1e-5
     dtype: jnp.dtype = jnp.bfloat16
+    # Qwen2-family: additive q/k/v projection biases (HF `attention_bias`).
+    # Params grow "bq"/"bk"/"bv" per layer; every serving path applies them
+    # via _qv_proj_with_lora/_k_proj, so the flag composes with paging,
+    # LoRA, speculation, and TP unchanged.
+    attn_bias: bool = False
 
     @property
     def q_dim(self) -> int:
@@ -65,7 +70,7 @@ def init_params(config: LlamaConfig, key: jax.Array) -> Params:
 
     def layer_params(k) -> Dict:
         ks = jax.random.split(k, 7)
-        return {
+        p = {
             "attn_norm": jnp.ones((c.d_model,), c.dtype),
             "wq": init(ks[0], (c.d_model, c.q_dim), c.dtype),
             "wk": init(ks[1], (c.d_model, c.kv_dim), c.dtype),
@@ -76,6 +81,11 @@ def init_params(config: LlamaConfig, key: jax.Array) -> Params:
             "w_up": init(ks[5], (c.d_model, c.d_ff), c.dtype),
             "w_down": init(ks[6], (c.d_ff, c.d_model), c.dtype),
         }
+        if c.attn_bias:
+            p["bq"] = jnp.zeros((c.q_dim,), c.dtype)
+            p["bk"] = jnp.zeros((c.kv_dim,), c.dtype)
+            p["bv"] = jnp.zeros((c.kv_dim,), c.dtype)
+        return p
 
     layer_keys = jax.random.split(k_layers, c.n_layers)
     layers = jax.vmap(layer_params)(layer_keys)
@@ -181,9 +191,10 @@ def forward_dense(config: LlamaConfig, params: Params, tokens: jax.Array) -> jax
 
     def layer_fn(x, layer):
         h = rms_norm(x, layer["attn_norm"], c.rms_eps)
-        q = (h @ layer["wq"]).reshape(b, l, c.n_q_heads, c.head_dim)
-        k = (h @ layer["wk"]).reshape(b, l, c.n_kv_heads, c.head_dim)
-        v = (h @ layer["wv"]).reshape(b, l, c.n_kv_heads, c.head_dim)
+        q_flat, v_flat = _qv_proj_with_lora(h, layer, None)
+        q = q_flat.reshape(b, l, c.n_q_heads, c.head_dim)
+        k = _k_proj(layer, h).reshape(b, l, c.n_kv_heads, c.head_dim)
+        v = v_flat.reshape(b, l, c.n_kv_heads, c.head_dim)
         q = _rope(q, positions, c.rope_theta)
         k = _rope(k, positions, c.rope_theta)
         attn = _dense_attention(q, k, v, 0)
@@ -346,8 +357,7 @@ def prefill_cache(
         x, = carry
         layer, cache = inputs["layer"], inputs["cache"]
         h = rms_norm(x, layer["attn_norm"], c.rms_eps)
-        q_flat = h @ layer["wq"]
-        v_flat = h @ layer["wv"]
+        q_flat, v_flat = _qv_proj_with_lora(h, layer, None)
         if lora is not None:
             from llm_d_kv_cache_manager_tpu.models.lora import apply_prefill_delta
 
@@ -355,7 +365,7 @@ def prefill_cache(
             q_flat = q_flat + dq
             v_flat = v_flat + dv
         q = q_flat.reshape(1, l, c.n_q_heads, c.head_dim)
-        k = (h @ layer["wk"]).reshape(1, l, c.n_kv_heads, c.head_dim)
+        k = _k_proj(layer, h).reshape(1, l, c.n_kv_heads, c.head_dim)
         v = v_flat.reshape(1, l, c.n_kv_heads, c.head_dim)
         q = _rope(q, positions, c.rope_theta)
         k = _rope(k, positions, c.rope_theta)
@@ -430,7 +440,7 @@ def _decode_once(
         h = rms_norm(x, layer["attn_norm"], c.rms_eps)
         q_flat, v_flat = _qv_proj_with_lora(h, layer, lora_slice)
         q = q_flat.reshape(b, 1, c.n_q_heads, c.head_dim)
-        k = (h @ layer["wk"]).reshape(b, 1, c.n_kv_heads, c.head_dim)
+        k = _k_proj(layer, h).reshape(b, 1, c.n_kv_heads, c.head_dim)
         v = v_flat.reshape(b, 1, c.n_kv_heads, c.head_dim)
         q = _rope(q, positions, c.rope_theta)
         k = _rope(k, positions, c.rope_theta)
@@ -485,6 +495,9 @@ def _qv_proj_with_lora(h, layer, lora_slice):
     adapter arrays or None."""
     q_flat = h @ layer["wq"]
     v_flat = h @ layer["wv"]
+    if "bq" in layer:  # Qwen2-family attention bias; static dict membership
+        q_flat = q_flat + layer["bq"]
+        v_flat = v_flat + layer["bv"]
     if lora_slice is not None:
         from llm_d_kv_cache_manager_tpu.models.lora import apply_decode_delta
 
@@ -492,6 +505,14 @@ def _qv_proj_with_lora(h, layer, lora_slice):
         q_flat = q_flat + dq
         v_flat = v_flat + dv
     return q_flat, v_flat
+
+
+def _k_proj(layer: Dict, h: jax.Array) -> jax.Array:
+    """K projection with the optional Qwen2-family bias — the one
+    definition every path (dense, prefill, decode, verify, multi-step)
+    uses, so a biased checkpoint can never half-apply its bias."""
+    k = h @ layer["wk"]
+    return k + layer["bk"] if "bk" in layer else k
 
 
 @functools.partial(
@@ -652,7 +673,7 @@ def verify_step_cache(
             h, layer, inputs["lora"] if lora_layers is not None else None
         )
         q = q_flat.reshape(b, s, c.n_q_heads, c.head_dim)
-        k = (h @ layer["wk"]).reshape(b, s, c.n_kv_heads, c.head_dim)
+        k = _k_proj(layer, h).reshape(b, s, c.n_kv_heads, c.head_dim)
         v = v_flat.reshape(b, s, c.n_kv_heads, c.head_dim)
         q = _rope(q, positions, c.rope_theta)
         k = _rope(k, positions, c.rope_theta)

@@ -53,28 +53,41 @@ _LAYER_SPECS: Dict[str, P] = {
 }
 
 
-def param_specs() -> Dict:
-    """PartitionSpec pytree matching models.llama.init_params structure."""
+def param_specs(attn_bias: bool = False) -> Dict:
+    """PartitionSpec pytree matching models.llama.init_params structure.
+    attn_bias adds the Qwen2-family bq/bk/bv rows: each bias lives on its
+    projection's OUTPUT dim, so it shards the same "tp" axis as the
+    column-parallel weight it adds onto ([n_layers, q_dim/kv_dim])."""
+    layers = dict(_LAYER_SPECS)
+    if attn_bias:
+        layers.update({
+            "bq": P(None, "tp"),
+            "bk": P(None, "tp"),
+            "bv": P(None, "tp"),
+        })
     return {
         "embed": P(None, None),  # replicated; activations gather from it
-        "layers": dict(_LAYER_SPECS),
+        "layers": layers,
         "final_norm": P(None),
         "out": P(None, "tp"),  # vocab-parallel logits
     }
 
 
-def param_shardings(mesh: Mesh) -> Dict:
+def param_shardings(mesh: Mesh, attn_bias: bool = False) -> Dict:
     return jax.tree_util.tree_map(
         lambda spec: NamedSharding(mesh, spec),
-        param_specs(),
+        param_specs(attn_bias),
         is_leaf=lambda x: isinstance(x, P),
     )
 
 
 def shard_params(params: Dict, mesh: Mesh) -> Dict:
-    """Place a host-resident param pytree onto the mesh."""
+    """Place a host-resident param pytree onto the mesh. The bias rows'
+    presence is read off the pytree itself so callers never pass a flag
+    the params already encode."""
+    shardings = param_shardings(mesh, attn_bias="bq" in params["layers"])
     return jax.tree_util.tree_map(
-        lambda p, s: jax.device_put(p, s), params, param_shardings(mesh)
+        lambda p, s: jax.device_put(p, s), params, shardings
     )
 
 

@@ -24,7 +24,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 from llm_d_kv_cache_manager_tpu.models.llama import (
     LlamaConfig,
     _dense_attention,
+    _k_proj,
     _mlp,
+    _qv_proj_with_lora,
     _rope,
     rms_norm,
 )
@@ -38,9 +40,10 @@ def _apply_local_layers(config: LlamaConfig, layers: Dict, x: jax.Array) -> jax.
 
     def layer_fn(x, layer):
         h = rms_norm(x, layer["attn_norm"], c.rms_eps)
-        q = (h @ layer["wq"]).reshape(mb, l, c.n_q_heads, c.head_dim)
-        k = (h @ layer["wk"]).reshape(mb, l, c.n_kv_heads, c.head_dim)
-        v = (h @ layer["wv"]).reshape(mb, l, c.n_kv_heads, c.head_dim)
+        q_flat, v_flat = _qv_proj_with_lora(h, layer, None)
+        q = q_flat.reshape(mb, l, c.n_q_heads, c.head_dim)
+        k = _k_proj(layer, h).reshape(mb, l, c.n_kv_heads, c.head_dim)
+        v = v_flat.reshape(mb, l, c.n_kv_heads, c.head_dim)
         q = _rope(q, positions, c.rope_theta)
         k = _rope(k, positions, c.rope_theta)
         attn = _dense_attention(q, k, v, 0)
