@@ -161,6 +161,10 @@ class DeviceFleet:
             return (self.rr - 1) % len(self.pods)
         if self.strategy == "random":
             return self._route_rng.randrange(len(self.pods))
+        if self.strategy != "precise":
+            # Fail loud: an unknown strategy silently measuring the precise
+            # scorer under another label would corrupt the comparison.
+            raise ValueError(f"unknown routing strategy: {self.strategy!r}")
         scores = self.indexer.get_pod_scores(prompt, MODEL, [])
         if not scores:
             self.rr += 1

@@ -133,7 +133,7 @@ def fleet_device_section() -> str:
         "| Hit rate | Output tok/s |",
         "|---|---:|---:|---:|---:|---:|",
     ]
-    for arm in ("precise", "estimated", "random", "round_robin"):
+    for arm in ("precise", "random", "round_robin"):
         if arm not in d:
             continue
         r = d[arm]
