@@ -43,6 +43,13 @@ def config_from_hf(hf_config, dtype=jnp.bfloat16) -> LlamaConfig:
         getattr(hf_config, "attention_bias", False)
         or getattr(hf_config, "model_type", "") == "qwen2"
     )
+    # Mistral sets sliding_window unconditionally; Qwen2 gates it behind
+    # use_sliding_window. Carry the effective value so the engine can
+    # refuse to serve past it (EnginePod fails loud) instead of silently
+    # diverging from the checkpoint's masking.
+    window = getattr(hf_config, "sliding_window", None)
+    if getattr(hf_config, "use_sliding_window", None) is False:
+        window = None
     return LlamaConfig(
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,
@@ -55,6 +62,7 @@ def config_from_hf(hf_config, dtype=jnp.bfloat16) -> LlamaConfig:
         rms_eps=float(hf_config.rms_norm_eps),
         dtype=dtype,
         attn_bias=attn_bias,
+        sliding_window=window,
     )
 
 
@@ -174,6 +182,9 @@ def mixtral_config_from_hf(hf_config, dtype=jnp.bfloat16):
         rope_theta=float(hf_config.rope_theta),
         rms_eps=float(hf_config.rms_norm_eps),
         dtype=dtype,
+        # Early Mixtral-8x7B configs carry sliding_window=4096; the engine
+        # guard (same as the dense family) needs it mapped, not dropped.
+        sliding_window=getattr(hf_config, "sliding_window", None),
     )
 
 

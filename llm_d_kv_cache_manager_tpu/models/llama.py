@@ -52,6 +52,12 @@ class LlamaConfig:
     # via _qv_proj_with_lora/_k_proj, so the flag composes with paging,
     # LoRA, speculation, and TP unchanged.
     attn_bias: bool = False
+    # Mistral/Qwen2 sliding-window attention width (HF `sliding_window`),
+    # carried so the engine can FAIL LOUD when a sequence could exceed it:
+    # attention here is always full-context, so serving past the window
+    # would silently diverge from the checkpoint's training-time masking.
+    # None = full attention. Sequences <= window are exact either way.
+    sliding_window: Optional[int] = None
 
     @property
     def q_dim(self) -> int:

@@ -61,6 +61,10 @@ class MixtralConfig:
     rope_theta: float = 500_000.0
     rms_eps: float = 1e-5
     dtype: jnp.dtype = jnp.bfloat16
+    # Early Mixtral-8x7B configs set sliding_window=4096; attention here is
+    # full-context, so the engine fails loud when a pod could serve past
+    # the window (same guard as the dense family — engine.py).
+    sliding_window: Optional[int] = None
 
     @property
     def q_dim(self) -> int:

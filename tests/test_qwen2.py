@@ -155,6 +155,41 @@ def test_mistral_checkpoint_loads_as_llama_family():
     np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
 
 
+def test_sliding_window_is_carried_and_guarded():
+    """A Mistral checkpoint's sliding_window must not be silently ignored:
+    the config carries it and EnginePod refuses a pod whose max sequence
+    could cross the window (full-context attention would diverge from the
+    checkpoint's training-time masking). Pods capped at/below the window
+    serve exactly."""
+    from transformers import MistralConfig as HFMistralConfig
+
+    hf_cfg = HFMistralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        sliding_window=64,
+    )
+    config = config_from_hf(hf_cfg, dtype=jnp.float32)
+    assert config.sliding_window == 64
+    with pytest.raises(NotImplementedError, match="sliding_window"):
+        EnginePod(
+            EnginePodConfig(
+                n_pages=64, page_size=4, with_model=True,
+                model_config=config, max_pages_per_seq=32,  # 128 > 64
+            ),
+        )
+    # At or below the window the pod is exact full-attention — allowed.
+    pod = EnginePod(
+        EnginePodConfig(
+            n_pages=64, page_size=4, with_model=True,
+            model_config=config, max_pages_per_seq=16,  # 64 <= 64
+        ),
+    )
+    pod.close()
+    # Qwen2 defaults use_sliding_window=False: no window carried.
+    hf_q, _ = _tiny_qwen2()
+    assert config_from_hf(hf_q, dtype=jnp.float32).sliding_window is None
+
+
 @pytest.mark.parametrize("use_quantized_kv", [False, True])
 def test_qwen2_speculative_int8_composes(use_quantized_kv):
     """The bias must compose with the latency lever (speculative decoding)
