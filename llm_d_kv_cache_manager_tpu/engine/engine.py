@@ -280,21 +280,10 @@ class EnginePod:
             # carrying n_experts is the MoE family (models/mixtral.py).
             self._model = llama
             self._model_config = mc
-            window = getattr(mc, "sliding_window", None)
-            if window is not None:
-                # The paged ops attend full-context; a sequence longer than
-                # the checkpoint's sliding window would silently diverge
-                # from its training-time masking (HF Mistral defaults to
-                # window 4096). Cap the pod so it can't happen, loudly.
-                max_seq = config.max_pages_per_seq * config.page_size
-                if max_seq > window:
-                    raise NotImplementedError(
-                        f"model sliding_window={window} < pod max sequence "
-                        f"{max_seq} (max_pages_per_seq*page_size): "
-                        "sliding-window attention is not implemented; "
-                        "lower max_pages_per_seq or serve a full-attention "
-                        "checkpoint"
-                    )
+            # Sliding-window checkpoints (HF Mistral defaults to 4096) are
+            # served exactly: every attention path masks to the window
+            # (models/llama.py _dense_attention + ops paged kernels, which
+            # also skip out-of-window page DMAs in the pipelined variant).
             if config.tp > 1 and llama.is_moe_config(mc):
                 # Reject BEFORE params init / page allocation: a real-size
                 # MoE pod would otherwise build GB-scale expert weights

@@ -44,9 +44,9 @@ def config_from_hf(hf_config, dtype=jnp.bfloat16) -> LlamaConfig:
         or getattr(hf_config, "model_type", "") == "qwen2"
     )
     # Mistral sets sliding_window unconditionally; Qwen2 gates it behind
-    # use_sliding_window. Carry the effective value so the engine can
-    # refuse to serve past it (EnginePod fails loud) instead of silently
-    # diverging from the checkpoint's masking.
+    # use_sliding_window. Carry the effective value: every attention path
+    # masks to it (models/llama.py), so windowed checkpoints serve exactly
+    # at any context length.
     window = getattr(hf_config, "sliding_window", None)
     if getattr(hf_config, "use_sliding_window", None) is False:
         window = None

@@ -52,11 +52,10 @@ class LlamaConfig:
     # via _qv_proj_with_lora/_k_proj, so the flag composes with paging,
     # LoRA, speculation, and TP unchanged.
     attn_bias: bool = False
-    # Mistral/Qwen2 sliding-window attention width (HF `sliding_window`),
-    # carried so the engine can FAIL LOUD when a sequence could exceed it:
-    # attention here is always full-context, so serving past the window
-    # would silently diverge from the checkpoint's training-time masking.
-    # None = full attention. Sequences <= window are exact either way.
+    # Mistral/Qwen2 sliding-window attention width (HF `sliding_window`):
+    # position p attends [p-window+1, p] in every path — dense forward,
+    # chunked prefill, batched/multi-step decode (kernels skip or mask
+    # out-of-window pages), and verify. None = full causal attention.
     sliding_window: Optional[int] = None
 
     @property
@@ -170,6 +169,9 @@ def _dense_attention(
     v: jax.Array,
     causal_offset: jax.Array | int,  # q position i attends k positions <=
     # offset+i; scalar, or [B] for per-sequence offsets (batched verify)
+    window: Optional[int] = None,  # sliding-window width: q position p
+    # additionally attends only k positions > p-window (HF Mistral mask:
+    # [p-window+1, p]); None = full causal
 ) -> jax.Array:
     b, l, n_q, hd = q.shape
     n_kv = k.shape[2]
@@ -182,6 +184,8 @@ def _dense_attention(
     k_pos = jnp.arange(k.shape[1])[None, None, :]
     offset = jnp.broadcast_to(jnp.asarray(causal_offset), (b,))[:, None, None]
     mask = k_pos <= (q_pos + offset)  # [B, L, S]
+    if window is not None:
+        mask = mask & (k_pos > (q_pos + offset - window))
     scores = jnp.where(mask[:, None, None], scores, -jnp.inf)
     weights = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhgls,bshd->blhgd", weights, v.astype(jnp.float32))
@@ -203,7 +207,7 @@ def forward_dense(config: LlamaConfig, params: Params, tokens: jax.Array) -> jax
         v = v_flat.reshape(b, l, c.n_kv_heads, c.head_dim)
         q = _rope(q, positions, c.rope_theta)
         k = _rope(k, positions, c.rope_theta)
-        attn = _dense_attention(q, k, v, 0)
+        attn = _dense_attention(q, k, v, 0, window=c.sliding_window)
         x = x + attn.reshape(b, l, c.q_dim) @ layer["wo"]
         h = rms_norm(x, layer["mlp_norm"], c.rms_eps)
         x = x + _mlp(layer, h)
@@ -301,7 +305,7 @@ def _cache_gather_dense(cache: tuple, block_table, dtype):
 
 
 def _cache_attend(cache: tuple, q, block_tables, seq_lens, use_kernel: bool,
-                  pipelined: bool = False):
+                  pipelined: bool = False, window: Optional[int] = None):
     """Batched decode attention over one layer's cache slice.
 
     `pipelined=True` selects the per-sequence manual-DMA kernel variant (2
@@ -312,9 +316,10 @@ def _cache_attend(cache: tuple, q, block_tables, seq_lens, use_kernel: bool,
     if len(cache) == 2:
         if use_kernel:
             return paged_attention(q, cache[0], cache[1], block_tables,
-                                   seq_lens, pipelined=pipelined)
+                                   seq_lens, pipelined=pipelined,
+                                   window=window)
         return paged_attention_reference(
-            q, cache[0], cache[1], block_tables, seq_lens
+            q, cache[0], cache[1], block_tables, seq_lens, window=window
         )
     from llm_d_kv_cache_manager_tpu.ops.quantized_kv import (
         paged_attention_quantized,
@@ -323,9 +328,12 @@ def _cache_attend(cache: tuple, q, block_tables, seq_lens, use_kernel: bool,
 
     if use_kernel:
         return paged_attention_quantized(
-            q, *cache, block_tables, seq_lens, pipelined=pipelined
+            q, *cache, block_tables, seq_lens, pipelined=pipelined,
+            window=window,
         )
-    return paged_attention_quantized_reference(q, *cache, block_tables, seq_lens)
+    return paged_attention_quantized_reference(
+        q, *cache, block_tables, seq_lens, window=window
+    )
 
 
 @functools.partial(
@@ -380,7 +388,8 @@ def prefill_cache(
 
         # Attend to everything cached so far (prefix + new), causally.
         k_all, v_all = _cache_gather_dense(cache, block_table, c.dtype)
-        attn = _dense_attention(q, k_all, v_all, start_pos)
+        attn = _dense_attention(q, k_all, v_all, start_pos,
+                                window=c.sliding_window)
         x = x + attn.reshape(1, l, c.q_dim) @ layer["wo"]
         h = rms_norm(x, layer["mlp_norm"], c.rms_eps)
         x = x + _mlp_dispatch(c, layer, h)
@@ -474,6 +483,7 @@ def _decode_once(
         attn = _cache_attend(
             tuple(comp[layer_idx] for comp in cache), q[:, 0],
             block_tables, seq_lens + 1, use_kernel, pipelined=pipelined,
+            window=c.sliding_window,
         )
         x = x + attn.reshape(b, 1, c.q_dim) @ layer["wo"]
         h = rms_norm(x, layer["mlp_norm"], c.rms_eps)
@@ -736,7 +746,8 @@ def verify_step_cache(
         v_all = jnp.swapaxes(
             v_all.reshape(b, c.n_kv_heads, max_ctx, c.head_dim), 1, 2
         )
-        attn = _dense_attention(q, k_all, v_all, start_positions)
+        attn = _dense_attention(q, k_all, v_all, start_positions,
+                                window=c.sliding_window)
         x = x + attn.reshape(b, s, c.q_dim) @ layer["wo"]
         h = rms_norm(x, layer["mlp_norm"], c.rms_eps)
         x = x + _mlp_dispatch(c, layer, h)

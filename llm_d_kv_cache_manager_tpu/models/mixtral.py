@@ -61,9 +61,9 @@ class MixtralConfig:
     rope_theta: float = 500_000.0
     rms_eps: float = 1e-5
     dtype: jnp.dtype = jnp.bfloat16
-    # Early Mixtral-8x7B configs set sliding_window=4096; attention here is
-    # full-context, so the engine fails loud when a pod could serve past
-    # the window (same guard as the dense family — engine.py).
+    # Early Mixtral-8x7B configs set sliding_window=4096; attention is
+    # shared with the dense family, so the window masks every path the
+    # same way (models/llama.py). None = full causal attention.
     sliding_window: Optional[int] = None
 
     @property
@@ -209,7 +209,7 @@ def forward_dense(config: MixtralConfig, params: Params, tokens: jax.Array) -> j
         v = (h @ layer["wv"]).reshape(b, l, c.n_kv_heads, c.head_dim)
         q = _rope(q, positions, c.rope_theta)
         k = _rope(k, positions, c.rope_theta)
-        attn = _dense_attention(q, k, v, 0)
+        attn = _dense_attention(q, k, v, 0, window=c.sliding_window)
         x = x + attn.reshape(b, l, c.q_dim) @ layer["wo"]
         h = rms_norm(x, layer["mlp_norm"], c.rms_eps)
         x = x + _moe_mlp(c, layer, h)

@@ -32,6 +32,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _GROUP_PAD = 8  # sublane minimum for f32 tiles
+# Pipelined-kernel buffer ring: depth-1 pages kept in flight. Depth 2 is
+# the device-validated double-buffer; the ring generalizes to deeper
+# lookahead (hides per-descriptor issue latency behind more compute) —
+# bump only after on-chip validation + kernel_bench shows a win.
+_PIPELINE_DEPTH = 2
 
 
 def paged_attention_reference(
@@ -40,6 +45,7 @@ def paged_attention_reference(
     v_pages: jax.Array,  # [n_kv_heads, n_pages, page_size, head_dim]
     block_tables: jax.Array,  # [batch, pages_per_seq] int32
     seq_lens: jax.Array,  # [batch] int32
+    window: "int | None" = None,  # sliding window: attend [len-window, len)
 ) -> jax.Array:
     """Gather-based paged attention; oracle for the Pallas kernel."""
     n_kv_heads, _, page_size, head_dim = k_pages.shape
@@ -59,6 +65,10 @@ def paged_attention_reference(
     max_len = k.shape[2]
     pos = jnp.arange(max_len)[None, None, None, :]
     mask = pos < seq_lens[:, None, None, None]
+    if window is not None:
+        # Decode q sits at position seq_len-1; HF sliding-window semantics
+        # attend [q_pos - window + 1, q_pos] = [seq_len - window, seq_len).
+        mask = mask & (pos >= seq_lens[:, None, None, None] - window)
     scores = jnp.where(mask, scores, -jnp.inf)
     weights = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhgl,bhld->bhgd", weights, v.astype(jnp.float32))
@@ -73,6 +83,7 @@ def _decode_kernel(
     page_size: int,
     scale: float,
     quantized: bool,
+    window: "int | None" = None,
 ):
     """Shared flash-decoding body for bf16 and int8-quantized KV pages."""
     if quantized:
@@ -94,7 +105,14 @@ def _decode_kernel(
         # output block must not be left as uninitialized VMEM garbage.
         o_ref[0, 0] = jnp.zeros_like(o_ref[0, 0])
 
-    @pl.when(start < seq_len)
+    # Sliding window: pages wholly below seq_len - window contribute
+    # nothing — skip their compute (their tile DMA still happens via the
+    # BlockSpec pipeline; the pipelined variant also skips the DMA).
+    live = start < seq_len
+    if window is not None:
+        live = live & (start + page_size > seq_len - window)
+
+    @pl.when(live)
     def _attend():
         q = q_ref[0, 0].astype(jnp.float32)  # (GROUP_PAD, hd)
         if quantized:
@@ -109,7 +127,10 @@ def _decode_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # (GROUP_PAD, page)
         pos = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(pos < seq_len, s, -jnp.inf)
+        valid = pos < seq_len
+        if window is not None:
+            valid = valid & (pos >= seq_len - window)
+        s = jnp.where(valid, s, -jnp.inf)
 
         m_prev = m_scratch[:, :1]  # (GROUP_PAD, 1)
         l_prev = l_scratch[:, :1]
@@ -141,6 +162,7 @@ def _decode_kernel_pipelined(
     page_size: int,
     scale: float,
     quantized: bool,
+    window: "int | None" = None,
 ):
     """Flash-decoding with a manual double-buffered page pipeline.
 
@@ -173,6 +195,7 @@ def _decode_kernel_pipelined(
     n_kv = q_ref.shape[1]
     group_pad = q_ref.shape[2]
     head_dim = q_ref.shape[3]
+    depth = bufs[0].shape[0]  # pipeline slots (= _PIPELINE_DEPTH)
 
     def dmas(slot, idx):
         page = block_tables_ref[b, idx]
@@ -184,19 +207,39 @@ def _decode_kernel_pipelined(
     # Padded batch slots (seq_len == 0) must not emit VMEM garbage.
     o_ref[0] = jnp.zeros_like(o_ref[0])
 
+    # Static bound for the priming loop: pl.when predicates execution but
+    # does NOT remove a constant SMEM index from the traced program, so j
+    # must stay inside the (static) padded table width — short sequences'
+    # tables bucket down to width 1 or 2.
+    table_width = block_tables_ref.shape[1]
+
+    # Sliding window: pages wholly below seq_len - window are never DMAd
+    # nor computed — the loop starts at the first in-window page (the DMA
+    # savings are the point: decode traffic becomes O(window), not O(ctx)).
+    if window is None:
+        first_page = 0
+    else:
+        first_page = jnp.maximum(seq_len - window, 0) // page_size
+
     @pl.when(n_pages > 0)
     def _run():
-        for dma in dmas(0, 0):
-            dma.start()
+        # Fill the pipeline: keep depth-1 pages in flight so per-descriptor
+        # issue latency (the tiled kernel's killer — see module docstring)
+        # overlaps several pages of compute, not just one.
+        for j in range(min(depth - 1, table_width)):
+            @pl.when(first_page + j < n_pages)
+            def _prime(j=j):
+                for dma in dmas((first_page + j) % depth, first_page + j):
+                    dma.start()
         q = q_ref[0].astype(jnp.float32)  # (n_kv, GROUP_PAD, hd)
 
         def body(i, carry):
             m_prev, l_prev, acc = carry
-            slot = i % 2
+            slot = i % depth
 
-            @pl.when(i + 1 < n_pages)
-            def _prefetch_next():
-                for dma in dmas((i + 1) % 2, i + 1):
+            @pl.when(i + depth - 1 < n_pages)
+            def _prefetch_ahead():
+                for dma in dmas((i + depth - 1) % depth, i + depth - 1):
                     dma.start()
 
             for dma in dmas(slot, i):
@@ -215,7 +258,10 @@ def _decode_kernel_pipelined(
                 preferred_element_type=jnp.float32,
             ) * scale  # (n_kv, GROUP_PAD, page)
             pos = i * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
-            s = jnp.where(pos < seq_len, s, -jnp.inf)
+            valid = pos < seq_len
+            if window is not None:
+                valid = valid & (pos >= seq_len - window)
+            s = jnp.where(valid, s, -jnp.inf)
 
             m_cur = jnp.max(s, axis=2, keepdims=True)
             m_new = jnp.maximum(m_prev, m_cur)
@@ -233,7 +279,7 @@ def _decode_kernel_pipelined(
             jnp.zeros((n_kv, group_pad, 1), jnp.float32),
             jnp.zeros((n_kv, group_pad, head_dim), jnp.float32),
         )
-        _, l_final, acc = jax.lax.fori_loop(0, n_pages, body, init)
+        _, l_final, acc = jax.lax.fori_loop(first_page, n_pages, body, init)
         o_ref[0] = (
             acc / jnp.where(l_final == 0, 1.0, l_final)
         ).astype(o_ref.dtype)
@@ -247,6 +293,7 @@ def _paged_attention_call_pipelined(
     *,
     quantized: bool,
     interpret: bool,
+    window: "int | None" = None,
 ) -> jax.Array:
     n_kv_heads, _n_pages, page_size, head_dim = kv_arrays[0].shape
     batch, n_q_heads, _ = q.shape
@@ -267,18 +314,25 @@ def _paged_attention_call_pipelined(
     )
     hbm_spec = pl.BlockSpec(memory_space=pltpu.ANY)
 
-    # One double buffer + DMA sem pair per pipelined array; buffer shapes
-    # mirror each array's per-page slice ((n_kv, page, hd) or (n_kv, page, 1)).
+    # One _PIPELINE_DEPTH-slot buffer ring + DMA sem array per pipelined
+    # array; buffer shapes mirror each array's per-page slice
+    # ((n_kv, page, hd) or (n_kv, page, 1)), keeping depth-1 pages in
+    # flight. VMEM cost: depth × per-array page slice × len(kv_arrays) —
+    # at flagship shapes 128KB per slice, so bf16 K+V cost depth×256KB and
+    # the int8 path's four arrays roughly double that; well inside the
+    # 16MB/core at any plausible depth.
     buf_shapes = [
-        pltpu.VMEM((2, n_kv_heads) + arr.shape[2:], arr.dtype)
+        pltpu.VMEM((_PIPELINE_DEPTH, n_kv_heads) + arr.shape[2:], arr.dtype)
         for arr in kv_arrays
     ]
-    sem_shapes = [pltpu.SemaphoreType.DMA((2,)) for _ in kv_arrays]
+    sem_shapes = [
+        pltpu.SemaphoreType.DMA((_PIPELINE_DEPTH,)) for _ in kv_arrays
+    ]
 
     out = pl.pallas_call(
         functools.partial(
             _decode_kernel_pipelined, page_size=page_size, scale=scale,
-            quantized=quantized,
+            quantized=quantized, window=window,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
@@ -310,6 +364,7 @@ def _paged_attention_call(
     head_dim: int,
     quantized: bool,
     interpret: bool,
+    window: "int | None" = None,
 ) -> jax.Array:
     """Shared pallas_call wiring for both KV storage formats."""
     batch, n_q_heads, _ = q.shape
@@ -340,7 +395,8 @@ def _paged_attention_call(
         else [page_spec, page_spec]
     )
     kernel = functools.partial(
-        _decode_kernel, page_size=page_size, scale=scale, quantized=quantized
+        _decode_kernel, page_size=page_size, scale=scale,
+        quantized=quantized, window=window,
     )
 
     out = pl.pallas_call(
@@ -370,7 +426,9 @@ def _paged_attention_call(
     return out[:, :, :group, :].reshape(batch, n_q_heads, head_dim)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "pipelined"))
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "pipelined", "window")
+)
 def paged_attention(
     q: jax.Array,  # [batch, n_q_heads, head_dim]
     k_pages: jax.Array,  # [n_kv_heads, n_pages, page_size, head_dim]
@@ -380,6 +438,7 @@ def paged_attention(
     *,
     interpret: bool = False,
     pipelined: bool = False,
+    window: "int | None" = None,
 ) -> jax.Array:
     """Flash-decoding paged attention (Pallas TPU kernel).
 
@@ -400,7 +459,7 @@ def paged_attention(
     if pipelined:
         return _paged_attention_call_pipelined(
             q, (k_pages, v_pages), block_tables, seq_lens,
-            quantized=False, interpret=interpret,
+            quantized=False, interpret=interpret, window=window,
         )
     return _paged_attention_call(
         q,
@@ -412,6 +471,7 @@ def paged_attention(
         head_dim=head_dim,
         quantized=False,
         interpret=interpret,
+        window=window,
     )
 
 

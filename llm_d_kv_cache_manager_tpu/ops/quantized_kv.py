@@ -72,7 +72,7 @@ def write_kv_pages_quantized(
 
 
 def paged_attention_quantized_reference(
-    q, k_q, k_scale, v_q, v_scale, block_tables, seq_lens
+    q, k_q, k_scale, v_q, v_scale, block_tables, seq_lens, window=None
 ):
     """Oracle: dequantize everything, then run the f32 gather attention."""
     from llm_d_kv_cache_manager_tpu.ops.paged_attention import (
@@ -82,11 +82,14 @@ def paged_attention_quantized_reference(
     k_pages = k_q.astype(jnp.float32) * k_scale
     v_pages = v_q.astype(jnp.float32) * v_scale
     return paged_attention_reference(
-        q, k_pages.astype(q.dtype), v_pages.astype(q.dtype), block_tables, seq_lens
+        q, k_pages.astype(q.dtype), v_pages.astype(q.dtype), block_tables,
+        seq_lens, window=window,
     )
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "pipelined"))
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "pipelined", "window")
+)
 def paged_attention_quantized(
     q: jax.Array,  # [batch, n_q_heads, head_dim]
     k_q: jax.Array,  # [n_kv, n_pages, page, hd] int8
@@ -98,6 +101,7 @@ def paged_attention_quantized(
     *,
     interpret: bool = False,
     pipelined: bool = False,
+    window: "int | None" = None,
 ) -> jax.Array:
     """Flash-decoding over int8 KV pages with in-VMEM dequantization.
 
@@ -116,7 +120,7 @@ def paged_attention_quantized(
     if pipelined:
         return _paged_attention_call_pipelined(
             q, (k_q, k_scale, v_q, v_scale), block_tables, seq_lens,
-            quantized=True, interpret=interpret,
+            quantized=True, interpret=interpret, window=window,
         )
     n_kv_heads, _n_pages, page_size, head_dim = k_q.shape
     return _paged_attention_call(
@@ -129,4 +133,5 @@ def paged_attention_quantized(
         head_dim=head_dim,
         quantized=True,
         interpret=interpret,
+        window=window,
     )

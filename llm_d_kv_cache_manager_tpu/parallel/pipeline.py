@@ -46,7 +46,7 @@ def _apply_local_layers(config: LlamaConfig, layers: Dict, x: jax.Array) -> jax.
         v = v_flat.reshape(mb, l, c.n_kv_heads, c.head_dim)
         q = _rope(q, positions, c.rope_theta)
         k = _rope(k, positions, c.rope_theta)
-        attn = _dense_attention(q, k, v, 0)
+        attn = _dense_attention(q, k, v, 0, window=c.sliding_window)
         x = x + attn.reshape(mb, l, c.q_dim) @ layer["wo"]
         h = rms_norm(x, layer["mlp_norm"], c.rms_eps)
         x = x + _mlp(layer, h)

@@ -120,3 +120,39 @@ class TestPipelinedVariant:
             q, kp, vp, bt, seq_lens, interpret=True, pipelined=True
         )
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=5e-3)
+
+    @pytest.mark.parametrize("window", [64, 128, 200])
+    def test_sliding_window_matches_oracle(self, window):
+        """Windowed decode: both kernel variants vs the gather oracle, and
+        the window must be load-bearing (differ from full attention). The
+        pipelined variant additionally starts its page loop at the first
+        in-window page — cross-checking it against the masked oracle pins
+        that the skipped pages truly contribute nothing."""
+        q, kp, vp, bt = _setup()
+        seq_lens = jnp.array([37, 300], jnp.int32)
+        ref = paged_attention_reference(q, kp, vp, bt, seq_lens, window=window)
+        full = paged_attention_reference(q, kp, vp, bt, seq_lens)
+        assert float(jnp.max(jnp.abs(ref - full))) > 1e-3  # load-bearing
+        for pipelined in (False, True):
+            out = paged_attention(
+                q, kp, vp, bt, seq_lens, interpret=True,
+                pipelined=pipelined, window=window,
+            )
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), atol=5e-3
+            )
+
+    @pytest.mark.parametrize("pps", [1, 2])
+    def test_table_narrower_than_pipeline_depth(self, pps):
+        """Padded block tables bucket down to width 1-2 for short
+        sequences; the priming loop's STATIC indices must stay inside that
+        width at ANY _PIPELINE_DEPTH (pl.when predicates execution, it
+        does not remove a traced constant SMEM access — a ring deeper
+        than the table would naively prime out-of-bounds j)."""
+        q, kp, vp, bt = _setup(pps=pps)
+        seq_lens = jnp.array([1, pps * 128], jnp.int32)
+        ref = paged_attention_reference(q, kp, vp, bt, seq_lens)
+        out = paged_attention(
+            q, kp, vp, bt, seq_lens, interpret=True, pipelined=True
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=5e-3)

@@ -155,36 +155,80 @@ def test_mistral_checkpoint_loads_as_llama_family():
     np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
 
 
-def test_sliding_window_is_carried_and_guarded():
-    """A Mistral checkpoint's sliding_window must not be silently ignored:
-    the config carries it and EnginePod refuses a pod whose max sequence
-    could cross the window (full-context attention would diverge from the
-    checkpoint's training-time masking). Pods capped at/below the window
-    serve exactly."""
+def _tiny_mistral_swa(window):
     from transformers import MistralConfig as HFMistralConfig
+    from transformers import MistralForCausalLM
 
     hf_cfg = HFMistralConfig(
         vocab_size=256, hidden_size=64, intermediate_size=128,
         num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
-        sliding_window=64,
+        max_position_embeddings=256, rope_theta=10000.0, rms_norm_eps=1e-5,
+        sliding_window=window, attn_implementation="eager",
     )
+    torch.manual_seed(5)
+    return hf_cfg, MistralForCausalLM(hf_cfg).eval()
+
+
+def test_sliding_window_forward_matches_transformers():
+    """Sliding-window masking against HF's own implementation: a 20-token
+    prompt with window 8 — beyond-window positions MUST differ from full
+    attention (the probe) and match HF exactly."""
+    hf_cfg, model = _tiny_mistral_swa(window=8)
     config = config_from_hf(hf_cfg, dtype=jnp.float32)
-    assert config.sliding_window == 64
-    with pytest.raises(NotImplementedError, match="sliding_window"):
-        EnginePod(
-            EnginePodConfig(
-                n_pages=64, page_size=4, with_model=True,
-                model_config=config, max_pages_per_seq=32,  # 128 > 64
-            ),
-        )
-    # At or below the window the pod is exact full-attention — allowed.
+    assert config.sliding_window == 8
+    params = params_from_hf(model, config)
+    tokens = np.arange(20, dtype=np.int64)[None] % 256
+    with torch.no_grad():
+        hf_logits = model(torch.from_numpy(tokens)).logits.numpy()
+    ours = np.asarray(
+        llama.forward_dense(config, params, jnp.asarray(tokens, jnp.int32))
+    )
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
+    # The window must be load-bearing: full attention on the same weights
+    # diverges at positions >= window.
+    import dataclasses
+
+    full = np.asarray(llama.forward_dense(
+        dataclasses.replace(config, sliding_window=None), params,
+        jnp.asarray(tokens, jnp.int32),
+    ))
+    assert np.abs(full[0, 8:] - hf_logits[0, 8:]).max() > 1e-3
+
+
+@pytest.mark.parametrize("decode_steps", [1, 4])
+def test_sliding_window_paged_serving_matches_hf_greedy(decode_steps):
+    """The full paged stack — chunked prefill past the window, batched and
+    multi-step decode — must emit HF's greedy continuation for a windowed
+    checkpoint whose prompt is LONGER than the window."""
+    hf_cfg, model = _tiny_mistral_swa(window=8)
+    config = config_from_hf(hf_cfg, dtype=jnp.float32)
+    params = params_from_hf(model, config)
+
+    prompt = list(range(3, 23))  # 20 tokens > window 8
+    n_new = 8
+    with torch.no_grad():
+        hf_out = model.generate(
+            torch.tensor([prompt]), max_new_tokens=n_new, do_sample=False,
+            pad_token_id=0,
+        )[0, len(prompt):].tolist()
+
     pod = EnginePod(
         EnginePodConfig(
-            n_pages=64, page_size=4, with_model=True,
-            model_config=config, max_pages_per_seq=16,  # 64 <= 64
+            n_pages=64, page_size=4, with_model=True, model_config=config,
+            max_pages_per_seq=16,
         ),
+        params=params,
     )
-    pod.close()
+    try:
+        sched = Scheduler(pod, max_batch=2, decode_steps=decode_steps,
+                          prefill_token_budget=8)
+        rid = sched.submit(prompt, max_new_tokens=n_new)
+        assert sched.run()[rid] == hf_out
+    finally:
+        pod.close()
+
+
+def test_qwen2_window_gate_respected():
     # Qwen2 defaults use_sliding_window=False: no window carried.
     hf_q, _ = _tiny_qwen2()
     assert config_from_hf(hf_q, dtype=jnp.float32).sliding_window is None
