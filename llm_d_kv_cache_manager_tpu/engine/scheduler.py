@@ -19,7 +19,10 @@ Capacity policy:
   waiting queue with its generated tokens folded into the prompt, and the
   re-prefill mostly hits the cache.
 
-Greedy decoding; sequences finish on max_new_tokens or EOS.
+Token selection: greedy argmax by default; per-request SamplingParams
+(temperature/top-k/top-p/seed) sample on device with per-position PRNG
+keys, so output is reproducible and independent of decode_steps and batch
+composition. Sequences finish on max_new_tokens or EOS.
 """
 
 from __future__ import annotations
@@ -35,6 +38,11 @@ from llm_d_kv_cache_manager_tpu.engine.block_manager import (
     SequenceState,
 )
 from llm_d_kv_cache_manager_tpu.engine.engine import EnginePod
+from llm_d_kv_cache_manager_tpu.ops.sampling import (
+    SamplingParams,
+    position_keys,
+    sample_tokens,
+)
 
 
 @dataclass
@@ -44,6 +52,11 @@ class Request:
     max_new_tokens: int
     eos_token: Optional[int] = None
     lora_id: Optional[int] = None
+    # None or greedy params => argmax. Sampled requests draw from
+    # fold_in(PRNGKey(seed or req_id), position) per emitted position —
+    # reproducible and identical across decode_steps settings
+    # (ops/sampling.py).
+    sampling: Optional["SamplingParams"] = None
     # Filled by the scheduler:
     state: Optional[SequenceState] = None
     generated: List[int] = field(default_factory=list)
@@ -96,9 +109,10 @@ class Scheduler:
         max_new_tokens: int = 16,
         eos_token: Optional[int] = None,
         lora_id: Optional[int] = None,
+        sampling: Optional[SamplingParams] = None,
     ) -> int:
         req = Request(self._next_id, list(prompt_tokens), max_new_tokens,
-                      eos_token, lora_id)
+                      eos_token, lora_id, sampling=sampling)
         self._next_id += 1
 
         error = self._validate(req)
@@ -248,7 +262,18 @@ class Scheduler:
         first_tokens = {}
         if completed:
             stacked = jnp.stack([logits_by_req[id(r)] for r in completed])
-            toks = np.asarray(jnp.argmax(stacked, axis=-1))
+            sarr = self._sampling_arrays(completed, len(completed))
+            if sarr is None:
+                toks = np.asarray(jnp.argmax(stacked, axis=-1))
+            else:
+                pos = jnp.asarray(
+                    [len(r.state.tokens) - 1 for r in completed],
+                    dtype=jnp.int32,
+                )
+                toks = np.asarray(sample_tokens(
+                    stacked, sarr[0], sarr[1], sarr[2],
+                    position_keys(sarr[3], pos),
+                ))
             first_tokens = {id(r): int(t) for r, t in zip(completed, toks)}
         for req in completed:
             self.pod.finish_prefill(req.state)
@@ -301,6 +326,45 @@ class Scheduler:
             positions[i] = len(req.state.tokens) - 1
         return tables, tokens, positions
 
+    def _sampling_arrays(self, reqs: List[Request], padded_len: int):
+        """None when every request is greedy (the common case keeps its
+        argmax trace); otherwise (temps, top_ks, top_ps, base_keys) padded
+        to `padded_len` with greedy pad rows. Base keys come from the
+        request seed (default: req_id), so a run is reproducible and a
+        request's draws don't depend on what it was batched with.
+
+        Cached per (request-set, padded_len): the arrays change only when
+        the batch's request set does, and a sampled decode tick must not
+        pay a host rebuild + four uploads per emitted token."""
+        if all(r.sampling is None or r.sampling.is_greedy for r in reqs):
+            return None
+        sig = (tuple((r.req_id, r.sampling) for r in reqs), padded_len)
+        cached = getattr(self, "_sampling_cache", None)
+        if cached is not None and cached[0] == sig:
+            return cached[1]
+        import jax
+
+        jnp = self.pod._jnp
+        temps = np.zeros((padded_len,), np.float32)
+        top_ks = np.zeros((padded_len,), np.int32)
+        top_ps = np.ones((padded_len,), np.float32)
+        keys = [jax.random.PRNGKey(0)] * padded_len
+        for i, r in enumerate(reqs):
+            sp = r.sampling
+            if sp is not None and not sp.is_greedy:
+                temps[i] = sp.temperature
+                top_ks[i] = sp.top_k
+                top_ps[i] = sp.top_p
+                keys[i] = jax.random.PRNGKey(
+                    sp.seed if sp.seed is not None else r.req_id
+                )
+        arrays = (
+            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
+            jnp.stack(keys),
+        )
+        self._sampling_cache = (sig, arrays)
+        return arrays
+
     def _decode(self) -> List[Request]:
         if not self._running:
             return []
@@ -322,7 +386,14 @@ class Scheduler:
             self.pod.config.use_kernel,
             lora=self.pod.lora_for_decode(lora_ids),
         )
-        next_tokens = np.asarray(jnp.argmax(logits, axis=-1))
+        sarr = self._sampling_arrays(self._running, len(tokens))
+        if sarr is None:
+            next_tokens = np.asarray(jnp.argmax(logits, axis=-1))
+        else:
+            next_tokens = np.asarray(sample_tokens(
+                logits, sarr[0], sarr[1], sarr[2],
+                position_keys(sarr[3], jnp.asarray(positions)),
+            ))
 
         # Every running sequence's pending token just had its KV row
         # written: commit pages that row completed (this is the only point
@@ -404,6 +475,7 @@ class Scheduler:
             n,
             pod.config.use_kernel,
             lora=pod.lora_for_decode(lora_ids),
+            sampling=self._sampling_arrays(running, len(tokens)),
         )
         toks = np.asarray(toks)  # [B, n]
 

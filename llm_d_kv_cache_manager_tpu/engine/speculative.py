@@ -298,11 +298,22 @@ class SpeculativeScheduler:
     # -- public API mirroring Scheduler ------------------------------------
 
     def submit(self, prompt_tokens, max_new_tokens=16, eos_token=None,
-               lora_id=None):
+               lora_id=None, sampling=None):
         """LoRA requests speculate too: the TARGET verifies with the
         sequence's adapter (verify_step_cache lora), so emitted tokens are
         exactly adapter-greedy; the draft proposes with its base weights —
-        adapter drift only lowers acceptance, never correctness."""
+        adapter drift only lowers acceptance, never correctness.
+
+        Sampling is greedy-only here: speculative SAMPLING needs the
+        rejection-sampling acceptance rule (accept with p_target/p_draft)
+        to preserve the target distribution — not implemented. Fail loud
+        rather than silently emit the wrong distribution."""
+        if sampling is not None and not sampling.is_greedy:
+            raise NotImplementedError(
+                "speculative decoding is greedy-only: sampled requests "
+                "need distribution-preserving rejection sampling — submit "
+                "them to a plain Scheduler"
+            )
         return self.inner.submit(prompt_tokens, max_new_tokens, eos_token,
                                  lora_id=lora_id)
 

@@ -50,6 +50,20 @@ def config_from_hf(hf_config, dtype=jnp.bfloat16) -> LlamaConfig:
     window = getattr(hf_config, "sliding_window", None)
     if getattr(hf_config, "use_sliding_window", None) is False:
         window = None
+    if window is not None and getattr(hf_config, "use_sliding_window", None):
+        # Qwen2's max_window_layers serves the FIRST mwl layers with full
+        # attention and only the rest with the window; the engine's window
+        # is uniform across layers. All-full (mwl >= n_layers) maps to no
+        # window; all-sliding (mwl == 0) maps to the uniform window; a mix
+        # would silently diverge from HF — refuse it.
+        mwl = getattr(hf_config, "max_window_layers", 0) or 0
+        if mwl >= hf_config.num_hidden_layers:
+            window = None
+        elif mwl > 0:
+            raise NotImplementedError(
+                f"max_window_layers={mwl} mixes full- and sliding-window "
+                "layers; per-layer windows are not implemented"
+            )
     return LlamaConfig(
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,

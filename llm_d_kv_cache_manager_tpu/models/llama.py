@@ -582,9 +582,13 @@ def decode_multi_step_cache(
     n_steps: int,
     use_kernel: bool = False,
     lora=None,
+    sampling=None,  # (temps [B], top_ks [B], top_ps [B], base_keys [B])
+    # or None for greedy; keys are folded per in-loop position so output
+    # is IDENTICAL to single-step sampling (ops/sampling.py)
 ) -> Tuple[tuple, jax.Array]:
     """N decode steps in ONE dispatch: `lax.scan` over the single-step body
-    with on-device greedy argmax feeding the next step and the page-table
+    with on-device token selection (greedy argmax, or filtered sampling
+    when `sampling` is given) feeding the next step and the page-table
     walk advancing inside the loop. Returns (kv_cache, tokens_out [B, N]) —
     tokens_out[:, j] is the token sampled at step j.
 
@@ -624,7 +628,18 @@ def decode_multi_step_cache(
             c, params, cache, tok, block_tables, lens,
             use_kernel, lora_layers, pages, slots,
         )
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if sampling is None:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            from llm_d_kv_cache_manager_tpu.ops.sampling import (
+                position_keys,
+                sample_tokens,
+            )
+
+            temps, top_ks, top_ps, base_keys = sampling
+            nxt = sample_tokens(
+                logits, temps, top_ks, top_ps, position_keys(base_keys, lens)
+            )
         return (cache, nxt, lens + 1), nxt
 
     (kv_cache, _, _), toks = jax.lax.scan(

@@ -1,0 +1,111 @@
+"""On-device token sampling: temperature / top-k / top-p with per-sequence
+PRNG keys.
+
+The reference's engines (vLLM) sample on the accelerator; this is the TPU
+equivalent for the paged engine. Design constraints, in order:
+
+- **Deterministic and chunking-invariant.** A sequence's randomness comes
+  from `fold_in(base_key, position)` — one key per emitted position — so
+  the SAME tokens come out whether the engine runs single-step decode,
+  an N-step on-device loop, or any mix (the multi-step scan folds at its
+  in-loop position). Batch composition can't perturb it either: keys are
+  per sequence, never derived from batch indices.
+- **Rectangular and jit-friendly.** All filters are batched array math
+  over [B, vocab] logits; per-sequence temperature 0 rows fall back to
+  argmax inside the same dispatch, so a batch can mix greedy and sampled
+  traffic exactly like it mixes LoRA adapters.
+- **vLLM-style filter order**: temperature scales logits, top-k keeps the
+  k highest, top-p keeps the smallest prefix of the sorted distribution
+  with cumulative probability >= top_p (the highest-probability token is
+  always kept). Sampling is the Gumbel-argmax trick — no cumsum search
+  on the sampling path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling controls. Defaults mean greedy decoding.
+
+    temperature: 0 => argmax (greedy). > 0 => softmax sampling.
+    top_k: keep only the k highest-logit tokens (0 => no top-k filter).
+    top_p: nucleus filter — keep the smallest sorted prefix reaching
+        cumulative probability top_p (1.0 => no filter).
+    seed: base PRNG seed for this request. None => the engine derives one
+        (scheduler uses the request id), so runs stay reproducible.
+    """
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: Optional[int] = None
+
+    @property
+    def is_greedy(self) -> bool:
+        return self.temperature <= 0.0
+
+
+def position_keys(base_keys: jax.Array, positions: jax.Array) -> jax.Array:
+    """One key per (sequence, position): fold each sequence's base key with
+    the absolute position being sampled. base_keys [B] PRNG keys (uint32
+    key-array), positions [B] int32."""
+    return jax.vmap(jax.random.fold_in)(base_keys, positions)
+
+
+@jax.jit
+def sample_tokens(
+    logits: jax.Array,  # [B, vocab]
+    temps: jax.Array,  # [B] f32; <= 0 selects greedy for that row
+    top_ks: jax.Array,  # [B] int32; 0 = no top-k
+    top_ps: jax.Array,  # [B] f32; 1.0 = no top-p, 0 clamps to ~greedy
+    keys: jax.Array,  # [B] PRNG keys (already position-folded)
+) -> jax.Array:
+    """Batched filtered sampling; returns [B] int32 token ids. Jitted: a
+    sampled decode tick is ONE dispatch, not a chain of eager ops."""
+    vocab = logits.shape[-1]
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # Temperature scaling (guarded for the greedy rows, which ignore it).
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+
+    sorted_desc = -jnp.sort(-scaled, axis=-1)  # [B, V] descending
+    # Top-k: keep logits >= the k-th largest (ties at the boundary all
+    # survive — same choice vLLM makes).
+    k_eff = jnp.where(top_ks > 0, top_ks, vocab)
+    kth = jnp.take_along_axis(
+        sorted_desc, jnp.clip(k_eff - 1, 0, vocab - 1)[:, None], axis=-1
+    )
+    filtered = jnp.where(scaled >= kth, scaled, -jnp.inf)
+    # Top-k filtering preserves descending order, so the sorted view of
+    # `filtered` is derivable without a second O(V log V) sort.
+    sorted_f = jnp.where(sorted_desc >= kth, sorted_desc, -jnp.inf)
+
+    # Top-p over the (already top-k-filtered) distribution: a sorted token
+    # survives while the cumulative probability BEFORE it is < top_p, so
+    # the first token always survives and the kept set is the smallest
+    # prefix reaching top_p. top_p is clamped away from 0 — 0 would empty
+    # the kept set (every draw would degenerate to token id 0); 1e-6 keeps
+    # exactly the argmax, matching the "top_p→0 is greedy" convention.
+    top_ps = jnp.maximum(top_ps, 1e-6)
+    probs_sorted = jax.nn.softmax(sorted_f, axis=-1)
+    cum_before = jnp.cumsum(probs_sorted, axis=-1) - probs_sorted
+    keep_sorted = cum_before < top_ps[:, None]
+    # Smallest kept logit per row bounds the kept set in unsorted order.
+    min_kept = jnp.min(
+        jnp.where(keep_sorted, sorted_f, jnp.inf), axis=-1, keepdims=True
+    )
+    filtered = jnp.where(filtered >= min_kept, filtered, -jnp.inf)
+
+    gumbel = jax.vmap(lambda k: jax.random.gumbel(k, (vocab,), jnp.float32))(
+        keys
+    )
+    sampled = jnp.argmax(filtered + gumbel, axis=-1).astype(jnp.int32)
+    return jnp.where(temps <= 0.0, greedy, sampled)

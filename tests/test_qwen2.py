@@ -234,6 +234,25 @@ def test_qwen2_window_gate_respected():
     assert config_from_hf(hf_q, dtype=jnp.float32).sliding_window is None
 
 
+def test_qwen2_max_window_layers_cases():
+    """HF serves the FIRST max_window_layers layers with full attention;
+    the engine's window is uniform. All-full maps to no window, all-sliding
+    maps to the uniform window, a mix must refuse instead of silently
+    diverging from HF."""
+    def cfg(mwl):
+        return HFQwen2Config(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=4, num_attention_heads=4,
+            num_key_value_heads=2, use_sliding_window=True,
+            sliding_window=32, max_window_layers=mwl,
+        )
+
+    assert config_from_hf(cfg(4), dtype=jnp.float32).sliding_window is None
+    assert config_from_hf(cfg(0), dtype=jnp.float32).sliding_window == 32
+    with pytest.raises(NotImplementedError, match="max_window_layers"):
+        config_from_hf(cfg(2), dtype=jnp.float32)
+
+
 @pytest.mark.parametrize("use_quantized_kv", [False, True])
 def test_qwen2_speculative_int8_composes(use_quantized_kv):
     """The bias must compose with the latency lever (speculative decoding)

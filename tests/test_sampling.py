@@ -1,0 +1,242 @@
+"""On-device sampling: determinism, chunking-invariance, and filter
+semantics.
+
+The contract (ops/sampling.py): per-request randomness comes from
+fold_in(base_key, position), so a request's output is identical across
+decode_steps settings, batch compositions, and reruns — and temperature 0
+(or top_k=1, or top_p→0) degenerates to exactly the greedy path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_d_kv_cache_manager_tpu.engine.engine import EnginePod, EnginePodConfig
+from llm_d_kv_cache_manager_tpu.engine.scheduler import Scheduler
+from llm_d_kv_cache_manager_tpu.models import llama
+from llm_d_kv_cache_manager_tpu.models.llama import LlamaConfig
+from llm_d_kv_cache_manager_tpu.ops.sampling import (
+    SamplingParams,
+    position_keys,
+    sample_tokens,
+)
+
+CFG = LlamaConfig(
+    vocab_size=128, d_model=32, n_layers=2, n_q_heads=2, n_kv_heads=2,
+    head_dim=16, d_ff=64, dtype=jnp.float32,
+)
+PARAMS = llama.init_params(CFG, jax.random.PRNGKey(0))
+PROMPT = [3, 17, 99, 4, 250 % 128, 7]
+
+
+def _pod():
+    return EnginePod(
+        EnginePodConfig(n_pages=64, page_size=4, with_model=True,
+                        model_config=CFG, max_pages_per_seq=16),
+        params=PARAMS,
+    )
+
+
+def _generate(sampling, decode_steps=1, n_new=12, prompt=None):
+    pod = _pod()
+    try:
+        sched = Scheduler(pod, max_batch=2, decode_steps=decode_steps)
+        rid = sched.submit(list(prompt or PROMPT), max_new_tokens=n_new,
+                           sampling=sampling)
+        return sched.run()[rid]
+    finally:
+        pod.close()
+
+
+class TestSampleTokensUnit:
+    """Direct unit semantics of the batched filter/sampling op."""
+
+    def _logits(self, batch=4, vocab=64, seed=1):
+        return jax.random.normal(jax.random.PRNGKey(seed), (batch, vocab)) * 3
+
+    def test_temperature_zero_is_argmax(self):
+        logits = self._logits()
+        keys = position_keys(
+            jnp.stack([jax.random.PRNGKey(i) for i in range(4)]),
+            jnp.arange(4),
+        )
+        out = sample_tokens(
+            logits, jnp.zeros(4), jnp.zeros(4, jnp.int32), jnp.ones(4), keys
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out), np.asarray(jnp.argmax(logits, -1))
+        )
+
+    def test_top_k_one_is_argmax_at_any_temperature(self):
+        logits = self._logits()
+        keys = position_keys(
+            jnp.stack([jax.random.PRNGKey(i) for i in range(4)]),
+            jnp.arange(4),
+        )
+        out = sample_tokens(
+            logits, jnp.full(4, 5.0), jnp.ones(4, jnp.int32), jnp.ones(4),
+            keys,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out), np.asarray(jnp.argmax(logits, -1))
+        )
+
+    def test_tiny_top_p_is_argmax(self):
+        logits = self._logits()
+        keys = position_keys(
+            jnp.stack([jax.random.PRNGKey(i) for i in range(4)]),
+            jnp.arange(4),
+        )
+        out = sample_tokens(
+            logits, jnp.full(4, 3.0), jnp.zeros(4, jnp.int32),
+            jnp.full(4, 1e-6), keys,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out), np.asarray(jnp.argmax(logits, -1))
+        )
+
+    def test_top_p_zero_is_argmax_not_token_zero(self):
+        """top_p=0 must clamp to greedy — an empty kept set would make
+        argmax over all -inf emit token id 0 for every draw."""
+        logits = self._logits()
+        keys = position_keys(
+            jnp.stack([jax.random.PRNGKey(i) for i in range(4)]),
+            jnp.arange(4),
+        )
+        out = sample_tokens(
+            logits, jnp.full(4, 2.0), jnp.zeros(4, jnp.int32),
+            jnp.zeros(4), keys,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out), np.asarray(jnp.argmax(logits, -1))
+        )
+
+    def test_top_k_restricts_support(self):
+        """1000 draws at high temperature never leave the top-k set."""
+        vocab = 32
+        logits = jax.random.normal(jax.random.PRNGKey(2), (1, vocab))
+        top5 = set(np.asarray(jnp.argsort(-logits[0])[:5]).tolist())
+        base = jnp.stack([jax.random.PRNGKey(9)])
+        seen = set()
+        for pos in range(1000):
+            out = sample_tokens(
+                jnp.tile(logits, (1, 1)), jnp.full(1, 10.0),
+                jnp.full(1, 5, jnp.int32), jnp.ones(1),
+                position_keys(base, jnp.array([pos])),
+            )
+            seen.add(int(out[0]))
+        assert seen <= top5
+        assert len(seen) > 1  # actually random, not degenerate
+
+    def test_rows_are_independent(self):
+        """A row's draw depends only on its own key, not batch contents."""
+        logits = self._logits(batch=3)
+        keys = position_keys(
+            jnp.stack([jax.random.PRNGKey(i) for i in range(3)]),
+            jnp.array([7, 7, 7]),
+        )
+        full = sample_tokens(
+            logits, jnp.full(3, 2.0), jnp.zeros(3, jnp.int32),
+            jnp.full(3, 0.9), keys,
+        )
+        solo = sample_tokens(
+            logits[1:2], jnp.full(1, 2.0), jnp.zeros(1, jnp.int32),
+            jnp.full(1, 0.9), keys[1:2],
+        )
+        assert int(full[1]) == int(solo[0])
+
+
+class TestServingSampling:
+    def test_greedy_default_unchanged(self):
+        assert _generate(None) == _generate(SamplingParams())
+
+    def test_seeded_runs_reproduce(self):
+        sp = SamplingParams(temperature=1.0, top_k=20, seed=42)
+        assert _generate(sp) == _generate(sp)
+
+    def test_decode_steps_invariant(self):
+        """The multi-step on-device loop must sample the SAME sequence as
+        single-step decode — per-position keys make chunking invisible."""
+        sp = SamplingParams(temperature=1.0, top_k=20, seed=7)
+        assert _generate(sp, decode_steps=1) == _generate(sp, decode_steps=4)
+
+    def test_seeds_differentiate(self):
+        outs = {
+            tuple(_generate(SamplingParams(temperature=2.0, seed=s)))
+            for s in range(5)
+        }
+        assert len(outs) > 1
+
+    def test_sampled_differs_from_greedy_sometimes(self):
+        greedy = _generate(None)
+        outs = [
+            _generate(SamplingParams(temperature=3.0, seed=s))
+            for s in range(4)
+        ]
+        assert any(o != greedy for o in outs)
+
+    def test_mixed_batch_greedy_row_unperturbed(self):
+        """Greedy and sampled requests in one batch: the greedy request's
+        output must equal its solo-run output."""
+        pod = _pod()
+        try:
+            sched = Scheduler(pod, max_batch=4, decode_steps=2)
+            rid_g = sched.submit(list(PROMPT), max_new_tokens=10)
+            rid_s = sched.submit(
+                [5, 9, 2, 44], max_new_tokens=10,
+                sampling=SamplingParams(temperature=1.5, seed=3),
+            )
+            results = sched.run()
+        finally:
+            pod.close()
+        assert results[rid_g] == _generate(None, n_new=10)
+        assert len(results[rid_s]) == 10
+
+    def test_preemption_does_not_change_sampled_output(self):
+        """Position-keyed sampling + deterministic recompute: a preempted
+        sampled request resumes mid-stream with identical output (tokens at
+        already-sampled positions fold into the prompt; later positions
+        draw the same keys)."""
+        sp = SamplingParams(temperature=1.0, top_k=30, seed=11)
+        reference = _generate(sp, n_new=10)
+        # Tiny pool forces decode-time preemption of one of two requests.
+        pod = EnginePod(
+            EnginePodConfig(n_pages=10, page_size=4, with_model=True,
+                            model_config=CFG, max_pages_per_seq=8),
+            params=PARAMS,
+        )
+        try:
+            sched = Scheduler(pod, max_batch=2)
+            rid = sched.submit(list(PROMPT), max_new_tokens=10, sampling=sp)
+            other = sched.submit([8, 1, 60], max_new_tokens=10)
+            results = sched.run()
+        finally:
+            pod.close()
+        assert results[rid] == reference
+        assert len(results[other]) == 10
+
+    def test_speculative_rejects_sampling(self):
+        from llm_d_kv_cache_manager_tpu.engine.speculative import (
+            SpeculativeScheduler,
+        )
+
+        draft_cfg = LlamaConfig(
+            vocab_size=128, d_model=16, n_layers=1, n_q_heads=2,
+            n_kv_heads=2, head_dim=8, d_ff=32, dtype=jnp.float32,
+        )
+        pod = _pod()
+        try:
+            spec = SpeculativeScheduler(
+                pod, draft_config=draft_cfg,
+                draft_params=llama.init_params(draft_cfg, jax.random.PRNGKey(5)),
+                k=2,
+            )
+            with pytest.raises(NotImplementedError, match="greedy-only"):
+                spec.submit(list(PROMPT), max_new_tokens=4,
+                            sampling=SamplingParams(temperature=1.0))
+            # Greedy submissions still work.
+            spec.submit(list(PROMPT), max_new_tokens=4,
+                        sampling=SamplingParams())
+        finally:
+            pod.close()
