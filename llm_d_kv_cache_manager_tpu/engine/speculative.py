@@ -1,4 +1,7 @@
-"""Greedy speculative decoding: draft proposes, target verifies in one pass.
+"""Speculative decoding: draft proposes, target verifies in one pass.
+Greedy by default; SpeculativeDecoder also implements SPECULATIVE SAMPLING
+(accept with min(1, q/p), resample rejections from the residual), whose
+emitted-token law is exactly the target's filtered sampling distribution.
 
 TPU-first rationale: decode is bandwidth-bound (one token streams the whole
 weight stack), but the MXU can score k+1 positions for nearly the price of
@@ -108,16 +111,65 @@ class SpeculativeDecoder:
         self.draft_params = draft_params
         self.k = k
         self.stats = SpeculativeStats()
+        self._gen_counter = 0  # unseeded sampled calls get distinct streams
 
     def generate(
         self,
         prompt_tokens: List[int],
         max_new_tokens: int,
         eos_token: Optional[int] = None,
+        sampling=None,  # ops.sampling.SamplingParams; None/greedy => argmax
     ) -> List[int]:
+        """Greedy by default. With non-greedy `sampling`, runs SPECULATIVE
+        SAMPLING (Leviathan et al.): the draft SAMPLES proposals from its
+        own filtered distribution, the target accepts each with
+        min(1, q/p) and resamples the first rejection from the residual
+        max(0, q-p) — the emitted-token law is exactly the target's
+        filtered distribution q (pinned statistically in tests). Both
+        distributions pass through the SAME filter_logits the plain
+        scheduler samples with. Draft/accept randomness rides independent
+        per-position key streams split from PRNGKey(seed), so a given seed
+        reproduces its output."""
         pod = self.pod
         page_size = pod.config.page_size
         max_total = len(prompt_tokens) + max_new_tokens + self.k + 1
+
+        sampled_mode = sampling is not None and not sampling.is_greedy
+        if sampled_mode:
+            from llm_d_kv_cache_manager_tpu.ops.sampling import (
+                accept_or_resample,
+                filter_logits,
+                sample_tokens,
+            )
+
+            # Unseeded calls draw a fresh per-call stream (else best-of-n
+            # sampling would collapse to n identical sequences); seeded
+            # calls reproduce exactly.
+            self._gen_counter += 1
+            base = jax.random.PRNGKey(
+                sampling.seed if sampling.seed is not None
+                else self._gen_counter
+            )
+            # Independent streams: target emissions, draft proposals,
+            # accept/resample draws — each folded per absolute position.
+            k_target, k_draft, k_accept = jax.random.split(base, 3)
+            sp_arrays = (
+                jnp.asarray([sampling.temperature], jnp.float32),
+                jnp.asarray([sampling.top_k], jnp.int32),
+                jnp.asarray([sampling.top_p], jnp.float32),
+            )
+
+            def q_of(logits_row):  # filtered target distribution
+                return jax.nn.softmax(
+                    filter_logits(logits_row[None], *sp_arrays)[0]
+                )
+
+            def draw(logits_row, stream, position):
+                # The jitted batched sampler at B=1: one dispatch per draw.
+                key = jax.random.fold_in(stream, position)
+                return int(sample_tokens(
+                    logits_row[None], *sp_arrays, key[None]
+                )[0])
 
         state, _ = pod.prefill(list(prompt_tokens))
         draft = _DraftState(
@@ -127,12 +179,22 @@ class SpeculativeDecoder:
 
         generated: List[int] = []
         target_logits = pod.last_logits  # target's opinion at the frontier
+        # A residual resample whose KV is not yet resident; consumed as the
+        # next round's t0 (sampled mode only).
+        pending: Optional[int] = None
 
         try:
             while len(generated) < max_new_tokens:
-                # The frontier token: the target's own greedy choice.
-                t0 = int(jnp.argmax(target_logits))
+                # The frontier token: a carried residual resample, else the
+                # target's own choice (greedy argmax, or a draw from its
+                # filtered distribution).
                 pos_t0 = len(state.tokens)  # device position t0 will occupy
+                if pending is not None:
+                    t0, pending = pending, None
+                elif sampled_mode:
+                    t0 = draw(target_logits, k_target, pos_t0)
+                else:
+                    t0 = int(jnp.argmax(target_logits))
 
                 # Cap proposals at what could possibly be accepted: the
                 # remaining token budget after t0, and the sequence's page
@@ -147,14 +209,27 @@ class SpeculativeDecoder:
                         capacity_tokens),
                 )
 
-                # Draft proposes k_eff tokens after t0 (greedy,
-                # autoregressive). In the final stretch (k_eff == 0) the
-                # draft is skipped entirely — no further rounds propose.
+                # Draft proposes k_eff tokens after t0 (autoregressive;
+                # greedy argmax, or sampled from ITS filtered distribution
+                # — recorded so acceptance can form q/p). In the final
+                # stretch (k_eff == 0) the draft is skipped entirely.
                 proposals: List[int] = []
+                draft_dists = []  # sampled mode: p_i(·) per proposal
                 if k_eff > 0:
                     seed_logits = draft.ingest([t0])
-                    for _ in range(k_eff):
-                        p = int(jnp.argmax(seed_logits))
+                    for j in range(k_eff):
+                        if sampled_mode:
+                            f = filter_logits(seed_logits[None], *sp_arrays)[0]
+                            draft_dists.append(jax.nn.softmax(f))
+                            g = jax.random.gumbel(
+                                jax.random.fold_in(
+                                    k_draft, pos_t0 + 1 + j
+                                ),
+                                f.shape,
+                            )
+                            p = int(jnp.argmax(f + g))
+                        else:
+                            p = int(jnp.argmax(seed_logits))
                         proposals.append(p)
                         seed_logits = draft.ingest([p])
                 self.stats.proposed += len(proposals)
@@ -176,13 +251,42 @@ class SpeculativeDecoder:
                     pod._padded_table(state), pos_t0,
                     all_logits=True,
                 )
-                argmaxes = np.asarray(jnp.argmax(verify_logits, axis=-1))
 
-                n_accept = 0
-                for i, p in enumerate(proposals):
-                    if int(argmaxes[i]) != p:
-                        break
-                    n_accept += 1
+                resampled: Optional[int] = None
+                if sampled_mode and proposals:
+                    # Accept proposal i with prob min(1, q_i(x)/p_i(x));
+                    # the first rejection resamples from the residual. All
+                    # k (token, accepted) pairs are independent given the
+                    # two distribution stacks, so ONE vmapped dispatch
+                    # computes them and a host scan finds the first
+                    # rejection (vs k sequential dispatch+sync pairs).
+                    qs = jax.vmap(q_of)(verify_logits[: len(proposals)])
+                    toks_a, oks = jax.vmap(accept_or_resample)(
+                        qs, jnp.stack(draft_dists),
+                        jnp.asarray(proposals, jnp.int32),
+                        jax.vmap(jax.random.fold_in, (None, 0))(
+                            k_accept,
+                            pos_t0 + 1 + jnp.arange(len(proposals)),
+                        ),
+                    )
+                    oks = np.asarray(oks)
+                    toks_a = np.asarray(toks_a)
+                    n_accept = 0
+                    for i in range(len(proposals)):
+                        if oks[i]:
+                            n_accept += 1
+                        else:
+                            resampled = int(toks_a[i])
+                            break
+                elif sampled_mode:
+                    n_accept = 0
+                else:
+                    argmaxes = np.asarray(jnp.argmax(verify_logits, axis=-1))
+                    n_accept = 0
+                    for i, p in enumerate(proposals):
+                        if int(argmaxes[i]) != p:
+                            break
+                        n_accept += 1
                 self.stats.accepted += n_accept
 
                 done = False
@@ -202,7 +306,17 @@ class SpeculativeDecoder:
                 # monotonic, so it stays untouched.)
                 if k_eff > 0:
                     draft.n_tokens = len(state.tokens)
-                target_logits = verify_logits[n_accept]
+                if resampled is not None:
+                    # The residual draw replaces the rejected proposal, but
+                    # its KV is NOT resident (the verify pass wrote the
+                    # proposal's row). Carry it as the NEXT round's t0: that
+                    # round's verify chunk recomputes the position with the
+                    # right token — the same pending-token convention plain
+                    # decode uses.
+                    pending = resampled
+                    target_logits = None  # unused until a non-pending round
+                else:
+                    target_logits = verify_logits[n_accept]
         finally:
             pod.free(state)
         return generated
@@ -304,15 +418,17 @@ class SpeculativeScheduler:
         exactly adapter-greedy; the draft proposes with its base weights —
         adapter drift only lowers acceptance, never correctness.
 
-        Sampling is greedy-only here: speculative SAMPLING needs the
-        rejection-sampling acceptance rule (accept with p_target/p_draft)
-        to preserve the target distribution — not implemented. Fail loud
-        rather than silently emit the wrong distribution."""
+        The BATCHED scheduler is greedy-only: speculative sampling
+        (implemented on the single-sequence SpeculativeDecoder) needs
+        per-position accept/resample draws that the rectangular batch
+        tick does not carry yet. Fail loud rather than silently emit the
+        wrong distribution."""
         if sampling is not None and not sampling.is_greedy:
             raise NotImplementedError(
-                "speculative decoding is greedy-only: sampled requests "
-                "need distribution-preserving rejection sampling — submit "
-                "them to a plain Scheduler"
+                "batched speculative scheduling is greedy-only — submit "
+                "sampled requests to a plain Scheduler, or use "
+                "SpeculativeDecoder.generate(sampling=...) for "
+                "single-sequence speculative sampling"
             )
         return self.inner.submit(prompt_tokens, max_new_tokens, eos_token,
                                  lora_id=lora_id)

@@ -59,22 +59,19 @@ def position_keys(base_keys: jax.Array, positions: jax.Array) -> jax.Array:
     return jax.vmap(jax.random.fold_in)(base_keys, positions)
 
 
-@jax.jit
-def sample_tokens(
+def filter_logits(
     logits: jax.Array,  # [B, vocab]
-    temps: jax.Array,  # [B] f32; <= 0 selects greedy for that row
+    temps: jax.Array,  # [B] f32
     top_ks: jax.Array,  # [B] int32; 0 = no top-k
     top_ps: jax.Array,  # [B] f32; 1.0 = no top-p, 0 clamps to ~greedy
-    keys: jax.Array,  # [B] PRNG keys (already position-folded)
 ) -> jax.Array:
-    """Batched filtered sampling; returns [B] int32 token ids. Jitted: a
-    sampled decode tick is ONE dispatch, not a chain of eager ops."""
+    """Temperature → top-k → top-p filtered logits [B, vocab]; filtered-out
+    entries are -inf. softmax of the result is THE sampling distribution —
+    both plain sampling and speculative accept/resample use it, so the two
+    can never disagree on what distribution a request asked for."""
     vocab = logits.shape[-1]
-    logits = logits.astype(jnp.float32)
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
     # Temperature scaling (guarded for the greedy rows, which ignore it).
-    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    scaled = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-6)[:, None]
 
     sorted_desc = -jnp.sort(-scaled, axis=-1)  # [B, V] descending
     # Top-k: keep logits >= the k-th largest (ties at the boundary all
@@ -102,10 +99,49 @@ def sample_tokens(
     min_kept = jnp.min(
         jnp.where(keep_sorted, sorted_f, jnp.inf), axis=-1, keepdims=True
     )
-    filtered = jnp.where(filtered >= min_kept, filtered, -jnp.inf)
+    return jnp.where(filtered >= min_kept, filtered, -jnp.inf)
 
+
+@jax.jit
+def sample_tokens(
+    logits: jax.Array,  # [B, vocab]
+    temps: jax.Array,  # [B] f32; <= 0 selects greedy for that row
+    top_ks: jax.Array,  # [B] int32; 0 = no top-k
+    top_ps: jax.Array,  # [B] f32; 1.0 = no top-p, 0 clamps to ~greedy
+    keys: jax.Array,  # [B] PRNG keys (already position-folded)
+) -> jax.Array:
+    """Batched filtered sampling; returns [B] int32 token ids. Jitted: a
+    sampled decode tick is ONE dispatch, not a chain of eager ops."""
+    vocab = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    filtered = filter_logits(logits, temps, top_ks, top_ps)
     gumbel = jax.vmap(lambda k: jax.random.gumbel(k, (vocab,), jnp.float32))(
         keys
     )
     sampled = jnp.argmax(filtered + gumbel, axis=-1).astype(jnp.int32)
     return jnp.where(temps <= 0.0, greedy, sampled)
+
+
+@jax.jit
+def accept_or_resample(
+    q_probs: jax.Array,  # [V] target distribution at this position
+    p_probs: jax.Array,  # [V] draft distribution the proposal was drawn from
+    proposal: jax.Array,  # scalar int32 token the draft proposed
+    key: jax.Array,  # PRNG key for this position's accept/resample draws
+):
+    """Speculative-sampling acceptance (Leviathan et al. / Chen et al.):
+    accept the proposal with probability min(1, q(x)/p(x)); on rejection
+    emit a draw from the residual max(0, q - p) (renormalized). Marginal
+    law of the emitted token is EXACTLY q — pinned statistically in
+    tests/test_sampling.py. Returns (token, accepted)."""
+    k_u, k_r = jax.random.split(key)
+    u = jax.random.uniform(k_u)
+    ratio = q_probs[proposal] / jnp.maximum(p_probs[proposal], 1e-20)
+    accepted = u < ratio
+    residual = jnp.maximum(q_probs - p_probs, 0.0)
+    # q == p everywhere => acceptance is certain (ratio >= 1) and the
+    # residual draw is dead; the uniform fallback only guards the log.
+    residual = residual / jnp.maximum(residual.sum(), 1e-20)
+    resampled = jax.random.categorical(k_r, jnp.log(residual + 1e-30))
+    token = jnp.where(accepted, proposal, resampled).astype(jnp.int32)
+    return token, accepted
