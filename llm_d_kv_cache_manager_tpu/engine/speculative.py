@@ -418,20 +418,15 @@ class SpeculativeScheduler:
         exactly adapter-greedy; the draft proposes with its base weights —
         adapter drift only lowers acceptance, never correctness.
 
-        The BATCHED scheduler is greedy-only: speculative sampling
-        (implemented on the single-sequence SpeculativeDecoder) needs
-        per-position accept/resample draws that the rectangular batch
-        tick does not carry yet. Fail loud rather than silently emit the
-        wrong distribution."""
-        if sampling is not None and not sampling.is_greedy:
-            raise NotImplementedError(
-                "batched speculative scheduling is greedy-only — submit "
-                "sampled requests to a plain Scheduler, or use "
-                "SpeculativeDecoder.generate(sampling=...) for "
-                "single-sequence speculative sampling"
-            )
+        Sampled requests run BATCHED speculative sampling: the draft
+        samples proposals from its filtered distribution, acceptance is
+        min(1, q/p) per position, the first rejection's residual draw (or
+        the bonus draw on full acceptance) becomes the next pending token
+        — the emitted law is the target's filtered distribution, same
+        rule as SpeculativeDecoder. Greedy and sampled requests mix in
+        one batch."""
         return self.inner.submit(prompt_tokens, max_new_tokens, eos_token,
-                                 lora_id=lora_id)
+                                 lora_id=lora_id, sampling=sampling)
 
     @property
     def has_work(self) -> bool:
@@ -529,6 +524,49 @@ class SpeculativeScheduler:
         b_pad = pod.batch_bucket(b)
         pending = np.zeros((b_pad,), dtype=np.int32)
         pending[:b] = [req.state.tokens[-1] for req in running]
+        starts = np.zeros((b_pad,), np.int32)
+        starts[:b] = [len(r.state.tokens) - 1 for r in running]
+
+        # Batched speculative SAMPLING state (rows with non-greedy
+        # SamplingParams): per-row filter params and three independent
+        # per-request key streams (draft proposals / accept draws /
+        # emission draws), all folded per absolute position. Greedy rows
+        # keep temperature 0 and ride the argmax paths untouched.
+        sampled_rows = [
+            r.sampling is not None and not r.sampling.is_greedy
+            for r in running
+        ]
+        any_sampled = any(sampled_rows)
+        if any_sampled:
+            from llm_d_kv_cache_manager_tpu.ops.sampling import (
+                accept_or_resample,
+                filter_logits,
+                position_keys,
+                sample_tokens,
+            )
+
+            sp_temps = np.zeros((b_pad,), np.float32)
+            sp_tks = np.zeros((b_pad,), np.int32)
+            sp_tps = np.ones((b_pad,), np.float32)
+            bases = [jax.random.PRNGKey(0)] * b_pad
+            for i, r in enumerate(running):
+                if sampled_rows[i]:
+                    sp = r.sampling
+                    sp_temps[i] = sp.temperature
+                    sp_tks[i] = sp.top_k
+                    sp_tps[i] = sp.top_p
+                    bases[i] = jax.random.PRNGKey(
+                        sp.seed if sp.seed is not None else r.req_id
+                    )
+            streams = jax.vmap(lambda k: jax.random.split(k, 3))(
+                jnp.stack(bases)
+            )  # [b_pad, 3, ...]
+            emit_keys, draft_keys, accept_keys = (
+                streams[:, 0], streams[:, 1], streams[:, 2]
+            )
+            sp_temps = jnp.asarray(sp_temps)
+            sp_tks = jnp.asarray(sp_tks)
+            sp_tps = jnp.asarray(sp_tps)
 
         # Batched draft proposals: ingest pending as the seed, then k_eff
         # autoregressive steps. Draft writes past a stripe's capacity clamp
@@ -547,13 +585,27 @@ class SpeculativeScheduler:
             draft_pos = np.zeros((b_pad,), dtype=np.int32)
             draft_pos[:b] = [self._draft_state[r.req_id][1] for r in running]
             cur = jnp.asarray(pending)
+            draft_dists = []  # sampled mode: p_j(.) [b_pad, V] per column
             for j in range(k_eff):
                 lens = jnp.asarray(draft_pos + j)
                 self._draft_cache, logits = llama.decode_step_cache(
                     self.draft_config, self.draft_params, self._draft_cache,
                     cur, tables, lens,
                 )
-                cur = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                if any_sampled:
+                    # Proposal j occupies absolute position starts + 1 + j.
+                    draft_dists.append(jax.nn.softmax(
+                        filter_logits(logits, sp_temps, sp_tks, sp_tps),
+                        axis=-1,
+                    ))
+                    cur = sample_tokens(
+                        logits, sp_temps, sp_tks, sp_tps,
+                        position_keys(
+                            draft_keys, jnp.asarray(starts + 1 + j)
+                        ),
+                    )
+                else:
+                    cur = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 proposals[:, j] = np.asarray(cur)
             # Ingest the final proposal's KV too (its logits are unused):
             # without this, a fully accepted round leaves a permanent
@@ -570,8 +622,6 @@ class SpeculativeScheduler:
         # pages up to position len+accepts[i]-1 and in the trash page past
         # that.
         chunk = np.concatenate([pending[:, None], proposals], axis=1)
-        starts = np.zeros((b_pad,), np.int32)
-        starts[:b] = [len(r.state.tokens) - 1 for r in running]
         max_lens = np.zeros((b_pad,), np.int32)  # pad rows: all writes → trash
         max_lens[:b] = [
             len(r.state.tokens) + a for r, a in zip(running, accepts)
@@ -590,6 +640,45 @@ class SpeculativeScheduler:
         )
         argmaxes = np.asarray(jnp.argmax(verify_logits, axis=-1))  # [B, k+1]
 
+        if any_sampled:
+            # Batched accept/resample draws for columns 0..k_eff-1 and
+            # emission draws (bonus on full acceptance / plain draw at
+            # accepts[i]==0) for every column — a few batched dispatches,
+            # consumed per-row on host. Column j of a row sits at absolute
+            # position starts + 1 + j.
+            vocab = verify_logits.shape[-1]
+            cols1 = k_eff + 1
+            pos_mat = starts[:, None] + 1 + np.arange(cols1)[None, :]
+            rep = lambda a, n: jnp.repeat(a, n, axis=0)
+            emit_flat = sample_tokens(
+                verify_logits.reshape(b_pad * cols1, vocab),
+                rep(sp_temps, cols1), rep(sp_tks, cols1), rep(sp_tps, cols1),
+                position_keys(
+                    rep(emit_keys, cols1),
+                    jnp.asarray(pos_mat.reshape(-1)),
+                ),
+            )
+            emit_draws = np.asarray(emit_flat).reshape(b_pad, cols1)
+            if k_eff > 0:
+                q_all = jax.nn.softmax(filter_logits(
+                    verify_logits.reshape(b_pad * cols1, vocab),
+                    rep(sp_temps, cols1), rep(sp_tks, cols1),
+                    rep(sp_tps, cols1),
+                ), axis=-1).reshape(b_pad, cols1, vocab)
+                toks_a, oks = jax.vmap(accept_or_resample)(
+                    q_all[:, :k_eff].reshape(b_pad * k_eff, vocab),
+                    jnp.stack(draft_dists, axis=1).reshape(
+                        b_pad * k_eff, vocab
+                    ),
+                    jnp.asarray(proposals.reshape(-1), jnp.int32),
+                    position_keys(
+                        rep(accept_keys, k_eff),
+                        jnp.asarray(pos_mat[:, :k_eff].reshape(-1)),
+                    ),
+                )
+                accept_toks = np.asarray(toks_a).reshape(b_pad, k_eff)
+                accept_oks = np.asarray(oks).reshape(b_pad, k_eff)
+
         # The verify pass wrote KV for every sequence's pending token (and
         # its proposals): the pending row is now resident, so commit any
         # page it completed.
@@ -599,22 +688,39 @@ class SpeculativeScheduler:
         finished = []
         still_running = []
         for i, req in enumerate(running):
-            # argmaxes[i, j] is the target opinion after chunk[i, j]; a
-            # proposal is accepted while it matches the chain, capped by
-            # this sequence's own budget (columns past accepts[i] exist
-            # only because the batch is rectangular).
-            n_accept = 0
-            for j in range(accepts[i]):
-                if int(argmaxes[i, j]) != int(proposals[i, j]):
-                    break
-                n_accept += 1
+            if sampled_rows[i]:
+                # Speculative sampling: accept while the min(1, q/p) draw
+                # passes (capped by this row's budget); the first
+                # rejection's residual draw — or the bonus/plain draw on
+                # full acceptance — is the correction token.
+                n_accept = 0
+                correction = None
+                for j in range(accepts[i]):
+                    if bool(accept_oks[i, j]):
+                        n_accept += 1
+                    else:
+                        correction = int(accept_toks[i, j])
+                        break
+                if correction is None:
+                    correction = int(emit_draws[i, n_accept])
+            else:
+                # Greedy: a proposal is accepted while it matches the
+                # target argmax chain, capped by this row's budget
+                # (columns past accepts[i] exist only because the batch
+                # is rectangular).
+                n_accept = 0
+                for j in range(accepts[i]):
+                    if int(argmaxes[i, j]) != int(proposals[i, j]):
+                        break
+                    n_accept += 1
+                correction = int(argmaxes[i, n_accept])
             self.stats.accepted += n_accept
 
             # Emit accepted proposals, then the correction token (which
             # becomes the next pending). decode_append is skipped for a
             # final token, matching the plain scheduler.
             to_emit = [int(p) for p in proposals[i, :n_accept]]
-            to_emit.append(int(argmaxes[i, n_accept]))
+            to_emit.append(correction)
             done = False
             preempted = False
             for j, tok in enumerate(to_emit):

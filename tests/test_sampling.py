@@ -311,7 +311,11 @@ class TestServingSampling:
         acc = float(jnp.mean(accepted))
         assert abs(acc - expected_acc) < 0.02
 
-    def test_speculative_rejects_sampling(self):
+    def test_batched_speculative_sampling(self):
+        """The batched SpeculativeScheduler serves sampled requests with
+        the accept/resample rule: seeded runs reproduce; a greedy request
+        mixed into the same batch still matches the plain scheduler's
+        greedy output; a perfect draft accepts every sampled proposal."""
         from llm_d_kv_cache_manager_tpu.engine.speculative import (
             SpeculativeScheduler,
         )
@@ -320,18 +324,40 @@ class TestServingSampling:
             vocab_size=128, d_model=16, n_layers=1, n_q_heads=2,
             n_kv_heads=2, head_dim=8, d_ff=32, dtype=jnp.float32,
         )
+        draft_params = llama.init_params(draft_cfg, jax.random.PRNGKey(5))
+        sp = SamplingParams(temperature=1.0, top_k=50, seed=33)
+
+        def spec_run(draft_c=draft_cfg, draft_p=draft_params):
+            pod = _pod()
+            try:
+                spec = SpeculativeScheduler(
+                    pod, draft_config=draft_c, draft_params=draft_p,
+                    k=2, max_batch=4,
+                )
+                rid_s = spec.submit(list(PROMPT), max_new_tokens=10,
+                                    sampling=sp)
+                rid_g = spec.submit([5, 9, 2, 44], max_new_tokens=10)
+                res = spec.run()
+                return res[rid_s], res[rid_g], spec.stats
+            finally:
+                pod.close()
+
+        s1, g1, _ = spec_run()
+        s2, g2, _ = spec_run()
+        assert s1 == s2 and g1 == g2  # seeded + greedy both reproduce
+        assert len(s1) == 10
+
+        # The co-batched greedy request matches plain-scheduler greedy.
         pod = _pod()
         try:
-            spec = SpeculativeScheduler(
-                pod, draft_config=draft_cfg,
-                draft_params=llama.init_params(draft_cfg, jax.random.PRNGKey(5)),
-                k=2,
-            )
-            with pytest.raises(NotImplementedError, match="greedy-only"):
-                spec.submit(list(PROMPT), max_new_tokens=4,
-                            sampling=SamplingParams(temperature=1.0))
-            # Greedy submissions still work.
-            spec.submit(list(PROMPT), max_new_tokens=4,
-                        sampling=SamplingParams())
+            sched = Scheduler(pod, max_batch=1)
+            rid = sched.submit([5, 9, 2, 44], max_new_tokens=10)
+            plain = sched.run()[rid]
         finally:
             pod.close()
+        assert g1 == plain
+
+        # Perfect draft (q == p): every sampled proposal accepted.
+        _, _, stats = spec_run(draft_c=CFG, draft_p=PARAMS)
+        assert stats.proposed > 0
+        assert stats.accepted == stats.proposed
