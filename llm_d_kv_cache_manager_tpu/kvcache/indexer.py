@@ -34,6 +34,7 @@ from llm_d_kv_cache_manager_tpu.kvcache.scorer import (
     new_kv_block_scorer,
 )
 from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    PoolOverloadedError,
     TokenizationPool,
     TokenizersPoolConfig,
 )
@@ -122,7 +123,17 @@ class Indexer:
                 kvlog.trace(logger, "ignoring invalid lora_id %r", lora_id)
             lora_id = None
 
-        tokens = self.tokenizers_pool.tokenize(render_request, prompt, model_name)
+        try:
+            tokens = self.tokenizers_pool.tokenize(render_request, prompt, model_name)
+        except PoolOverloadedError:
+            # Degrade, don't fail: an empty score map routes the request by
+            # the caller's fallback strategy, which beats queueing the read
+            # path without bound behind a saturated tokenizer.
+            logger.warning(
+                "tokenization pool overloaded; returning empty scores for model %s",
+                model_name,
+            )
+            return {}
 
         block_keys = self.token_processor.tokens_to_kv_block_keys(
             None, tokens, model_name, lora_id=lora_id

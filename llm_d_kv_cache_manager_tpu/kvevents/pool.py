@@ -14,6 +14,17 @@ msgpack EventBatches and digest them into the shared KV-block index:
 Undecodable messages are dropped ("poison pills"), never retried
 (pool.go:182-187). The default device tier here is TPU "hbm" (the reference
 defaulted to "gpu"); events carrying an explicit Medium override it.
+
+Shard queues are bounded (the reference bounds ingest with rate-limited k8s
+workqueues, pool.go:103-144). On overflow the OLDEST queued message for that
+shard is dropped and counted (`kvcache_events_dropped_total`), but its
+BlockRemoved events are still applied before the rest is discarded: dropping
+a store self-heals (the engine re-stores hot blocks, and LRU churn evicts the
+rest), while dropping a removal would leave a permanent false-positive entry
+the engine never corrects. So overload sheds the expensive work (re-hashing
+token chains for stores) and keeps the cheap work that protects index
+soundness, and a misbehaving fleet degrades index freshness instead of
+growing manager memory without bound.
 """
 
 from __future__ import annotations
@@ -36,6 +47,7 @@ from llm_d_kv_cache_manager_tpu.kvevents.events import (
     EventBatch,
     hash_as_uint64,
 )
+from llm_d_kv_cache_manager_tpu.metrics import collector as metrics
 from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
 
 logger = kvlog.get_logger("kvevents.pool")
@@ -49,6 +61,9 @@ class EventPoolConfig:
     topic_filter: str = "kv@"
     concurrency: int = 4
     default_device_tier: str = DEFAULT_DEVICE_TIER
+    # Per-shard queue bound; <=0 means unbounded (not recommended in
+    # production — a stalled worker then grows memory without limit).
+    max_queue_depth: int = 4096
 
 
 @dataclass
@@ -72,13 +87,17 @@ class EventPool:
         self.config = config or EventPoolConfig()
         self.index = index
         self.token_processor = token_processor
+        depth = max(0, self.config.max_queue_depth)
         self._queues: List["queue.Queue[Optional[Message]]"] = [
-            queue.Queue() for _ in range(self.config.concurrency)
+            queue.Queue(maxsize=depth) for _ in range(self.config.concurrency)
         ]
         self._workers: List[threading.Thread] = []
         self._subscriber = None
         self._started = False
+        self._shutdown = False
         self._mu = threading.Lock()
+        self._dropped = 0
+        self._dropped_mu = threading.Lock()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -87,6 +106,7 @@ class EventPool:
             if self._started:
                 return
             self._started = True
+            self._shutdown = False
             for i, q in enumerate(self._queues):
                 t = threading.Thread(
                     target=self._worker_loop, args=(q,), name=f"kvevents-worker-{i}",
@@ -109,6 +129,7 @@ class EventPool:
             if not self._started:
                 return
             self._started = False
+            self._shutdown = True
         if self._subscriber is not None:
             self._subscriber.stop()
             self._subscriber = None
@@ -125,10 +146,90 @@ class EventPool:
 
     # -- ingestion ---------------------------------------------------------
 
+    @property
+    def dropped_events(self) -> int:
+        """Messages dropped because their shard queue was full."""
+        with self._dropped_mu:
+            return self._dropped
+
     def add_task(self, msg: Message) -> None:
-        """Shard by FNV-1a(pod) so per-pod ordering is preserved."""
+        """Shard by FNV-1a(pod) so per-pod ordering is preserved.
+
+        Never blocks: when the shard queue is full the oldest queued message
+        is dropped to make room (drop-oldest keeps the freshest view of the
+        fleet's cache state). A dropped message still has its BlockRemoved
+        events applied — see the module docstring.
+        """
+        if self._shutdown:
+            return  # shutdown in progress: drop quietly
+        # Enqueuing before start() is fine — the bounded queue accumulates
+        # (drop-oldest past the cap) until workers come up.
         shard = fnv32a(msg.pod_identifier.encode("utf-8")) % len(self._queues)
-        self._queues[shard].put(msg)
+        self._offer(self._queues[shard], msg, shard)
+
+    def _offer(
+        self,
+        q: "queue.Queue[Optional[Message]]",
+        item: Optional[Message],
+        shard: int,
+    ) -> None:
+        """put_nowait with drop-oldest; never blocks, never loses a sentinel.
+
+        The victim is applied removals-only before being discarded. If the
+        victim turns out to be the shutdown sentinel (None), the incoming
+        message is dropped instead and the sentinel is restored so the
+        worker still exits.
+        """
+        while True:
+            try:
+                q.put_nowait(item)
+                return
+            except queue.Full:
+                try:
+                    victim = q.get_nowait()
+                    q.task_done()
+                except queue.Empty:
+                    continue  # a worker drained it; retry the put
+                if victim is None:
+                    # Racing a shutdown: restore the sentinel, drop `item`.
+                    if item is not None:
+                        self._record_drop(item, shard)
+                    item = None
+                    continue
+                self._record_drop(victim, shard)
+
+    def _record_drop(self, victim: Message, shard: int) -> None:
+        self._apply_removals_only(victim)
+        metrics.count_event_dropped()
+        with self._dropped_mu:
+            self._dropped += 1
+            dropped = self._dropped
+        if dropped == 1 or dropped % 1000 == 0:
+            logger.warning(
+                "event ingest overloaded: dropped %d message(s) "
+                "(shard %d full at depth %d) — oldest-first, removals kept",
+                dropped, shard, self.config.max_queue_depth,
+            )
+
+    def _apply_removals_only(self, msg: Message) -> None:
+        """Digest just the BlockRemoved events of a message being dropped.
+
+        Evictions are cheap (no token re-hashing) and must not be lost: a
+        missed removal leaves a false-positive index entry the engine never
+        corrects. Runs on the producer thread — bounded work per dropped
+        message is exactly the backpressure we want.
+        """
+        try:
+            batch = EventBatch.from_msgpack(msg.payload)
+        except Exception:  # noqa: BLE001 - poison pill: nothing to preserve
+            return
+        pod = msg.pod_identifier
+        rank = batch.data_parallel_rank
+        if isinstance(rank, int) and not isinstance(rank, bool) and rank >= 0:
+            pod = f"{pod}@dp{rank}"
+        for event in batch.events:
+            if isinstance(event, BlockRemoved):
+                self._digest_block_removed(pod, msg.model_name, event)
 
     # -- workers -----------------------------------------------------------
 

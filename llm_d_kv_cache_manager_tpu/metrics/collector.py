@@ -39,6 +39,11 @@ render_latency: Optional[Histogram] = None
 # (/root/reference/pkg/tokenization/tokenizer.go:503-549).
 tokenization_backend_latency: Optional[Histogram] = None
 tokenization_backend_fallbacks: Optional[Counter] = None
+# Overload counters: the reference bounds ingest with rate-limited k8s
+# workqueues (/root/reference/pkg/kvcache/kvevents/pool.go:103-144); here the
+# queues are bounded and overload is made visible instead of rate-limited.
+events_dropped: Optional[Counter] = None
+tokenization_rejected: Optional[Counter] = None
 
 _registered = False
 _register_lock = threading.Lock()
@@ -51,6 +56,7 @@ def register_metrics(registry=None) -> None:
     global index_lookup_hits, index_max_pod_hits, index_lookup_latency
     global tokenization_latency, tokenized_tokens, render_latency
     global tokenization_backend_latency, tokenization_backend_fallbacks
+    global events_dropped, tokenization_rejected
 
     with _register_lock:
         if _registered:
@@ -118,6 +124,16 @@ def register_metrics(registry=None) -> None:
             labelnames=("backend", "op"),
             registry=reg,
         )
+        events_dropped = Counter(
+            "kvcache_events_dropped_total",
+            "KV events dropped because an ingest shard queue was full",
+            registry=reg,
+        )
+        tokenization_rejected = Counter(
+            "kvcache_tokenization_rejected_total",
+            "Tokenization tasks rejected because the pool queue was full",
+            registry=reg,
+        )
         _registered = True
 
 
@@ -144,6 +160,16 @@ def observe_backend(backend: str, op: str, seconds: float) -> None:
 def count_backend_fallback(backend: str, op: str) -> None:
     if tokenization_backend_fallbacks is not None:
         tokenization_backend_fallbacks.labels(backend=backend, op=op).inc()
+
+
+def count_event_dropped(n: int = 1) -> None:
+    if events_dropped is not None:
+        events_dropped.inc(n)
+
+
+def count_tokenization_rejected() -> None:
+    if tokenization_rejected is not None:
+        tokenization_rejected.inc()
 
 
 def start_metrics_logging(interval_s: float = 60.0) -> None:

@@ -11,6 +11,13 @@ pool.go:140-161.
 
 The composite tokenizer is assembled from the enabled backends in the order
 local → UDS sidecar → HF hub (pool.go:103-135).
+
+The task queue is bounded (the reference uses a rate-limited workqueue,
+pool.go:187-191). Overload policy: blocking `tokenize` waits briefly for a
+slot then raises `PoolOverloadedError` so the caller can shed or back off
+(scorer callers degrade to a zero-score answer rather than queueing without
+bound); fire-and-forget `enqueue_tokenization` is dropped and counted
+(`kvcache_tokenization_rejected_total`) — cache warming is best-effort.
 """
 
 from __future__ import annotations
@@ -42,10 +49,18 @@ DEFAULT_WORKERS = 5
 DEFAULT_MIN_PREFIX_OVERLAP_RATIO = 0.8
 
 
+class PoolOverloadedError(RuntimeError):
+    """The tokenization queue is full; the caller should shed or back off."""
+
+
 @dataclass
 class TokenizersPoolConfig:
     workers: int = DEFAULT_WORKERS
     min_prefix_overlap_ratio: float = DEFAULT_MIN_PREFIX_OVERLAP_RATIO
+    # Queue bound; <=0 means unbounded. Blocking submissions wait up to
+    # `enqueue_timeout_s` for a slot before raising PoolOverloadedError.
+    max_queue_depth: int = 2048
+    enqueue_timeout_s: float = 1.0
     enable_local: bool = True
     enable_uds: bool = False
     enable_hf: bool = False
@@ -77,10 +92,13 @@ class TokenizationPool:
         self.config = config or TokenizersPoolConfig()
         self.prefix_store = prefix_store or new_prefix_store(PrefixStoreConfig())
         self.tokenizer = tokenizer or self._build_composite(chat_templating)
-        self._queue: "queue.Queue[Optional[_Task]]" = queue.Queue()
+        depth = max(0, self.config.max_queue_depth)
+        self._queue: "queue.Queue[Optional[_Task]]" = queue.Queue(maxsize=depth)
         self._workers: List[threading.Thread] = []
         self._started = False
         self._mu = threading.Lock()
+        self._rejected = 0
+        self._rejected_mu = threading.Lock()
 
     def _build_composite(self, chat_templating) -> CompositeTokenizer:
         backends: List[Tokenizer] = []
@@ -134,19 +152,52 @@ class TokenizationPool:
 
     # -- submission --------------------------------------------------------
 
+    @property
+    def rejected_tasks(self) -> int:
+        """Submissions refused because the queue was full."""
+        with self._rejected_mu:
+            return self._rejected
+
+    def _count_rejected(self) -> None:
+        metrics.count_tokenization_rejected()
+        with self._rejected_mu:
+            self._rejected += 1
+            rejected = self._rejected
+        if rejected == 1 or rejected % 1000 == 0:
+            logger.warning(
+                "tokenization pool overloaded: rejected %d task(s) "
+                "(queue full at depth %d)",
+                rejected, self.config.max_queue_depth,
+            )
+
     def tokenize(
         self, render_request, prompt: str, model_name: str, timeout: Optional[float] = None
     ) -> List[int]:
-        """Blocking tokenization (the read path)."""
-        fut: Future = Future()
-        self._queue.put(_Task(render_request, prompt, model_name, fut))
+        """Blocking tokenization (the read path).
+
+        Raises PoolOverloadedError when no queue slot frees up within
+        `enqueue_timeout_s`.
+        """
         if not self._started:
             self.run()
+        fut: Future = Future()
+        task = _Task(render_request, prompt, model_name, fut)
+        try:
+            self._queue.put(task, timeout=self.config.enqueue_timeout_s)
+        except queue.Full:
+            self._count_rejected()
+            raise PoolOverloadedError(
+                f"tokenization queue full (depth {self.config.max_queue_depth}); "
+                "retry with backoff or shed the request"
+            ) from None
         return fut.result(timeout=timeout)
 
     def enqueue_tokenization(self, render_request, prompt: str, model_name: str) -> None:
-        """Fire-and-forget tokenization (cache warming)."""
-        self._queue.put(_Task(render_request, prompt, model_name, None))
+        """Fire-and-forget tokenization (cache warming). Dropped when full."""
+        try:
+            self._queue.put_nowait(_Task(render_request, prompt, model_name, None))
+        except queue.Full:
+            self._count_rejected()
 
     # -- workers -----------------------------------------------------------
 

@@ -1,0 +1,178 @@
+"""Bounded-ingest overload behavior.
+
+Parity target: the reference bounds ingest with rate-limited k8s workqueues
+(/root/reference/pkg/kvcache/kvevents/pool.go:103-144,187-191). Here the
+queues are bounded with an explicit overload policy: the event pool drops
+oldest-first and counts drops; the tokenization pool rejects loudly
+(blocking path) or drops-and-counts (fire-and-forget path). These tests
+flood both pools and assert memory stays bounded and the overload is
+visible.
+"""
+
+import queue
+import threading
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import InMemoryIndex
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.events import BlockStored, EventBatch
+from llm_d_kv_cache_manager_tpu.kvevents.pool import (
+    EventPool,
+    EventPoolConfig,
+    Message,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    PoolOverloadedError,
+    TokenizationPool,
+    TokenizersPoolConfig,
+)
+
+
+def _make_event_pool(depth: int, concurrency: int = 1) -> EventPool:
+    return EventPool(
+        EventPoolConfig(concurrency=concurrency, max_queue_depth=depth),
+        InMemoryIndex(),
+        ChunkedTokenDatabase(TokenProcessorConfig()),
+    )
+
+
+def _msg(i: int, pod: str = "pod-a") -> Message:
+    batch = EventBatch(
+        ts=float(i),
+        events=[BlockStored(block_hashes=[i], parent_block_hash=None,
+                            token_ids=list(range(16)), block_size=16)],
+    )
+    return Message(
+        topic=f"kv@{pod}@m", payload=batch.to_msgpack(), seq=i,
+        pod_identifier=pod, model_name="m",
+    )
+
+
+class TestEventPoolFlood:
+    def test_flood_is_bounded_and_counted(self):
+        """Workers never started: a stalled consumer must not grow memory."""
+        pool = _make_event_pool(depth=8)
+        for i in range(1000):
+            pool.add_task(_msg(i))
+        assert pool._queues[0].qsize() == 8
+        assert pool.dropped_events == 992
+
+    def test_drop_oldest_keeps_freshest(self):
+        pool = _make_event_pool(depth=4)
+        for i in range(10):
+            pool.add_task(_msg(i))
+        kept = []
+        while True:
+            try:
+                kept.append(pool._queues[0].get_nowait().seq)
+            except queue.Empty:
+                break
+        assert kept == [6, 7, 8, 9]
+
+    def test_no_drops_below_bound(self):
+        pool = _make_event_pool(depth=64)
+        for i in range(64):
+            pool.add_task(_msg(i))
+        assert pool.dropped_events == 0
+
+    def test_flood_with_live_workers_processes_tail(self):
+        """With workers running the pool still lands the freshest events."""
+        pool = _make_event_pool(depth=16)
+        pool.start(with_subscriber=False)
+        try:
+            for i in range(500):
+                pool.add_task(_msg(i))
+            pool.drain()
+            # The last event is never dropped (drop-oldest), so its block
+            # must be indexed.
+            tp = pool.token_processor
+            keys = tp.tokens_to_kv_block_keys(None, list(range(16)), "m")
+            hits = pool.index.lookup(keys, set())
+            assert any(hits.values())
+        finally:
+            pool.shutdown()
+
+
+class _SlowTokenizer:
+    """Minimal Tokenizer stub that blocks until released."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.entered = threading.Event()
+
+    def encode(self, prompt, model_name):
+        self.entered.set()
+        self.release.wait(timeout=10.0)
+        from llm_d_kv_cache_manager_tpu.tokenization.tokenizer import (
+            TokenizationResult,
+        )
+
+        toks = list(range(len(prompt.split())))
+        return TokenizationResult(tokens=toks, offsets=[(0, 1)] * len(toks))
+
+    def render_chat_template(self, request):  # pragma: no cover
+        raise NotImplementedError
+
+
+class TestTokenizationPoolOverload:
+    def _pool(self, depth: int, workers: int = 1):
+        tok = _SlowTokenizer()
+        pool = TokenizationPool(
+            TokenizersPoolConfig(
+                workers=workers, max_queue_depth=depth, enqueue_timeout_s=0.05
+            ),
+            tokenizer=tok,
+        )
+        return pool, tok
+
+    def test_enqueue_drops_and_counts_when_full(self):
+        pool, tok = self._pool(depth=4)
+        try:
+            # Not started: nothing drains, so the 5th onward is rejected.
+            for i in range(20):
+                pool.enqueue_tokenization(None, f"prompt {i}", "m")
+            assert pool._queue.qsize() == 4
+            assert pool.rejected_tasks == 16
+        finally:
+            tok.release.set()
+            pool.shutdown()
+
+    def test_blocking_tokenize_raises_overloaded(self):
+        pool, tok = self._pool(depth=1)
+        try:
+            pool.run()
+            # One task occupies the single worker, one fills the queue.
+            pool.enqueue_tokenization(None, "busy a", "m")
+            assert tok.entered.wait(timeout=5.0)
+            pool.enqueue_tokenization(None, "busy b", "m")
+            with pytest.raises(PoolOverloadedError):
+                pool.tokenize(None, "overflow", "m")
+            assert pool.rejected_tasks >= 1
+        finally:
+            tok.release.set()
+            pool.shutdown()
+
+    def test_indexer_degrades_to_empty_scores(self):
+        tok = _SlowTokenizer()
+        pool = TokenizationPool(
+            TokenizersPoolConfig(
+                workers=1, max_queue_depth=1, enqueue_timeout_s=0.05
+            ),
+            tokenizer=tok,
+        )
+        indexer = Indexer(IndexerConfig(), tokenization_pool=pool)
+        try:
+            indexer.run()
+            pool.enqueue_tokenization(None, "busy a", "m")
+            assert tok.entered.wait(timeout=5.0)
+            pool.enqueue_tokenization(None, "busy b", "m")
+            scores = indexer.get_pod_scores("overflow prompt", "m", ["pod-a"])
+            assert scores == {}
+        finally:
+            tok.release.set()
+            indexer.shutdown()
