@@ -27,7 +27,7 @@ composition. Sequences finish on max_new_tokens or EOS.
 
 from __future__ import annotations
 
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -333,15 +333,21 @@ class Scheduler:
         request seed (default: req_id), so a run is reproducible and a
         request's draws don't depend on what it was batched with.
 
-        Cached per (request-set, padded_len): the arrays change only when
-        the batch's request set does, and a sampled decode tick must not
-        pay a host rebuild + four uploads per emitted token."""
+        Cached per (request-set, padded_len), a few entries deep: prefill
+        finishes (padded to the wave length) and decode ticks (padded to
+        the batch bucket) alternate with different signatures, so a
+        single-slot cache would rebuild + re-upload the arrays every tick
+        — exactly the cost the cache exists to avoid."""
         if all(r.sampling is None or r.sampling.is_greedy for r in reqs):
             return None
         sig = (tuple((r.req_id, r.sampling) for r in reqs), padded_len)
-        cached = getattr(self, "_sampling_cache", None)
-        if cached is not None and cached[0] == sig:
-            return cached[1]
+        cache = getattr(self, "_sampling_cache", None)
+        if cache is None:
+            cache = self._sampling_cache = OrderedDict()
+        cached = cache.get(sig)
+        if cached is not None:
+            cache.move_to_end(sig)
+            return cached
         import jax
 
         jnp = self.pod._jnp
@@ -362,7 +368,9 @@ class Scheduler:
             jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
             jnp.stack(keys),
         )
-        self._sampling_cache = (sig, arrays)
+        cache[sig] = arrays
+        while len(cache) > 8:  # a handful of live shapes; bound the rest
+            cache.popitem(last=False)
         return arrays
 
     def _decode(self) -> List[Request]:
