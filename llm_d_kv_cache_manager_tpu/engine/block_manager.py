@@ -150,6 +150,10 @@ class BlockManager:
     def num_cached_pages(self) -> int:
         return len(self._hash_to_page)
 
+    def is_cached(self, chunk_hash: int) -> bool:
+        """True when the block is HBM-resident (committed and reusable)."""
+        return chunk_hash in self._hash_to_page
+
     # -- allocation ----------------------------------------------------------
 
     def allocate(

@@ -1,0 +1,238 @@
+"""Transfer-vs-recompute cost model for the two-tier data plane.
+
+Whether moving a KV block beats recomputing it is pure arithmetic
+intensity: restoring a block costs its `kv_bytes_per_token` over the
+transfer path's bandwidth, while recomputing it costs the model's
+`flops_per_token` over the chip's measured prefill rate. Wide / MQA /
+int8-KV models carry few KV bytes per token of compute, so transfer wins;
+small dense models recompute almost for free, so blind onboarding is a net
+TTFT loss (round-3 measurement: 4x worse than recompute under
+cache-oblivious routing, BENCH_r03.json two_tier rr_data_plane_speedup
+0.252).
+
+`TransferCostModel` makes the decision explicit. It is seeded from the
+device-measured rates in benchmarking/DEVICE_BENCH.json (data-plane
+bandwidths + marginal prefill TFLOP/s) whenever that artifact exists, so
+the gate's economics are the rig's, not guesses; without the artifact it
+falls back to labeled "assumed" v5e-class rates.
+
+The reference has no equivalent surface: its kv_connectors/ directory is
+an empty mandate (/root/reference/kv_connectors/, Makefile:169-175) and
+its device tiers exist only as scoring weights
+(/root/reference/pkg/kvcache/backend.go:19-31). This module is TPU-build
+design: the data plane only fires when the bytes are cheaper than the
+FLOPs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, replace
+from typing import List, Optional, Sequence
+
+from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
+
+logger = kvlog.get_logger("engine.costs")
+
+_DEVICE_BENCH_PATH = os.path.join(
+    os.path.dirname(__file__), "..", "..", "benchmarking", "DEVICE_BENCH.json"
+)
+
+# Block sources a restorable chain prefix can mix, in the order load_chain
+# resolves them: a payload the prefetcher already fetched into host RAM
+# ("ready", pays only the device insert), the local host staging store
+# ("staged", loopback fetch + insert), a peer pod over DCN ("peer",
+# network fetch + insert).
+READY, STAGED, PEER = "ready", "staged", "peer"
+
+# Assumed v5e-class rates used only when no device measurement exists:
+# host<->HBM over PCIe gen3 ~12 GB/s effective, DCN ~3 GB/s effective,
+# marginal prefill ~80 TFLOP/s bf16. On the tunneled bench rig the
+# measured rates are ~150x slower on the transfer side — which is exactly
+# why the gate must be seeded from measurements, not these defaults.
+ASSUMED_RATES = {
+    "staged_bytes_per_s": 12e9,
+    "peer_bytes_per_s": 3e9,
+    "insert_bytes_per_s": 12e9,
+    "compute_flops_per_s": 80e12,
+    "source": "assumed (v5e-class; no DEVICE_BENCH.json)",
+}
+
+
+def measured_rates(path: Optional[str] = None) -> Optional[dict]:
+    """Model-independent transfer/compute rates from the device bench
+    artifact: bytes/s per path (derived from the benched model's measured
+    s-per-token and its page geometry) and marginal prefill FLOP/s.
+    Returns None when the artifact or its data_plane section is absent."""
+    path = path or _DEVICE_BENCH_PATH
+    try:
+        with open(path) as f:
+            bench = json.load(f)
+    except (OSError, ValueError):
+        return None
+    dp = bench.get("data_plane") or {}
+    if "page_nbytes" not in dp or "page_size_tokens" not in dp:
+        return None
+    bytes_per_token = dp["page_nbytes"] / dp["page_size_tokens"]
+
+    def rate(key_batch: str, key_single: str) -> Optional[float]:
+        s_per_token = dp.get(key_batch, dp.get(key_single))
+        if not s_per_token:
+            return None
+        return bytes_per_token / s_per_token
+
+    staged = rate("host_restore_batch_s_per_token", "host_restore_s_per_token")
+    peer = rate("dcn_onboard_chain_s_per_token", "dcn_onboard_s_per_token")
+    insert_mbps = dp.get("insert_batch_mbps", dp.get("insert_mbps"))
+    tflops = (bench.get("analysis") or {}).get("prefill_marginal_tflops")
+    if staged is None or tflops is None:
+        return None
+    source = "measured (DEVICE_BENCH.json)"
+    if peer is None:
+        # Artifact lacks the DCN leg (connector bench skipped): don't pass
+        # a modeled number off under a measured label.
+        source = "measured (DEVICE_BENCH.json; peer rate assumed staged/2)"
+    return {
+        "staged_bytes_per_s": staged,
+        "peer_bytes_per_s": peer if peer is not None else staged / 2,
+        "insert_bytes_per_s": (
+            insert_mbps * 1e6 if insert_mbps else staged
+        ),
+        "compute_flops_per_s": tflops * 1e12,
+        "source": source,
+    }
+
+
+def flops_per_token(model_config) -> float:
+    """~2 FLOPs per parameter touched per token (matmul dominated):
+    attention projections + gated MLP + LM head; embedding lookups free."""
+    c = model_config
+    attn = (
+        c.d_model * c.n_q_heads * c.head_dim  # wq
+        + 2 * c.d_model * c.n_kv_heads * c.head_dim  # wk, wv
+        + c.n_q_heads * c.head_dim * c.d_model  # wo
+    )
+    mlp = 3 * c.d_model * c.d_ff  # gate, up, down
+    # The MoE family (models/mixtral.py) activates top_k experts per token.
+    n_experts_active = getattr(c, "top_k", None)
+    if getattr(c, "n_experts", 0) and n_experts_active:
+        mlp = n_experts_active * mlp + c.d_model * c.n_experts  # + router
+    head = c.d_model * c.vocab_size
+    return 2.0 * (c.n_layers * (attn + mlp) + head)
+
+
+def kv_bytes_per_token(model_config, quantized: bool = False) -> float:
+    """Bytes of KV cache one token occupies across all layers — the wire
+    size of its share of a block payload (engine._DevicePageCodec layout:
+    bf16 (k, v) pair, or int8 4-tuple with one f32 scale per row)."""
+    c = model_config
+    rows = 2 * c.n_layers * c.n_kv_heads  # k and v, every layer, every head
+    if quantized:
+        return rows * (c.head_dim * 1 + 4)  # int8 row + f32 scale
+    return rows * c.head_dim * 2  # bf16
+
+
+@dataclass(frozen=True)
+class TransferCostModel:
+    """Per-token seconds for THIS pod's model on THIS rig. `margin` < 1
+    demands transfer beat recompute by that factor; > 1 tolerates slower
+    transfers (e.g. to trade chip FLOPs for freshness under load)."""
+
+    recompute_s: float
+    staged_restore_s: float
+    onboard_s: float
+    insert_s: float
+    margin: float = 1.0
+    source: str = "assumed"
+
+    def per_token(self, source: str) -> float:
+        return {
+            READY: self.insert_s,
+            STAGED: self.staged_restore_s,
+            PEER: self.onboard_s,
+        }[source]
+
+    def admit_prefix(self, sources: Sequence[str], page_size: int) -> int:
+        """Longest chain prefix worth restoring. Restoring k blocks saves
+        k * page_size tokens of recompute and costs the sum of their
+        transfer times; admit the longest prefix whose cumulative cost
+        stays within margin x savings (an expensive block can ride on the
+        cheap ones behind it — chains restore as prefixes, never with
+        holes)."""
+        budget_per_block = self.margin * self.recompute_s * page_size
+        cost = 0.0
+        admitted = 0
+        for i, source in enumerate(sources):
+            cost += self.per_token(source) * page_size
+            if cost <= budget_per_block * (i + 1):
+                admitted = i + 1
+        return admitted
+
+    def with_margin(self, margin: float) -> "TransferCostModel":
+        return replace(self, margin=margin)
+
+    # -- constructors ------------------------------------------------------
+
+    @classmethod
+    def from_rates(
+        cls,
+        *,
+        model_flops_per_token: float,
+        model_kv_bytes_per_token: float,
+        rates: Optional[dict] = None,
+        margin: float = 1.0,
+    ) -> "TransferCostModel":
+        rates = rates or measured_rates() or ASSUMED_RATES
+        return cls(
+            recompute_s=model_flops_per_token / rates["compute_flops_per_s"],
+            staged_restore_s=(
+                model_kv_bytes_per_token / rates["staged_bytes_per_s"]
+            ),
+            onboard_s=model_kv_bytes_per_token / rates["peer_bytes_per_s"],
+            insert_s=model_kv_bytes_per_token / rates["insert_bytes_per_s"],
+            margin=margin,
+            source=rates["source"],
+        )
+
+    @classmethod
+    def for_model(
+        cls,
+        model_config,
+        quantized: bool = False,
+        rates: Optional[dict] = None,
+        margin: float = 1.0,
+    ) -> "TransferCostModel":
+        """The default gate an EnginePod builds for its own model config:
+        rig rates (measured when available) x this model's arithmetic
+        intensity."""
+        return cls.from_rates(
+            model_flops_per_token=flops_per_token(model_config),
+            model_kv_bytes_per_token=kv_bytes_per_token(
+                model_config, quantized=quantized
+            ),
+            rates=rates,
+            margin=margin,
+        )
+
+
+#: Gate that admits every restorable block — accounting-only pods (zero
+#: payload bytes) and tests that pin restore mechanics rather than
+#: economics.
+ALWAYS_TRANSFER = TransferCostModel(
+    recompute_s=1.0,
+    staged_restore_s=0.0,
+    onboard_s=0.0,
+    insert_s=0.0,
+    source="always-transfer",
+)
+
+#: Gate that refuses every transfer — the degenerate "recompute everything"
+#: policy, useful as a bench arm.
+NEVER_TRANSFER = TransferCostModel(
+    recompute_s=0.0,
+    staged_restore_s=1.0,
+    onboard_s=1.0,
+    insert_s=1.0,
+    source="never-transfer",
+)

@@ -205,6 +205,16 @@ class EnginePodConfig:
     enable_host_tier: bool = False
     host_capacity_blocks: int = 1024
     transfer_port: int = 0  # 0 -> ephemeral
+    # Transfer-vs-recompute gate (engine/costs.py). "auto": model pods gate
+    # with a cost model seeded from this model's arithmetic intensity x the
+    # rig's measured rates (DEVICE_BENCH.json when present); accounting-only
+    # pods (no model: zero-byte payloads) run ungated. Pass an explicit
+    # TransferCostModel (e.g. costs.ALWAYS_TRANSFER) to override, or None
+    # to disable gating.
+    transfer_cost_model: object = "auto"
+    # Ready-buffer bound for the async payload prefetcher (blocks held in
+    # host RAM awaiting their device insert); <=0 disables prefetch.
+    prefetch_capacity_blocks: int = 64
 
 
 class EnginePod:
@@ -242,8 +252,19 @@ class EnginePod:
             codec = (
                 _DevicePageCodec(self) if config.with_model else NullPageCodec()
             )
+            # "auto" for a model pod resolves below once the model config is
+            # known; accounting-only pods stay ungated (zero-byte payloads
+            # cost nothing to move).
+            cost_model = (
+                None
+                if config.transfer_cost_model == "auto"
+                else config.transfer_cost_model
+            )
             self.tier_store = TieredKVStore(
-                self.connector, codec, capacity_blocks=config.host_capacity_blocks
+                self.connector, codec,
+                capacity_blocks=config.host_capacity_blocks,
+                cost_model=cost_model,
+                prefetch_capacity_blocks=config.prefetch_capacity_blocks,
             )
 
         self.block_manager = BlockManager(
@@ -280,6 +301,17 @@ class EnginePod:
             # carrying n_experts is the MoE family (models/mixtral.py).
             self._model = llama
             self._model_config = mc
+            if (
+                self.tier_store is not None
+                and config.transfer_cost_model == "auto"
+            ):
+                from llm_d_kv_cache_manager_tpu.engine.costs import (
+                    TransferCostModel,
+                )
+
+                self.tier_store.cost_model = TransferCostModel.for_model(
+                    mc, quantized=config.use_quantized_kv
+                )
             # Sliding-window checkpoints (HF Mistral defaults to 4096) are
             # served exactly: every attention path masks to the window
             # (models/llama.py _dense_attention + ops paged kernels, which
@@ -585,9 +617,28 @@ class EnginePod:
         self.tier_store.export_blocks(blocks)
         return len(blocks)
 
+    def prefetch(self, tokens: List[int], lora_id: Optional[int] = None) -> int:
+        """Start background payload fetches for this prompt's restorable
+        blocks (announced-but-not-yet-admitted requests: the fetch rides
+        the queue wait instead of the TTFT critical path). No-op without a
+        data plane. Returns the number of fetches queued."""
+        if self.tier_store is None:
+            return 0
+        keys = self.block_manager.token_db.tokens_to_kv_block_keys(
+            None, [int(t) for t in tokens], "", lora_id=lora_id
+        )
+        missing = [
+            k.chunk_hash
+            for k in keys
+            if not self.block_manager.is_cached(k.chunk_hash)
+        ]
+        return self.tier_store.prefetch(missing)
+
     def close(self) -> None:
         if self._publisher is not None:
             self._publisher.close()
+        if self.tier_store is not None:
+            self.tier_store.close()
         if self.connector is not None:
             self.connector.close()
 

@@ -122,6 +122,10 @@ class Scheduler:
             self._rejected.append(req)
         else:
             self._waiting.append(req)
+            # Data plane: start background payload fetches for restorable
+            # blocks now, so the network leg rides the queue wait instead
+            # of the admission tick (no-op without a host tier).
+            self.pod.prefetch(req.prompt_tokens, req.lora_id)
         return req.req_id
 
     @property

@@ -26,9 +26,17 @@ without device bytes, which is what the fleet bench simulates.
 
 from __future__ import annotations
 
+import queue
+import threading
 from collections import OrderedDict
 from typing import TYPE_CHECKING, Callable, Dict, List, Mapping, Optional, Tuple
 
+from llm_d_kv_cache_manager_tpu.engine.costs import (
+    PEER,
+    READY,
+    STAGED,
+    TransferCostModel,
+)
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import (
     Key,
     base_pod_identifier,
@@ -97,15 +105,32 @@ class TieredKVStore:
         codec: PageCodec,
         capacity_blocks: int = 1024,
         peer_resolver: Optional[PeerResolver] = None,
+        cost_model: Optional[TransferCostModel] = None,
+        prefetch_capacity_blocks: int = 64,
     ):
         self.connector = connector
         self.codec = codec
         self.capacity_blocks = capacity_blocks
         self.peer_resolver = peer_resolver
+        # Transfer-vs-recompute gate (engine/costs.py). None admits every
+        # restorable block — the pre-gate behavior, which is right for
+        # accounting-only pods (zero payload bytes) and mechanics tests;
+        # EnginePod passes a model-seeded gate for real pods.
+        self.cost_model = cost_model
         # hash -> None, insertion-ordered: the host store's eviction queue.
         self._staged: "OrderedDict[int, None]" = OrderedDict()
+        # hash -> (payload, source): payloads the async prefetcher already
+        # pulled into host RAM; load_chain lands them at insert-only cost.
+        self._ready: "OrderedDict[int, Tuple[bytes, str]]" = OrderedDict()
+        self._ready_cap = max(0, prefetch_capacity_blocks)
+        self._mu = threading.Lock()  # guards _staged and _ready
+        self._prefetch_q: "queue.Queue[Optional[List[int]]]" = queue.Queue()
+        self._prefetch_thread: Optional[threading.Thread] = None
+        self._inflight: set = set()  # hashes queued/being fetched
+        self._closed = False
         self.stats: Dict[str, int] = {
             "offloads": 0, "restores": 0, "onboards": 0, "host_evictions": 0,
+            "gated_blocks": 0, "prefetched": 0, "ready_hits": 0,
         }
 
     # -- BlockManager hook: reclaim → offload ------------------------------
@@ -154,20 +179,47 @@ class TieredKVStore:
         return len(landed) == 1
 
     def plan_restore(self, chunk_hashes: List[int]) -> int:
-        """Longest prefix of `chunk_hashes` this store can materialize —
-        membership checks only (local host store, then peer index), no
-        bytes moved. The block manager calls this before grabbing pages so
-        a chain restore allocates exactly what will land."""
-        n = 0
+        """Longest prefix of `chunk_hashes` WORTH materializing: membership
+        checks (prefetched payloads, local host store, then peer index —
+        no bytes moved), truncated by the transfer-vs-recompute gate. The
+        block manager calls this before grabbing pages so a chain restore
+        allocates exactly what will land."""
+        sources: List[str] = []
         for h in chunk_hashes:
-            if h in self._staged:
-                n += 1
-                continue
-            if self.peer_resolver is not None and self.peer_resolver(h) is not None:
-                n += 1
-                continue
-            break
-        return n
+            source = self._source_of(h)
+            if source is None:
+                break
+            sources.append(source)
+        if not sources:
+            return 0
+        if self.cost_model is None:
+            return len(sources)
+        # page_size scales cost and savings identically, so 1 suffices.
+        admitted = self.cost_model.admit_prefix(sources, 1)
+        self.stats["gated_blocks"] += len(sources) - admitted
+        return admitted
+
+    def _live_fetch_admissible(self, so_far: List[str], source: str) -> bool:
+        """Cumulative gate re-check for a critical-path fetch: admit block
+        len(so_far) at `source` cost only if the whole chain so far plus
+        it stays admissible — the same arithmetic plan_restore ran, at the
+        costs actually being paid."""
+        if self.cost_model is None:
+            return True
+        return self.cost_model.admit_prefix(so_far + [source], 1) == len(so_far) + 1
+
+    def _source_of(self, chunk_hash: int) -> Optional[str]:
+        """Cheapest available source for a block, or None when absent
+        everywhere (READY beats STAGED beats PEER — same order load_chain
+        fetches)."""
+        with self._mu:
+            if chunk_hash in self._ready:
+                return READY
+            if chunk_hash in self._staged:
+                return STAGED
+        if self.peer_resolver is not None and self.peer_resolver(chunk_hash) is not None:
+            return PEER
+        return None
 
     def load_chain(self, blocks: List[tuple], take_pages) -> List[int]:
         """Materialize a chain prefix: fetch every payload (host store or
@@ -179,20 +231,46 @@ class TieredKVStore:
         hole, and fetch-before-take means a stale plan cannot evict
         HBM-cached pages for a restore that lands nothing."""
         fetched: List[tuple] = []  # (payload, source)
+        cost_sources: List[str] = []  # what each landed block actually cost
         max_size = max(self.codec.page_nbytes, 1)
         for chunk_hash, _tokens, _parent in blocks:
             payload = None
             source = None
-            if chunk_hash in self._staged:
+            with self._mu:
+                ready = self._ready.pop(chunk_hash, None)
+                staged = chunk_hash in self._staged
+            if ready is not None:
+                # Prefetched: the fetch already happened off the critical
+                # path; classify by where the prefetcher got it so the
+                # restore/onboard stats stay truthful.
+                payload, source = ready[0], (
+                    "restores" if ready[1] == STAGED else "onboards"
+                )
+                cost_sources.append(READY)
+                self.stats["ready_hits"] += 1
+            if payload is None and staged:
+                # plan_restore may have admitted this block at READY cost
+                # and the ready entry got evicted since (prefetcher cap
+                # churn): re-check the gate at the cost actually paid, so
+                # a transfer the economics refuse cannot sneak onto the
+                # critical path through that race.
+                if not self._live_fetch_admissible(cost_sources, STAGED):
+                    break
                 payload = self.connector.fetch_staged(chunk_hash, max_size)
-                source = "restores"
+                if payload is not None:
+                    source = "restores"
+                    cost_sources.append(STAGED)
             if payload is None and self.peer_resolver is not None:
                 addr = self.peer_resolver(chunk_hash)
                 if addr is not None:
+                    if not self._live_fetch_admissible(cost_sources, PEER):
+                        break
                     payload = self.connector.onboard_payload(
                         addr[0], addr[1], chunk_hash, max_size
                     )
-                    source = "onboards"
+                    if payload is not None:
+                        source = "onboards"
+                        cost_sources.append(PEER)
             if payload is None:
                 break
             fetched.append((payload, source))
@@ -209,6 +287,104 @@ class TieredKVStore:
             self.stats[source] += 1
         return list(page_ids[: len(fetched)])
 
+    # -- async prefetch ----------------------------------------------------
+
+    def prefetch(self, chunk_hashes: List[int]) -> int:
+        """Queue block payload fetches on the background prefetcher. The
+        network/loopback fetch happens off the serving thread; the device
+        insert still happens at allocate time, from the ready buffer, at
+        insert-only cost. Returns how many fetches were queued.
+
+        Gate: a prefetched block lands at insert-only cost, so prefetch
+        only when even that cost beats recompute (insert cost is uniform
+        per block, so the single-block check is exact for whole chains)."""
+        if self._ready_cap <= 0 or self._closed:
+            return 0
+        if self.cost_model is not None and self.cost_model.admit_prefix(
+            [READY], 1
+        ) == 0:
+            return 0
+        todo: List[int] = []
+        with self._mu:
+            # Never fetch past the ready-buffer cap: chains restore
+            # head-first, so fetching a long tail would evict the head —
+            # the part load_chain consumes first — and the evicted
+            # payloads' fetch traffic would be pure waste.
+            budget = self._ready_cap - len(self._ready) - len(self._inflight)
+            for h in chunk_hashes:
+                if budget <= 0:
+                    break
+                if h in self._ready or h in self._inflight:
+                    continue
+                self._inflight.add(h)
+                todo.append(h)
+                budget -= 1
+        if not todo:
+            return 0
+        self._ensure_prefetcher()
+        self._prefetch_q.put(todo)
+        return len(todo)
+
+    def _ensure_prefetcher(self) -> None:
+        if self._prefetch_thread is None or not self._prefetch_thread.is_alive():
+            self._prefetch_thread = threading.Thread(
+                target=self._prefetch_loop, name="kv-tier-prefetch", daemon=True
+            )
+            self._prefetch_thread.start()
+
+    def _prefetch_loop(self) -> None:
+        while True:
+            batch = self._prefetch_q.get()
+            if batch is None:
+                return
+            for h in batch:
+                try:
+                    # On close, drain without fetching: pending batches
+                    # must not hold the connector open through slow-peer
+                    # timeouts after the pod is being torn down.
+                    if not self._closed:
+                        self._prefetch_one(h)
+                except Exception as e:  # noqa: BLE001 - best-effort warming
+                    logger.debug("prefetch failed for %x: %s", h, e)
+                finally:
+                    with self._mu:
+                        self._inflight.discard(h)
+
+    def _prefetch_one(self, chunk_hash: int) -> None:
+        max_size = max(self.codec.page_nbytes, 1)
+        with self._mu:
+            if chunk_hash in self._ready:
+                return
+            staged = chunk_hash in self._staged
+        payload = None
+        source = None
+        if staged:
+            payload = self.connector.fetch_staged(chunk_hash, max_size)
+            source = STAGED
+        if payload is None and self.peer_resolver is not None:
+            addr = self.peer_resolver(chunk_hash)
+            if addr is not None:
+                payload = self.connector.onboard_payload(
+                    addr[0], addr[1], chunk_hash, max_size
+                )
+                source = PEER
+        if payload is None:
+            return
+        with self._mu:
+            self._ready[chunk_hash] = (payload, source)
+            while len(self._ready) > self._ready_cap:
+                self._ready.popitem(last=False)  # payload copies; no event
+        self.stats["prefetched"] += 1
+
+    def close(self) -> None:
+        """Stop the prefetcher (idempotent; safe when it never started).
+        Pending batches drain unfetched — see _prefetch_loop."""
+        self._closed = True
+        if self._prefetch_thread is not None and self._prefetch_thread.is_alive():
+            self._prefetch_q.put(None)
+            self._prefetch_thread.join(timeout=5.0)
+        self._prefetch_thread = None
+
     # -- internals ---------------------------------------------------------
 
     def _stage_many(self, blocks: List[tuple]) -> int:
@@ -217,22 +393,29 @@ class TieredKVStore:
         Returns how many of `blocks` are host-resident afterwards."""
         fresh = []
         n_resident = 0
-        for block in blocks:
-            if block[0] in self._staged:
-                self._staged.move_to_end(block[0])
-                n_resident += 1
-            else:
-                fresh.append(block)
+        with self._mu:
+            for block in blocks:
+                if block[0] in self._staged:
+                    self._staged.move_to_end(block[0])
+                    n_resident += 1
+                else:
+                    fresh.append(block)
         if not fresh:
             return n_resident
         payloads = self.codec.extract_many([b[3] for b in fresh])
         for (chunk_hash, token_ids, parent_hash, _pid, lora_id), payload in zip(
             fresh, payloads
         ):
-            while len(self._staged) >= self.capacity_blocks:
-                victim, _ = self._staged.popitem(last=False)
+            victims: List[int] = []
+            with self._mu:
+                while len(self._staged) >= self.capacity_blocks:
+                    victim, _ = self._staged.popitem(last=False)
+                    victims.append(victim)
+                    self.stats["host_evictions"] += 1
+            # drop() is a server round-trip + event emission — keep it
+            # outside the lock so membership checks never stall on I/O.
+            for victim in victims:
                 self.connector.drop(victim)
-                self.stats["host_evictions"] += 1
             # Per-block isolation: one failed stage must not drop the rest
             # of the wave from the host tier.
             try:
@@ -243,13 +426,15 @@ class TieredKVStore:
             except Exception as e:  # noqa: BLE001 - staging is best-effort
                 logger.debug("stage failed for %x: %s", chunk_hash, e)
                 continue
-            self._staged[chunk_hash] = None
+            with self._mu:
+                self._staged[chunk_hash] = None
             n_resident += 1
         return n_resident
 
     @property
     def staged_count(self) -> int:
-        return len(self._staged)
+        with self._mu:
+            return len(self._staged)
 
 
 class IndexBackedPeerResolver:

@@ -1,0 +1,320 @@
+"""Transfer-vs-recompute gate (engine/costs.py) + async prefetch.
+
+Round-3 measurement this subsystem answers: blind onboarding under
+cache-oblivious routing was 4x WORSE than recompute (BENCH_r03 two_tier
+rr_data_plane_speedup 0.252) because the data plane had no cost gate. The
+gate's economics are pinned here on both rigs' regimes: the tunneled
+bench rig (transfers lose for the benched 1.1B dense model → refuse) and
+the winning regime (wide MQA + int8 KV: few KV bytes per token of compute
+→ admit).
+"""
+
+import threading
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.engine import costs
+from llm_d_kv_cache_manager_tpu.engine.costs import (
+    ALWAYS_TRANSFER,
+    NEVER_TRANSFER,
+    PEER,
+    READY,
+    STAGED,
+    TransferCostModel,
+)
+from llm_d_kv_cache_manager_tpu.engine.tiering import (
+    NullPageCodec,
+    TieredKVStore,
+)
+
+
+class TestEstimators:
+    def test_flops_per_token_tracks_param_count(self):
+        from llm_d_kv_cache_manager_tpu.models.llama import LlamaConfig
+
+        c = LlamaConfig(vocab_size=1024, d_model=256, n_layers=4,
+                        n_q_heads=8, n_kv_heads=4, head_dim=32, d_ff=512)
+        # ~2 flops per matmul parameter: attn + gated MLP + head.
+        attn = 256 * 8 * 32 + 2 * 256 * 4 * 32 + 8 * 32 * 256
+        mlp = 3 * 256 * 512
+        head = 256 * 1024
+        assert costs.flops_per_token(c) == 2.0 * (4 * (attn + mlp) + head)
+
+    def test_moe_counts_only_active_experts(self):
+        from llm_d_kv_cache_manager_tpu.models.mixtral import MixtralConfig
+
+        c = MixtralConfig(vocab_size=256, d_model=64, n_layers=2,
+                          n_q_heads=4, n_kv_heads=2, head_dim=16, d_ff=128,
+                          n_experts=8, top_k=2)
+        dense_like = costs.flops_per_token(c)
+        # top_k=2 of 8 experts: the MLP term must scale by 2, not 8.
+        mlp_all = 2 * 2 * 8 * 3 * 64 * 128
+        mlp_active = 2 * 2 * 2 * 3 * 64 * 128
+        assert dense_like < mlp_all
+        assert dense_like > mlp_active
+
+    def test_kv_bytes_quantized_smaller(self):
+        from llm_d_kv_cache_manager_tpu.models.llama import LlamaConfig
+
+        c = LlamaConfig(vocab_size=256, d_model=128, n_layers=2,
+                        n_q_heads=4, n_kv_heads=2, head_dim=64, d_ff=256)
+        bf16 = costs.kv_bytes_per_token(c)
+        assert bf16 == 2 * 2 * 2 * 64 * 2  # 2(kv) x L x Hkv x hd x 2B
+        int8 = costs.kv_bytes_per_token(c, quantized=True)
+        assert int8 == 2 * 2 * 2 * (64 + 4)
+        assert int8 < bf16
+
+
+class TestAdmitPrefix:
+    def test_cheap_chain_fully_admitted(self):
+        m = TransferCostModel(recompute_s=1e-4, staged_restore_s=1e-5,
+                              onboard_s=2e-5, insert_s=1e-5)
+        assert m.admit_prefix([STAGED, PEER, STAGED], 16) == 3
+
+    def test_expensive_chain_refused(self):
+        m = TransferCostModel(recompute_s=1e-5, staged_restore_s=1e-4,
+                              onboard_s=2e-4, insert_s=1e-4)
+        assert m.admit_prefix([STAGED, STAGED], 16) == 0
+
+    def test_expensive_block_amortized_by_cheap_tail(self):
+        # One peer block at 3x recompute followed by three free ready
+        # blocks: cumulative cost 3 <= cumulative savings 4 at k=4, so the
+        # whole chain is admitted even though block 1 alone is refused.
+        m = TransferCostModel(recompute_s=1.0, staged_restore_s=1.0,
+                              onboard_s=3.0, insert_s=0.0)
+        assert m.admit_prefix([PEER], 1) == 0
+        assert m.admit_prefix([PEER, READY, READY, READY], 1) == 4
+
+    def test_margin_loosens_the_gate(self):
+        m = TransferCostModel(recompute_s=1.0, staged_restore_s=1.5,
+                              onboard_s=1.5, insert_s=1.5)
+        assert m.admit_prefix([STAGED], 4) == 0
+        assert m.with_margin(2.0).admit_prefix([STAGED], 4) == 1
+
+    def test_sentinels(self):
+        assert ALWAYS_TRANSFER.admit_prefix([PEER] * 5, 16) == 5
+        assert NEVER_TRANSFER.admit_prefix([READY], 16) == 0
+
+
+class TestMeasuredSeeding:
+    def test_measured_rates_parse_committed_artifact(self):
+        rates = costs.measured_rates()
+        assert rates is not None, "benchmarking/DEVICE_BENCH.json missing?"
+        assert rates["source"].startswith("measured")
+        for key in ("staged_bytes_per_s", "peer_bytes_per_s",
+                    "insert_bytes_per_s", "compute_flops_per_s"):
+            assert rates[key] > 0
+
+    def test_benched_dense_model_refuses_transfer_on_tunneled_rig(self):
+        """The round-3 regression, now a pinned decision: for the benched
+        1.1B dense model the tunneled rig's measured transfer rates lose
+        to recompute, so the gate must refuse."""
+        from llm_d_kv_cache_manager_tpu.models.llama import LlamaConfig
+
+        rates = costs.measured_rates()
+        if rates is None:
+            pytest.skip("no device bench artifact")
+        bench_cfg = LlamaConfig(
+            vocab_size=32768, d_model=2048, n_layers=16, n_q_heads=16,
+            n_kv_heads=8, head_dim=128, d_ff=8192,
+        )
+        gate = TransferCostModel.for_model(bench_cfg, rates=rates)
+        assert gate.admit_prefix([STAGED] * 8, 64) == 0
+        assert gate.admit_prefix([PEER] * 8, 64) == 0
+
+    def test_wide_mqa_int8_model_admits_transfer(self):
+        """The winning regime: high arithmetic intensity per KV byte.
+        A wide MQA model with int8 KV moves ~1.3KB/token against ~7GF of
+        recompute — transfer wins even at the tunneled rig's rates."""
+        from llm_d_kv_cache_manager_tpu.models.llama import LlamaConfig
+
+        rates = costs.measured_rates()
+        if rates is None:
+            pytest.skip("no device bench artifact")
+        wide = LlamaConfig(
+            vocab_size=32768, d_model=8192, n_layers=4, n_q_heads=64,
+            n_kv_heads=1, head_dim=128, d_ff=28672,
+        )
+        gate = TransferCostModel.for_model(wide, quantized=True, rates=rates)
+        assert gate.admit_prefix([STAGED] * 8, 64) == 8
+
+    def test_assumed_rates_used_without_artifact(self, tmp_path):
+        assert costs.measured_rates(str(tmp_path / "nope.json")) is None
+        from llm_d_kv_cache_manager_tpu.models.llama import LlamaConfig
+
+        gate = TransferCostModel.for_model(
+            LlamaConfig(), rates=costs.ASSUMED_RATES
+        )
+        assert gate.source.startswith("assumed")
+
+
+class _FakeConnector:
+    """Host store as a dict; 'peer' fetches recorded for assertions."""
+
+    def __init__(self):
+        self.store = {}
+        self.dropped = []
+        self.fetches = []
+
+    def stage(self, block_hash, payload, token_ids, block_size,
+              parent_hash=None, lora_id=None):
+        self.store[block_hash] = payload
+
+    def fetch_staged(self, block_hash, max_size):
+        self.fetches.append(("staged", block_hash))
+        return self.store.get(block_hash)
+
+    def onboard_payload(self, host, port, block_hash, max_size):
+        self.fetches.append(("peer", block_hash))
+        return b""
+
+    def drop(self, block_hash):
+        self.dropped.append(block_hash)
+
+
+def _store(cost_model=None, **kw):
+    return TieredKVStore(
+        _FakeConnector(), NullPageCodec(), cost_model=cost_model, **kw
+    )
+
+
+class TestGatedStore:
+    def test_plan_restore_truncates_and_counts(self):
+        store = _store(cost_model=NEVER_TRANSFER)
+        store.export_blocks([(h, [1, 2], None, 0, None) for h in (10, 11)])
+        assert store.plan_restore([10, 11]) == 0
+        assert store.stats["gated_blocks"] == 2
+
+    def test_ungated_store_admits_everything(self):
+        store = _store(cost_model=None)
+        store.export_blocks([(h, [1, 2], None, 0, None) for h in (10, 11)])
+        assert store.plan_restore([10, 11]) == 2
+
+    def test_prefetch_makes_blocks_ready_and_load_chain_consumes(self):
+        store = _store(cost_model=ALWAYS_TRANSFER)
+        store.export_blocks([(7, [1, 2], None, 0, None)])
+        assert store.prefetch([7]) == 1
+        deadline = threading.Event()
+        for _ in range(100):
+            if store.stats["prefetched"] == 1:
+                break
+            deadline.wait(0.02)
+        assert store.stats["prefetched"] == 1
+        store.connector.fetches.clear()
+        landed = store.load_chain([(7, [1, 2], None)], lambda k: list(range(k)))
+        assert landed == [0]
+        assert store.stats["ready_hits"] == 1
+        # The payload came from the ready buffer — no fetch on this path.
+        assert store.connector.fetches == []
+        store.close()
+
+    def test_prefetch_gated_off_when_insert_loses(self):
+        store = _store(cost_model=NEVER_TRANSFER)
+        store.export_blocks([(7, [1, 2], None, 0, None)])
+        assert store.prefetch([7]) == 0
+
+    def test_prefetch_dedupes_inflight(self):
+        store = _store(cost_model=None)
+        store.export_blocks([(7, [1, 2], None, 0, None)])
+        n1 = store.prefetch([7, 7])
+        assert n1 == 1
+        store.close()
+
+    def test_prefetch_bounded_by_ready_cap_head_first(self):
+        """Chains restore head-first: fetching past the ready-buffer cap
+        would evict the head for a tail load_chain can't use yet."""
+        store = _store(cost_model=None, prefetch_capacity_blocks=4)
+        store.export_blocks(
+            [(h, [1, 2], None, 0, None) for h in range(100, 140)]
+        )
+        queued = store.prefetch(list(range(100, 140)))
+        assert queued == 4
+        for _ in range(200):
+            if store.stats["prefetched"] == 4:
+                break
+            threading.Event().wait(0.01)
+        with store._mu:
+            assert list(store._ready) == [100, 101, 102, 103]  # the head
+        store.close()
+
+    def test_load_chain_regates_when_ready_entry_evicted(self):
+        """TOCTOU guard: a chain admitted at READY (insert-only) cost whose
+        ready entry got evicted must NOT silently pay the staged/peer
+        fetch the gate refuses — the round-3 regression path."""
+        insert_wins_staged_loses = TransferCostModel(
+            recompute_s=1.0, staged_restore_s=10.0, onboard_s=10.0,
+            insert_s=0.0,
+        )
+        store = _store(cost_model=insert_wins_staged_loses)
+        store.export_blocks([(7, [1, 2], None, 0, None)])
+        assert store.prefetch([7]) == 1
+        for _ in range(200):
+            if store.stats["prefetched"] == 1:
+                break
+            threading.Event().wait(0.01)
+        assert store.plan_restore([7]) == 1  # admitted at READY cost
+        store.connector.fetches.clear()  # drop the prefetcher's own fetch
+        with store._mu:  # simulate cap churn evicting the entry
+            store._ready.clear()
+        landed = store.load_chain([(7, [1, 2], None)], lambda k: list(range(k)))
+        assert landed == []
+        assert store.connector.fetches == []  # the refused fetch never ran
+        store.close()
+
+
+class TestEngineAutoGate:
+    def test_model_pod_gets_model_seeded_gate(self):
+        from llm_d_kv_cache_manager_tpu.engine.engine import (
+            EnginePod,
+            EnginePodConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.models.llama import LlamaConfig
+
+        pod = EnginePod(EnginePodConfig(
+            n_pages=4, page_size=4, with_model=True,
+            model_config=LlamaConfig(), enable_host_tier=True,
+        ))
+        try:
+            assert pod.tier_store.cost_model is not None
+            assert pod.tier_store.cost_model.recompute_s > 0
+        finally:
+            pod.close()
+
+    def test_accounting_pod_is_ungated(self):
+        from llm_d_kv_cache_manager_tpu.engine.engine import (
+            EnginePod,
+            EnginePodConfig,
+        )
+
+        pod = EnginePod(EnginePodConfig(
+            n_pages=4, page_size=4, enable_host_tier=True,
+        ))
+        try:
+            assert pod.tier_store.cost_model is None
+        finally:
+            pod.close()
+
+    def test_scheduler_submit_prefetches(self):
+        from llm_d_kv_cache_manager_tpu.engine.engine import (
+            EnginePod,
+            EnginePodConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.engine.scheduler import Scheduler
+        from llm_d_kv_cache_manager_tpu.models.llama import LlamaConfig
+
+        pod = EnginePod(EnginePodConfig(
+            n_pages=16, page_size=4, with_model=True,
+            model_config=LlamaConfig(), enable_host_tier=True,
+            transfer_cost_model=ALWAYS_TRANSFER,
+        ))
+        try:
+            calls = []
+            orig = pod.prefetch
+            pod.prefetch = lambda toks, lora_id=None: calls.append(
+                (list(toks), lora_id)
+            ) or orig(toks, lora_id)
+            sched = Scheduler(pod, max_batch=2)
+            sched.submit(list(range(8)), max_new_tokens=1)
+            assert calls and calls[0][0] == list(range(8))
+        finally:
+            pod.close()

@@ -11,6 +11,7 @@ trip, so the per-page forms paid O(components x pages) RPCs per chain.
 import numpy as np
 import pytest
 
+from llm_d_kv_cache_manager_tpu.engine.costs import ALWAYS_TRANSFER
 from llm_d_kv_cache_manager_tpu.engine.block_manager import (
     BlockManager,
     BlockManagerConfig,
@@ -279,6 +280,8 @@ class TestTieredBatchIntegration:
                 EnginePodConfig(
                     pod_id=pod_id, n_pages=8, page_size=4, device_tier="hbm",
                     with_model=True, model_config=mc, enable_host_tier=True,
+                    # Mechanics test: economics gating is test_costs.py's job.
+                    transfer_cost_model=ALWAYS_TRANSFER,
                 ),
                 params=params,
             )

@@ -18,6 +18,7 @@ and the BASELINE.json north star.
 import numpy as np
 import pytest
 
+from llm_d_kv_cache_manager_tpu.engine.costs import ALWAYS_TRANSFER
 from llm_d_kv_cache_manager_tpu.engine.engine import EnginePod, EnginePodConfig
 from llm_d_kv_cache_manager_tpu.engine.tiering import IndexBackedPeerResolver
 from llm_d_kv_cache_manager_tpu.kv_connectors.connector import native_available
@@ -173,6 +174,9 @@ class TestCrossPodOnboard:
                     page_size=page_size, device_tier="hbm", with_model=True,
                     model_config=mc, enable_host_tier=True,
                     use_quantized_kv=quantized,
+                    # This test pins onboard MECHANICS; the economics gate
+                    # (engine/costs.py) is pinned by tests/test_costs.py.
+                    transfer_cost_model=ALWAYS_TRANSFER,
                 ),
                 event_sink=sink_for(pod_id),
                 params=params,
