@@ -53,6 +53,65 @@ def have_vllm() -> bool:
         return False
 
 
+def run_with_vllm(indexer, endpoint):
+    """The real path (VERDICT r3 #7): an actual vLLM engine publishing
+    KVEvents over ZMQ at this indexer, scored non-zero for a served prompt.
+    Mirrors /root/reference/examples/kv_events/vllm/vllm_kv_cache_demo.py:
+    46-60. Requirements for hash parity (silently zero scores otherwise):
+    PYTHONHASHSEED set and equal to the indexer's hash_seed, block_size
+    aligned, and — on vLLM builds where the builtin algo doesn't match this
+    indexer's CBOR+FNV scheme — the matched algo from
+    tests/fixtures/kv_event_vllm.json passed as prefix-caching hash algo."""
+    from vllm import LLM, SamplingParams
+    from vllm.config import KVEventsConfig
+
+    model_id = os.environ.get("KVTPU_VLLM_MODEL", "Qwen/Qwen2.5-0.5B-Instruct")
+    pod_id = "vllm-pod-0"
+    engine_kwargs = dict(
+        model=model_id,
+        enforce_eager=True,
+        enable_prefix_caching=True,
+        block_size=BLOCK_SIZE,
+        max_model_len=1024,
+        kv_events_config=KVEventsConfig(
+            enable_kv_cache_events=True,
+            publisher="zmq",
+            endpoint=endpoint,  # engine connects OUT; subscriber binds
+            topic=f"kv@{pod_id}@{model_id}",
+        ),
+    )
+    algo = os.environ.get("KVTPU_VLLM_HASH_ALGO")
+    if algo and algo != "builtin":
+        engine_kwargs["prefix_caching_hash_algo"] = algo
+    llm = LLM(**engine_kwargs)
+    time.sleep(0.5)  # ZMQ slow-joiner
+
+    prompt = "The quick brown fox jumps over the lazy dog. " * 12
+    llm.generate([prompt], SamplingParams(max_tokens=4))
+
+    def pod_score(scores):
+        # DP-rank-stamped engines index as "<pod>@dpN" (kvevents/pool.py
+        # appends the rank) — match either identity.
+        return sum(
+            s for p, s in scores.items()
+            if p == pod_id or p.startswith(pod_id + "@dp")
+        )
+
+    deadline = time.time() + 30
+    scores = {}
+    while time.time() < deadline:
+        scores = indexer.get_pod_scores(prompt, model_id, [])
+        if pod_score(scores):
+            break
+        time.sleep(0.2)
+    print(f"[indexer] scores from real vLLM events: {scores}")
+    assert pod_score(scores) > 0, (
+        "indexer never scored the vLLM pod: check PYTHONHASHSEED/hash_seed "
+        "alignment, block_size, and KVTPU_VLLM_HASH_ALGO (see "
+        "tests/fixtures/kv_event_vllm.json matched_algo)"
+    )
+
+
 def run_with_engine_pod(indexer, event_pool, endpoint):
     """Fallback: the in-repo paged-KV engine publishing real ZMQ KVEvents."""
     from llm_d_kv_cache_manager_tpu.engine.engine import EnginePod, EnginePodConfig
@@ -87,6 +146,11 @@ def run_with_engine_pod(indexer, event_pool, endpoint):
 
 
 def main():
+    require_vllm = "--require-vllm" in sys.argv
+    use_vllm = have_vllm()
+    if require_vllm and not use_vllm:
+        sys.exit("--require-vllm: vllm is not importable in this environment")
+
     endpoint = f"ipc://{tempfile.gettempdir()}/kvvllm-{uuid.uuid4().hex[:8]}.sock"
     indexer = Indexer(
         config=IndexerConfig(
@@ -96,7 +160,14 @@ def main():
             )
         ),
         tokenization_pool=TokenizationPool(
-            TokenizersPoolConfig(workers=2, local_tokenizer_files={MODEL: FIXTURE})
+            TokenizersPoolConfig(
+                workers=2,
+                local_tokenizer_files={MODEL: FIXTURE},
+                # Real-vLLM mode scores prompts for the engine's HF model,
+                # so the read path needs the same tokenizer (composite
+                # fallback: local fixture first, HF hub second).
+                enable_hf=use_vllm,
+            )
         ),
     )
     indexer.run()
@@ -108,9 +179,9 @@ def main():
     event_pool.start(with_subscriber=True)
 
     try:
-        if have_vllm():
-            print("vLLM detected — configure KVEventsConfig as in the module "
-                  f"docstring with endpoint {endpoint} and run your model.")
+        if use_vllm:
+            print(f"vLLM detected — running the real engine at {endpoint}.")
+            run_with_vllm(indexer, endpoint)
         else:
             print("vLLM not installed; using the in-repo EnginePod stand-in.")
             run_with_engine_pod(indexer, event_pool, endpoint)

@@ -220,8 +220,12 @@ class TestVllmVectors:
     """Third-party vectors computed by vLLM's own block hashing (VERDICT r2
     missing #1). The fixture is produced by
     tests/fixtures/generate_vllm_vectors.py on a machine with a CPU vllm
-    install (this build image has neither vllm nor egress, so the test
-    skips until the JSON is committed)."""
+    install (the CI `vllm-interop` job; this build image has neither vllm
+    nor egress, so the test skips until the JSON is committed). The
+    generator records every hash algorithm the installed vLLM exposes and
+    which one this repo reproduces (`matched_algo`) — a fleet pins that
+    algorithm via vLLM's --prefix-caching-hash-algo and the indexer's
+    hash_seed."""
 
     def test_chunked_token_database_reproduces_vllm_hashes(self):
         import pytest
@@ -230,7 +234,8 @@ class TestVllmVectors:
         if not path.exists():
             pytest.skip(
                 "kv_event_vllm.json not generated (needs a vllm install; "
-                "see tests/fixtures/generate_vllm_vectors.py)"
+                "see tests/fixtures/generate_vllm_vectors.py / the CI "
+                "vllm-interop job)"
             )
         from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
             ChunkedTokenDatabase,
@@ -240,7 +245,24 @@ class TestVllmVectors:
         from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key as _Key
 
         data = json.loads(path.read_text())
-        for vec in data["vectors"]:
+        # An existing fixture with no matching algorithm is a FAILURE, not
+        # a skip: it means vLLM offers no configuration this indexer can
+        # score against — the keystone must never pass silently.
+        matched = data.get("matched_algo")
+        assert matched is not None, (
+            f"kv_event_vllm.json (vLLM {data['vllm_version']}, algos "
+            f"{data.get('algos')}) has matched_algo=None: no vLLM hash "
+            "algorithm reproduces ChunkedTokenDatabase's scheme"
+        )
+        vectors = [
+            v for v in data["vectors"] if v.get("algo", matched) == matched
+        ]
+        assert vectors, "fixture carries no vectors for the matched algo"
+        cases = {v["case"] for v in vectors}
+        assert {"base", "seeded", "parent_chain", "lora"} <= cases, (
+            f"fixture covers only {sorted(cases)}"
+        )
+        for vec in vectors:
             db = ChunkedTokenDatabase(
                 TokenProcessorConfig(
                     block_size=data["block_size"], hash_seed=vec["seed"]
@@ -255,6 +277,7 @@ class TestVllmVectors:
             )
             got = [k.chunk_hash for k in keys]
             assert got == vec["hashes"], (
-                f"case {vec['case']}: vLLM {data['vllm_version']} hashes "
-                "diverge from ChunkedTokenDatabase"
+                f"case {vec['case']} (algo {matched}): vLLM "
+                f"{data['vllm_version']} hashes diverge from "
+                "ChunkedTokenDatabase"
             )

@@ -10,6 +10,7 @@ behavior suite runs through `resp.py` against it; absent the binary the
 module skips (this build image ships neither, CI images may).
 """
 
+import os
 import shutil
 import socket
 import subprocess
@@ -24,10 +25,16 @@ from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import (
 from tests.test_index import TestCommonIndexBehavior as _CommonBehavior
 
 SERVER_BIN = shutil.which("valkey-server") or shutil.which("redis-server")
+# A reachable server beats a local binary: CI provisions redis as a service
+# container (no binary on PATH, port on localhost — .github/workflows/
+# ci.yml) and exports KVTPU_REDIS_URL. The suite FLUSHALLs, so the URL must
+# point at a DISPOSABLE instance.
+EXTERNAL_URL = os.environ.get("KVTPU_REDIS_URL")
 
 pytestmark = pytest.mark.skipif(
-    SERVER_BIN is None,
-    reason="no valkey-server/redis-server binary on PATH",
+    SERVER_BIN is None and EXTERNAL_URL is None,
+    reason="no valkey-server/redis-server binary on PATH and no "
+           "KVTPU_REDIS_URL pointing at a disposable server",
 )
 
 
@@ -39,6 +46,26 @@ def _free_port() -> int:
 
 @pytest.fixture(scope="module")
 def real_server_url():
+    if EXTERNAL_URL is not None:
+        from urllib.parse import urlparse
+
+        # Same parse resp.py applies (handles redis://host:port/db etc.);
+        # bare host:port gets a scheme so urlparse sees a netloc.
+        raw = EXTERNAL_URL if "://" in EXTERNAL_URL else f"redis://{EXTERNAL_URL}"
+        parsed = urlparse(raw)
+        host = parsed.hostname or "127.0.0.1"
+        port = parsed.port or 6379
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            try:
+                with socket.create_connection((host, port), timeout=0.5):
+                    break
+            except OSError:
+                time.sleep(0.1)
+        else:
+            pytest.skip(f"KVTPU_REDIS_URL {EXTERNAL_URL} unreachable")
+        yield EXTERNAL_URL
+        return
     port = _free_port()
     proc = subprocess.Popen(
         [
@@ -100,7 +127,6 @@ class TestRealServerSpecific:
     def test_outage_cuts_chain_then_recovers(self, real_server_url):
         from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
 
-        port = int(real_server_url.rsplit(":", 1)[1])
         idx = RedisIndex(RedisIndexConfig(url=real_server_url, timeout_s=1.0))
         try:
             key = Key("m", 9)
