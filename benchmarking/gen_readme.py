@@ -123,6 +123,32 @@ def fleet_section() -> str:
             "for the benched dense model, so enabling the data plane can "
             "no longer regress TTFT.",
         ]
+    ladder = stats.get("qps_ladder") or {}
+    if ladder:
+        lines += [
+            "",
+            "TTFT vs arrival rate (the reference's QPS-ladder shape, "
+            "`37-capacity/README.md:342-347` — precise holds sub-second "
+            "TTFT while cache-oblivious arms explode once prefill queues "
+            "stop clearing):",
+            "",
+            "| QPS | precise p50/p90 (s) | load p50/p90 (s) "
+            "| round-robin p50/p90 (s) | precise vs rr (p90) |",
+            "|---:|---:|---:|---:|---:|",
+        ]
+        for name, row in sorted(
+            ladder.items(), key=lambda kv: float(kv[0].split("_")[1])
+        ):
+            qps = name.split("_")[1]
+            lines.append(
+                f"| {qps} "
+                f"| **{row['precise']['ttft_p50_s']} / "
+                f"{row['precise']['ttft_p90_s']}** "
+                f"| {row['load']['ttft_p50_s']} / {row['load']['ttft_p90_s']} "
+                f"| {row['round_robin']['ttft_p50_s']} / "
+                f"{row['round_robin']['ttft_p90_s']} "
+                f"| {row['precise_vs_round_robin_p90']}× |"
+            )
     wr = stats.get("data_plane_winning_regime") or {}
     if "cold_ttft_p50_speedup" in wr:
         lines += [
