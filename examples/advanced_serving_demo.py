@@ -80,10 +80,18 @@ def demo_two_tier():
         return s
 
     def pod(pod_id):
+        from llm_d_kv_cache_manager_tpu.engine.costs import ALWAYS_TRANSFER
+
         return EnginePod(EnginePodConfig(
             pod_id=pod_id, model_name=MODEL, n_pages=32, page_size=PAGE,
             device_tier="hbm", with_model=True, model_config=CFG,
             enable_host_tier=True,
+            # This demo shows onboard MECHANICS, so the economics gate is
+            # pinned open. The default ("auto") gate would refuse: for a
+            # toy model on this rig's measured rates, recomputing a block
+            # is cheaper than moving it (engine/costs.py — exactly the
+            # decision that keeps the data plane from regressing TTFT).
+            transfer_cost_model=ALWAYS_TRANSFER,
         ), event_sink=sink(pod_id), params=PARAMS)
 
     a, b = pod("pod-a"), pod("pod-b")

@@ -177,8 +177,14 @@ def _dense_attention(
     n_kv = k.shape[2]
     group = n_q // n_kv
     qg = q.reshape(b, l, n_kv, group, hd)
+    # Keep matmul OPERANDS in the model dtype and accumulate in f32
+    # (preferred_element_type): on TPU a bf16xbf16->f32 matmul runs at the
+    # full MXU rate, while upcasting the operands first would run the two
+    # big einsums at the f32 rate (half or worse) AND double their operand
+    # bytes. Softmax still happens in f32 (the accumulated dtype), which is
+    # exactly the flash-kernel numerics.
     scores = jnp.einsum(
-        "blhgd,bshd->bhgls", qg.astype(jnp.float32), k.astype(jnp.float32)
+        "blhgd,bshd->bhgls", qg, k, preferred_element_type=jnp.float32
     ) / (hd**0.5)
     q_pos = jnp.arange(l)[None, :, None]
     k_pos = jnp.arange(k.shape[1])[None, None, :]
@@ -188,7 +194,10 @@ def _dense_attention(
         mask = mask & (k_pos > (q_pos + offset - window))
     scores = jnp.where(mask[:, None, None], scores, -jnp.inf)
     weights = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhgls,bshd->blhgd", weights, v.astype(jnp.float32))
+    out = jnp.einsum(
+        "bhgls,bshd->blhgd", weights.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
     return out.reshape(b, l, n_q, hd).astype(q.dtype)
 
 
