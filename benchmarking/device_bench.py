@@ -300,6 +300,28 @@ def bench_decode_multistep(config, params, batch, ctx, step_counts,
     return rows
 
 
+def bench_prefill_flash(config, params, seq_lens, fidelity_flags,
+                        measured_peak) -> list:
+    """Prefill through the Pallas flash kernel (ops/flash_prefill.py) for
+    a side-by-side with the jnp rows: the kernel removes the O(L*S) f32
+    score tensor's HBM round trips. The gate reads the env at trace time,
+    so flip it, clear the jit caches, measure, restore."""
+    if jax.default_backend() != "tpu":
+        return [{"skipped": "flash prefill kernel path needs TPU"}]
+    prev = os.environ.get("KVTPU_FLASH_PREFILL")
+    os.environ["KVTPU_FLASH_PREFILL"] = "1"
+    jax.clear_caches()
+    try:
+        return bench_prefill(config, params, seq_lens, fidelity_flags,
+                             measured_peak)
+    finally:
+        if prev is None:
+            os.environ.pop("KVTPU_FLASH_PREFILL", None)
+        else:
+            os.environ["KVTPU_FLASH_PREFILL"] = prev
+        jax.clear_caches()
+
+
 def bench_pipeline_depth(config, params, batch, ctx, depths) -> list:
     """Validate _PIPELINE_DEPTH > 2 on chip (VERDICT r3 #4; the constant's
     own comment defers deeper lookahead to exactly this measurement). The
@@ -609,6 +631,9 @@ def main():
         "matmul_calibration": calib,
         "prefill": bench_prefill(config, params, seqs, fidelity_flags,
                                  measured_peak),
+        "prefill_flash": bench_prefill_flash(
+            config, params, seqs, fidelity_flags, measured_peak
+        ),
         "decode": bench_decode(config, params, batches, ctx, fidelity_flags),
         "decode_multistep": bench_decode_multistep_grid(
             config, params, multistep_grid, ctx, fidelity_flags,

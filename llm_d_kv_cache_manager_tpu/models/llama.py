@@ -163,6 +163,34 @@ def _mlp_dispatch(config, layer: Dict, x: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
+def _serving_attention(q, k, v, causal_offset, window=None):
+    """Attention for the SERVING prefill/verify paths only: dispatches to
+    the Pallas flash-prefill kernel (ops/flash_prefill.py) when the opt-in
+    gate opens, else the jnp oracle. The training paths (forward_dense,
+    mixtral, pipeline) call _dense_attention directly — pallas_call has no
+    autodiff rule, so the kernel must never sit under value_and_grad."""
+    if _flash_prefill_wanted(q.shape[1], k.shape[1], q.shape[3]):
+        from llm_d_kv_cache_manager_tpu.ops.flash_prefill import flash_prefill
+
+        return flash_prefill(q, k, v, causal_offset, window=window)
+    return _dense_attention(q, k, v, causal_offset, window=window)
+
+
+def _flash_prefill_wanted(l: int, s: int, hd: int) -> bool:
+    """Opt-in gate for the Pallas flash-prefill kernel: set
+    KVTPU_FLASH_PREFILL=1 on a TPU backend, with MXU-shaped heads and
+    enough sequence for the blockwise pipeline to pay off. Off by default
+    until a chip session validates the win; the jnp path is the semantics
+    oracle either way."""
+    import os
+
+    if os.environ.get("KVTPU_FLASH_PREFILL") != "1":
+        return False
+    if hd % 128 or l < 256 or s < 256:
+        return False
+    return jax.default_backend() == "tpu"
+
+
 def _dense_attention(
     q: jax.Array,  # [B, L, n_q, hd]
     k: jax.Array,  # [B, S, n_kv, hd]
@@ -397,7 +425,7 @@ def prefill_cache(
 
         # Attend to everything cached so far (prefix + new), causally.
         k_all, v_all = _cache_gather_dense(cache, block_table, c.dtype)
-        attn = _dense_attention(q, k_all, v_all, start_pos,
+        attn = _serving_attention(q, k_all, v_all, start_pos,
                                 window=c.sliding_window)
         x = x + attn.reshape(1, l, c.q_dim) @ layer["wo"]
         h = rms_norm(x, layer["mlp_norm"], c.rms_eps)
@@ -770,7 +798,7 @@ def verify_step_cache(
         v_all = jnp.swapaxes(
             v_all.reshape(b, c.n_kv_heads, max_ctx, c.head_dim), 1, 2
         )
-        attn = _dense_attention(q, k_all, v_all, start_positions,
+        attn = _serving_attention(q, k_all, v_all, start_positions,
                                 window=c.sliding_window)
         x = x + attn.reshape(b, s, c.q_dim) @ layer["wo"]
         h = rms_norm(x, layer["mlp_norm"], c.rms_eps)

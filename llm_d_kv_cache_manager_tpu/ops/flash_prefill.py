@@ -1,0 +1,226 @@
+"""Flash prefill for TPU: blockwise online-softmax attention in Pallas.
+
+The jnp prefill path (`models/llama._dense_attention`) materializes the
+[L, S] score tensor in f32 through HBM — at seq 2048 that is ~270MB per
+layer written and re-read (scores, then softmax weights), which is why
+prefill sat at ~20% MFU on chip while its marginal matmul rate was ~46%
+(benchmarking/DEVICE_BENCH.json). This kernel is the standard flash
+restructuring: Q tiles stay resident in VMEM while K/V tiles stream
+through the Pallas pipeline, the softmax runs online (running max /
+normalizer / accumulator in VMEM scratch, exactly like this repo's
+flash-decoding kernel in ops/paged_attention.py), and nothing O(L*S)
+ever touches HBM.
+
+Causality without wasted bandwidth: the K/V BlockSpec index maps CLAMP
+the k-block index into each q-block's live range
+[first_window_block, last_causal_block] (computed from the scalar-
+prefetched per-batch causal offsets). Pallas only issues a DMA when the
+mapped block index CHANGES between grid steps, so the upper-triangle
+iterations re-map to the diagonal block and move zero bytes; compute for
+them is skipped with pl.when. The sliding-window case clamps from below
+the same way.
+
+Semantics are exactly `_dense_attention`'s (the test oracle): q position
+i attends k positions <= causal_offset + i, optionally windowed to
+(causal_offset + i - window, causal_offset + i]. Used by the serving
+prefill/verify paths behind an opt-in gate (models/llama.py) until the
+chip run validates it; `interpret=True` runs it on CPU for parity tests.
+
+Reference anchor: the reference has no device math at all (SURVEY.md
+§2.5) — this is TPU-build engine surface, built for the MXU/HBM balance.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_BLOCK_Q = 128
+_BLOCK_K = 512
+_LANE = 128  # f32 scratch tile lane width
+
+
+def _flash_kernel(
+    offs_ref,  # SMEM [B] int32 causal offsets (scalar prefetch)
+    q_ref,  # VMEM (1, 1, group, block_q, hd)
+    k_ref,  # VMEM (1, 1, block_k, hd)
+    v_ref,  # VMEM (1, 1, block_k, hd)
+    o_ref,  # VMEM (1, 1, group, block_q, hd)
+    m_scratch,  # VMEM (rows, _LANE) f32
+    l_scratch,  # VMEM (rows, _LANE) f32
+    acc_scratch,  # VMEM (rows, hd) f32
+    *,
+    block_q: int,
+    block_k: int,
+    n_k_blocks: int,
+    s_real: int,
+    scale: float,
+    window: "int | None",
+):
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    off = offs_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, -jnp.inf)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    # Live k-block range for this q block (the index maps clamp the DMA to
+    # the same range; out-of-range iterations skip compute entirely).
+    last_blk = (i * block_q + block_q - 1 + off) // block_k
+    if window is None:
+        first_blk = 0
+    else:
+        first_blk = jnp.maximum(i * block_q + off - window + 1, 0) // block_k
+
+    @pl.when((j >= first_blk) & (j <= last_blk))
+    def _attend():
+        group, bq, hd = q_ref.shape[2], q_ref.shape[3], q_ref.shape[4]
+        rows = group * bq
+        # Operands stay in the model dtype; only the ACCUMULATION is f32
+        # (preferred_element_type) — a bf16xbf16->f32 matmul runs at the
+        # full MXU rate, upcasting operands first would halve it (the same
+        # rule the jnp path documents in _dense_attention).
+        q = q_ref[0, 0].reshape(rows, hd)
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (rows, block_k) f32
+
+        # Row r of the flattened (group, q) tile holds q position
+        # i*block_q + (r % block_q); the group index never affects masks.
+        row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        q_pos = i * block_q + jax.lax.rem(row, bq)
+        k_pos = j * block_k + col
+        valid = (k_pos <= q_pos + off) & (k_pos < s_real)
+        if window is not None:
+            valid = valid & (k_pos > q_pos + off - window)
+        s = jnp.where(valid, s, -jnp.inf)
+
+        m_prev = m_scratch[:, :1]
+        l_prev = l_scratch[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # A fully-masked tile row keeps m == -inf; exp(-inf - -inf) is NaN,
+        # so pin the rescale factor to 0 there (nothing accumulated yet).
+        alpha = jnp.where(
+            m_new == -jnp.inf, 0.0, jnp.exp(m_prev - m_new)
+        )
+        p = jnp.exp(s - jnp.where(m_new == -jnp.inf, 0.0, m_new))
+        p = jnp.where(valid, p, 0.0)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+
+        acc_scratch[:] = acc_scratch[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scratch[:] = jnp.broadcast_to(m_new, m_scratch.shape)
+        l_scratch[:] = jnp.broadcast_to(l_new, l_scratch.shape)
+
+    @pl.when(j == n_k_blocks - 1)
+    def _emit():
+        group, bq, hd = o_ref.shape[2], o_ref.shape[3], o_ref.shape[4]
+        l_final = l_scratch[:, :1]
+        out = acc_scratch[:] / jnp.where(l_final == 0, 1.0, l_final)
+        o_ref[0, 0] = out.reshape(group, bq, hd).astype(o_ref.dtype)
+
+
+def flash_prefill(
+    q: jax.Array,  # [B, L, n_q, hd]
+    k: jax.Array,  # [B, S, n_kv, hd]
+    v: jax.Array,  # [B, S, n_kv, hd]
+    causal_offset,  # scalar or [B] int32
+    window: "int | None" = None,
+    *,
+    block_q: int = _BLOCK_Q,
+    block_k: int = _BLOCK_K,
+    interpret: bool = False,
+) -> jax.Array:
+    """Drop-in for `_dense_attention` (same signature semantics)."""
+    b, l, n_q, hd = q.shape
+    s_real = k.shape[1]
+    n_kv = k.shape[2]
+    group = n_q // n_kv
+    if group * n_kv != n_q:
+        raise ValueError(f"n_q {n_q} not divisible by n_kv {n_kv}")
+    scale = 1.0 / (hd**0.5)
+    block_q = min(block_q, max(8, l))
+    block_k = min(block_k, max(128, s_real))
+
+    offs = jnp.broadcast_to(
+        jnp.asarray(causal_offset, jnp.int32), (b,)
+    )
+
+    l_pad = -l % block_q
+    s_pad = -s_real % block_k
+    # Head-major tiles: q [B, n_kv, group, Lp, hd]; k/v [B, n_kv, Sp, hd].
+    qh = jnp.moveaxis(
+        q.reshape(b, l, n_kv, group, hd), 1, 3
+    )
+    if l_pad:
+        qh = jnp.pad(qh, ((0, 0),) * 3 + ((0, l_pad), (0, 0)))
+    kh = jnp.moveaxis(k, 1, 2)
+    vh = jnp.moveaxis(v, 1, 2)
+    if s_pad:
+        pad = ((0, 0), (0, 0), (0, s_pad), (0, 0))
+        kh = jnp.pad(kh, pad)
+        vh = jnp.pad(vh, pad)
+
+    n_q_blocks = qh.shape[3] // block_q
+    n_k_blocks = kh.shape[2] // block_k
+    rows = group * block_q
+
+    def kv_index(b_, h, i, j, offs_ref):
+        last = (i * block_q + block_q - 1 + offs_ref[b_]) // block_k
+        if window is None:
+            first = 0
+        else:
+            first = (
+                jnp.maximum(i * block_q + offs_ref[b_] - window + 1, 0)
+                // block_k
+            )
+        return (b_, h, jnp.clip(j, first, last), 0)
+
+    q_spec = pl.BlockSpec(
+        (1, 1, group, block_q, hd), lambda b_, h, i, j, offs_ref: (b_, h, 0, i, 0)
+    )
+    kv_spec = pl.BlockSpec((1, 1, block_k, hd), kv_index)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel, block_q=block_q, block_k=block_k,
+            n_k_blocks=n_k_blocks, s_real=s_real, scale=scale,
+            window=window,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, n_kv, n_q_blocks, n_k_blocks),
+            in_specs=[q_spec, kv_spec, kv_spec],
+            out_specs=q_spec,
+            scratch_shapes=[
+                pltpu.VMEM((rows, _LANE), jnp.float32),
+                pltpu.VMEM((rows, _LANE), jnp.float32),
+                pltpu.VMEM((rows, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=interpret,
+    )(offs, qh, kh, vh)
+
+    out = jnp.moveaxis(out, 3, 1)[:, :l]  # [B, L, n_kv, group, hd]
+    return out.reshape(b, l, n_q, hd)
