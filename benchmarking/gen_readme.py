@@ -246,6 +246,29 @@ def device_section() -> str:
             else "Overhead-corrected prefill analysis unavailable for this run "
                  "(needs >=2 seq lengths with increasing times)."
         ),
+    ]
+    flash_rows = [r for r in d.get("prefill_flash", []) if "seq" in r]
+    if flash_rows:
+        jnp_by_seq = {r["seq"]: r for r in d["prefill"]}
+        out += [
+            "",
+            "Flash-prefill kernel (`ops/flash_prefill.py`: blockwise online "
+            "softmax, no O(L·S) score tensor through HBM) vs the jnp path, "
+            "same shapes:",
+            "",
+            "| seq | jnp ms | flash ms | speedup | flash MFU (vs calibration) |",
+            "|---:|---:|---:|---:|---:|",
+        ]
+        for r in flash_rows:
+            base = jnp_by_seq.get(r["seq"])
+            speedup = (
+                f"{base['ms'] / r['ms']:.2f}×" if base and r["ms"] else "—"
+            )
+            out.append(
+                f"| {r['seq']} | {base['ms'] if base else '—'} | {r['ms']} "
+                f"| {speedup} | {r['mfu_vs_measured_matmul_peak']:.1%} |"
+            )
+    out += [
         "",
         "Decode (paged flash-decoding kernel, ctx 2048). `HBM floor` is the "
         "physical minimum step time (weights + KV across the bus once); the "
