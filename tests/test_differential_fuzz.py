@@ -1,0 +1,169 @@
+"""Differential fuzz: every index backend vs an executable semantics model.
+
+The reference pins backend equivalence with a shared example-based suite
+(/root/reference/pkg/kvcache/kvblock/index_test.go:35-63); this extends it
+with randomized op sequences — add/evict/lookup/get_request_key in every
+interleaving a seeded generator produces — checked against a pure-Python
+model of the documented contract. Divergences that example tests miss
+(ordering quirks, empty-key cleanup, dual-key bookkeeping after partial
+evictions, dp-rank filter matching) surface here as model mismatches.
+
+Documented per-backend delta honored by the model: the Redis backend CUTS
+the lookup walk at the first key with no post-filter entries (missing or
+fully filtered) while the in-memory backends continue past it
+(reference redis.go:199-205 vs in_memory.go:112-117; pinned individually
+in tests/test_index.py) — `cut_on_empty` per backend.
+"""
+
+import random
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.cost_aware import (
+    CostAwareIndexConfig,
+    CostAwareMemoryIndex,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import InMemoryIndex
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import (
+    Key,
+    PodEntry,
+    pod_matches,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import (
+    RedisIndex,
+    RedisIndexConfig,
+)
+from tests.fake_redis import FakeRedisServer
+
+MODEL = "fuzz-model"
+PODS = ["p0", "p1", "p1@dp0", "p2@dp1"]
+TIERS = ["hbm", "host"]
+N_KEYS = 24
+
+
+class SemanticsModel:
+    """Executable contract: what any backend must answer. `cut_on_empty`
+    is the Redis delta: the walk stops at the first key whose post-filter
+    entry list is empty (missing OR fully filtered — redis_index.lookup),
+    while the in-memory backends continue past missing/filtered keys."""
+
+    def __init__(self, cut_on_empty: bool):
+        self.cut = cut_on_empty
+        self.store = {}  # Key -> set[PodEntry]
+        self.engine_map = {}  # Key -> Key
+
+    def add(self, engine_keys, request_keys, entries):
+        for ek, rk in zip(engine_keys, request_keys):
+            self.engine_map[ek] = rk
+            self.store.setdefault(rk, set()).update(entries)
+
+    def evict(self, engine_key, entries):
+        rk = self.engine_map.get(engine_key)
+        if rk is None or rk not in self.store:
+            return
+        self.store[rk] -= set(entries)
+        if not self.store[rk]:
+            # Empty-key cleanup: backends drop the key (and its
+            # engine-side mapping) once the last pod leaves.
+            del self.store[rk]
+            self.engine_map.pop(engine_key, None)
+
+    def lookup(self, keys, pod_filter):
+        out = {}
+        for key in keys:
+            entries = self.store.get(key) or set()
+            if pod_filter:
+                hits = {
+                    e for e in entries
+                    if pod_matches(e.pod_identifier, pod_filter)
+                }
+            else:
+                hits = set(entries)
+            if not hits:
+                if self.cut:
+                    return out
+                continue
+            out[key] = hits
+        return out
+
+    def get_request_key(self, engine_key):
+        return self.engine_map.get(engine_key)
+
+
+def _fuzz(index, cut_on_empty: bool, seed: int, n_ops: int = 300):
+    rng = random.Random(seed)
+    model = SemanticsModel(cut_on_empty)
+    keys = [Key(MODEL, 1000 + i) for i in range(N_KEYS)]
+    # Engine keys are distinct from request keys (dual-key bookkeeping).
+    engine_of = {k: Key(MODEL, 5000 + k.chunk_hash) for k in keys}
+
+    for step in range(n_ops):
+        op = rng.random()
+        if op < 0.45:
+            start = rng.randrange(N_KEYS)
+            chain = keys[start:start + rng.randint(1, 4)]
+            entries = [
+                PodEntry(p, rng.choice(TIERS))
+                for p in rng.sample(PODS, rng.randint(1, 3))
+            ]
+            index.add([engine_of[k] for k in chain], chain, entries)
+            model.add([engine_of[k] for k in chain], chain, entries)
+        elif op < 0.65:
+            key = rng.choice(keys)
+            known = model.store.get(key, set())
+            victims = (
+                rng.sample(sorted(known, key=str), rng.randint(1, len(known)))
+                if known and rng.random() < 0.8
+                else [PodEntry(rng.choice(PODS), rng.choice(TIERS))]
+            )
+            index.evict(engine_of[key], victims)
+            model.evict(engine_of[key], victims)
+        elif op < 0.9:
+            start = rng.randrange(N_KEYS)
+            probe = list(keys[start:start + rng.randint(1, 6)])
+            if rng.random() < 0.3:
+                probe.insert(
+                    rng.randrange(len(probe) + 1), Key(MODEL, 9999)
+                )  # never-added key: exercises continue-vs-cut
+            pod_filter = (
+                set(rng.sample(["p0", "p1", "p2", "nope"], rng.randint(1, 2)))
+                if rng.random() < 0.5 else set()
+            )
+            got = index.lookup(probe, pod_filter)
+            want = model.lookup(probe, pod_filter)
+            got_sets = {k: set(v) for k, v in got.items()}
+            assert got_sets == want, (
+                f"seed {seed} step {step}: lookup({probe}, {pod_filter}) "
+                f"= {got_sets} want {want}"
+            )
+        else:
+            key = rng.choice(keys)
+            got = index.get_request_key(engine_of[key])
+            want = model.get_request_key(engine_of[key])
+            assert got == want, (
+                f"seed {seed} step {step}: get_request_key mismatch "
+                f"{got} != {want}"
+            )
+
+
+@pytest.mark.parametrize("seed", [11, 23, 47])
+class TestDifferentialFuzz:
+    def test_in_memory(self, seed):
+        _fuzz(InMemoryIndex(), cut_on_empty=False, seed=seed)
+
+    def test_cost_aware(self, seed):
+        # Budget far above the working set: economics eviction never fires,
+        # so the semantics model applies unmodified.
+        _fuzz(
+            CostAwareMemoryIndex(CostAwareIndexConfig(max_size_bytes="64MiB")),
+            cut_on_empty=False, seed=seed,
+        )
+
+    def test_redis(self, seed):
+        server = FakeRedisServer()
+        index = RedisIndex(RedisIndexConfig(url=server.url))
+        try:
+            _fuzz(index, cut_on_empty=True, seed=seed)
+        finally:
+            index.close()
+            server.close()
