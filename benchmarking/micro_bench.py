@@ -1,0 +1,211 @@
+"""Control-plane microbench: recorded numbers for the host-side hot paths.
+
+The reference ships benchmark harnesses for its tokenization pool and
+chat templating but records no numbers (/root/reference/Makefile:198-203,
+pkg/tokenization/pool_test.go:211-281,
+pkg/preprocessing/chat_completions/cgo_functions_test.go:450-533 —
+BASELINE.md calls these "latent harnesses with no recorded results").
+This bench closes that gap for the TPU build: the control plane's hot
+loops run on host CPU in production, so these are real measurements of
+the shipped read/write planes, not simulations.
+
+Legs (all through public APIs):
+- tokenize: blocking pool round trip (local tokenizer, warm prefix store)
+- tokenize_cold: raw HF-tokenizers encode (the prefix-store-miss cost)
+- render: chat-template Jinja render (template cache warm)
+- block_keys: tokens -> chained block keys (canonical CBOR + FNV, C path)
+- prefix_store: FindLongestContainedTokens hit
+- score: LongestPrefixScorer over 128 keys x 4 pods
+- lookup: in-memory index lookup, 128-key chain
+- event_digest: ZMQ-shaped msgpack BlockStored batches through the
+  sharded pool into the index (events/s, end to end)
+
+Run: python benchmarking/micro_bench.py [--quick]
+Writes MICRO_BENCH.json (full mode) and prints it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+MODEL = "test-model"
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "test-model", "tokenizer.json")
+
+CHAT_TEMPLATE = (
+    "{% for m in messages %}[{{ m.role }}] {{ m.content }}\n{% endfor %}"
+    "[assistant]"
+)
+
+
+def _timeit(fn, iters: int, warmup: int = 5):
+    for _ in range(warmup):
+        fn()
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    return {
+        "p50_us": round(samples[len(samples) // 2] * 1e6, 1),
+        "p90_us": round(samples[min(int(len(samples) * 0.9), len(samples) - 1)] * 1e6, 1),
+        "mean_us": round(statistics.mean(samples) * 1e6, 1),
+        "iters": iters,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="CI smoke sizes")
+    args = ap.parse_args()
+    iters = 30 if args.quick else 300
+
+    from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import InMemoryIndex
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+        TokenProcessorConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.kvcache.scorer import (
+        KVBlockScorerConfig,
+        new_kv_block_scorer,
+    )
+    from llm_d_kv_cache_manager_tpu.kvevents.events import BlockStored, EventBatch
+    from llm_d_kv_cache_manager_tpu.kvevents.pool import (
+        EventPool,
+        EventPoolConfig,
+        Message,
+    )
+    from llm_d_kv_cache_manager_tpu.preprocessing.chat_completions import (
+        ChatTemplatingProcessor,
+        RenderRequest,
+    )
+    from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+        TokenizationPool,
+        TokenizersPoolConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.utils.workload import text
+
+    rng = random.Random(3)
+    prompt = text(rng, 1000)  # ~1.9k tokens with the fixture tokenizer
+
+    indexer = Indexer(
+        config=IndexerConfig(
+            token_processor_config=TokenProcessorConfig(block_size=16)
+        ),
+        tokenization_pool=TokenizationPool(
+            TokenizersPoolConfig(workers=2, local_tokenizer_files={MODEL: FIXTURE})
+        ),
+    )
+    indexer.run()
+    report = {"prompt_words": 1000, "block_size": 16}
+    try:
+        pool = indexer.tokenizers_pool
+        tokens = pool.tokenize(None, prompt, MODEL)
+        report["prompt_tokens"] = len(tokens)
+
+        report["tokenize"] = _timeit(
+            lambda: pool.tokenize(None, prompt, MODEL), iters
+        )
+
+        # Cold cost: the raw HF-tokenizers encode the pool pays on a
+        # prefix-store miss (the warm path above rides the store).
+        report["tokenize_cold"] = _timeit(
+            lambda: pool.tokenizer.encode(prompt, MODEL), iters
+        )
+
+        proc = ChatTemplatingProcessor()
+        req = RenderRequest(
+            conversations=[[
+                {"role": "system", "content": text(rng, 200)},
+                {"role": "user", "content": text(rng, 50)},
+            ]],
+            chat_template=CHAT_TEMPLATE,
+            model_name=MODEL,
+        )
+        report["render"] = _timeit(lambda: proc.render(req), iters)
+
+        tp = indexer.token_processor
+        report["block_keys"] = _timeit(
+            lambda: tp.tokens_to_kv_block_keys(None, tokens, MODEL), iters
+        )
+        keys = tp.tokens_to_kv_block_keys(None, tokens, MODEL)
+        report["block_keys"]["tokens_per_s"] = round(
+            len(tokens) / (report["block_keys"]["mean_us"] * 1e-6)
+        )
+
+        report["prefix_store"] = _timeit(
+            lambda: pool.prefix_store.find_longest_contained_tokens(prompt),
+            iters,
+        )
+
+        index = InMemoryIndex()
+        chain = keys[:128] if len(keys) >= 128 else keys
+        pods = [PodEntry(f"pod-{i}", "hbm") for i in range(4)]
+        index.add(chain, chain, pods)
+        report["lookup"] = _timeit(lambda: index.lookup(chain, set()), iters)
+
+        scorer = new_kv_block_scorer(KVBlockScorerConfig())
+        hits = index.lookup(chain, set())
+        report["score"] = _timeit(lambda: scorer.score(chain, hits), iters)
+
+        # Write plane: sharded pool digesting realistic BlockStored chains.
+        ev_index = InMemoryIndex()
+        ev_pool = EventPool(EventPoolConfig(concurrency=4), ev_index, tp)
+        ev_pool.start(with_subscriber=False)
+        try:
+            n_batches = 50 if args.quick else 400
+            batches = []
+            for i in range(n_batches):
+                toks = [
+                    int(t) for t in tokens[: 16 * 8]
+                ]  # 8-block chain per batch
+                batches.append(Message(
+                    topic=f"kv@pod-{i % 8}@{MODEL}",
+                    payload=EventBatch(ts=float(i), events=[BlockStored(
+                        block_hashes=list(range(i * 8, i * 8 + 8)),
+                        parent_block_hash=None,
+                        token_ids=toks, block_size=16,
+                    )]).to_msgpack(),
+                    seq=i, pod_identifier=f"pod-{i % 8}", model_name=MODEL,
+                ))
+            t0 = time.perf_counter()
+            for m in batches:
+                ev_pool.add_task(m)
+            ev_pool.drain()
+            dt = time.perf_counter() - t0
+            report["event_digest"] = {
+                "batches": n_batches,
+                "blocks_per_batch": 8,
+                "batches_per_s": round(n_batches / dt),
+                "blocks_per_s": round(n_batches * 8 / dt),
+            }
+        finally:
+            ev_pool.shutdown()
+
+        # Whole read path for context (also in bench.py's read_path_p50_ms).
+        report["get_pod_scores"] = _timeit(
+            lambda: indexer.get_pod_scores(prompt, MODEL, []), iters
+        )
+    finally:
+        indexer.shutdown()
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "MICRO_BENCH.json")
+    if not args.quick:
+        with open(out, "w") as f:
+            json.dump(report, f, indent=2)
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""Control-plane microbench stays runnable; committed artifact coherent."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+BENCH = REPO / "benchmarking" / "micro_bench.py"
+ARTIFACT = REPO / "benchmarking" / "MICRO_BENCH.json"
+
+LEGS = (
+    "tokenize", "tokenize_cold", "render", "block_keys", "prefix_store",
+    "lookup", "score", "get_pod_scores",
+)
+
+
+def test_quick_mode_measures_every_leg():
+    out = subprocess.run(
+        [sys.executable, str(BENCH), "--quick"],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    report = json.loads(out.stdout[out.stdout.index("{"):])
+    for leg in LEGS:
+        assert report[leg]["p50_us"] > 0, leg
+    assert report["event_digest"]["blocks_per_s"] > 0
+    # The warm path must actually be riding the prefix store.
+    assert report["tokenize"]["p50_us"] < report["tokenize_cold"]["p50_us"]
+
+
+def test_committed_artifact_is_coherent():
+    if not ARTIFACT.exists():
+        import pytest
+
+        pytest.skip("microbench artifact not committed on this checkout")
+    d = json.loads(ARTIFACT.read_text())
+    for leg in LEGS:
+        assert d[leg]["p50_us"] > 0, leg
+    assert d["tokenize"]["p50_us"] < d["tokenize_cold"]["p50_us"]
+    assert d["event_digest"]["blocks_per_s"] > 0
