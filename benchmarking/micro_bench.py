@@ -71,7 +71,7 @@ def main():
 
     from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
     from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import InMemoryIndex
-    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import PodEntry
     from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
         TokenProcessorConfig,
     )
