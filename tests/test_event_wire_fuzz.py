@@ -4,8 +4,8 @@ The reference's stance is poison-pill dropping — undecodable messages are
 discarded, never retried (/root/reference/pkg/kvcache/kvevents/pool.go:
 182-187). This fuzz drives that stance structurally: seeded random
 mutations of VALID msgpack EventBatch payloads (truncation, byte flips,
-type confusion in the tagged union, hash-coercion edge values) are
-interleaved with known-good batches, and afterwards (a) the pool's
+garbage prefixes, empty frames, wrong-shape msgpack, and tag confusion
+in the event tagged union) are interleaved with known-good batches, and afterwards (a) the pool's
 workers are alive, (b) every good batch landed in the index, and (c) no
 mutated frame produced an index entry for a chain the good traffic never
 stored.
@@ -42,7 +42,7 @@ def _good_message(i: int) -> Message:
 
 
 def _mutate(payload: bytes, rng: random.Random) -> bytes:
-    mode = rng.randrange(5)
+    mode = rng.randrange(6)
     if mode == 0 and len(payload) > 2:  # truncate
         return payload[: rng.randrange(1, len(payload))]
     if mode == 1:  # flip random bytes
@@ -54,10 +54,18 @@ def _mutate(payload: bytes, rng: random.Random) -> bytes:
         return bytes(rng.randrange(256) for _ in range(rng.randint(1, 8))) + payload
     if mode == 3:  # empty frame
         return b""
-    # valid msgpack, wrong structure: a map where an array is expected
     import msgpack
 
-    return msgpack.packb({"not": "an event batch", "n": rng.randrange(99)})
+    if mode == 4:  # valid msgpack, wrong structure: a map, not an array
+        return msgpack.packb({"not": "an event batch", "n": rng.randrange(99)})
+    # Tag confusion: decode the valid batch and corrupt the tagged-union
+    # tag (unknown id, or a tag with the wrong payload arity).
+    ts, events = msgpack.unpackb(payload, raw=False)
+    if events and rng.random() < 0.5:
+        events[0][0] = rng.choice([99, -1, "BlockStored", None])
+    else:
+        events = [[rng.randrange(3)]]  # known tag, missing payload
+    return msgpack.packb([ts, events])
 
 
 def test_mutated_frames_never_crash_and_good_traffic_lands():
