@@ -724,7 +724,13 @@ def main():
             "precise": fd.get("precise"),
             "round_robin": fd.get("round_robin"),
             "device": fd.get("device"),
+            "full_mode_version": fd.get("config", {}).get(
+                "full_mode_version", "v1"
+            ),
         }
+        # v2 artifacts carry the random arm (ADVICE r3) — don't drop it.
+        if "random" in fd:
+            stats["device_measured_fleet"]["random"] = fd["random"]
     print(json.dumps(stats), file=sys.stderr)
 
     print(
