@@ -42,10 +42,14 @@ EOF
 
 step device_bench python benchmarking/device_bench.py
 step fleet_device_bench python benchmarking/fleet_device_bench.py
+# bench.py re-reads the regenerated DEVICE_BENCH rates (gamma/delta
+# provenance, cost-model seeds) — run it before the README render so the
+# committed prose reflects the fresh constants.
+step bench python bench.py
 step gen_readme python benchmarking/gen_readme.py
 step coherence_tests python -m pytest \
   tests/test_fleet_device_bench.py tests/test_bench_docs.py \
-  tests/test_costs.py -q -p no:cacheprovider
+  tests/test_costs.py tests/test_micro_bench.py -q -p no:cacheprovider
 
 echo "=== chip session done: $fails step(s) failed; logs in $OUT"
 python - <<'EOF'
