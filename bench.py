@@ -527,7 +527,7 @@ def run_qps_ladder(pressured_raw=None):
     main()'s already-run pressured arms (identical deterministic configs)
     instead of paying three duplicate 300-request simulations — the same
     reuse contract as run_two_tier_comparison."""
-    arms = ("precise", "load", "round_robin")
+    arms = ("precise", "estimated", "load", "round_robin")
     ladder = {}
     for qps in (10.0, 20.0, 40.0):
         row = {}

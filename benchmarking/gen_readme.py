@@ -132,18 +132,24 @@ def fleet_section() -> str:
             "TTFT while cache-oblivious arms explode once prefill queues "
             "stop clearing):",
             "",
-            "| QPS | precise p50/p90 (s) | load p50/p90 (s) "
-            "| round-robin p50/p90 (s) | precise vs rr (p90) |",
-            "|---:|---:|---:|---:|---:|",
+            "| QPS | precise p50/p90 (s) | estimated p50/p90 (s) "
+            "| load p50/p90 (s) | round-robin p50/p90 (s) "
+            "| precise vs rr (p90) |",
+            "|---:|---:|---:|---:|---:|---:|",
         ]
         for name, row in sorted(
             ladder.items(), key=lambda kv: float(kv[0].split("_")[1])
         ):
             qps = name.split("_")[1]
+            est = row.get("estimated")
+            est_cell = (
+                f"{est['ttft_p50_s']} / {est['ttft_p90_s']}" if est else "—"
+            )
             lines.append(
                 f"| {qps} "
                 f"| **{row['precise']['ttft_p50_s']} / "
                 f"{row['precise']['ttft_p90_s']}** "
+                f"| {est_cell} "
                 f"| {row['load']['ttft_p50_s']} / {row['load']['ttft_p90_s']} "
                 f"| {row['round_robin']['ttft_p50_s']} / "
                 f"{row['round_robin']['ttft_p90_s']} "
