@@ -692,6 +692,17 @@ def main():
 
     speedup = p50(ttft_rr) / max(p50(ttft_precise), 1e-9)
     stats = {
+        "config": {
+            "n_pods": N_PODS,
+            "page_size": PAGE_SIZE,
+            "pages_per_pod": PAGES_PER_POD,
+            "pressured_pages_per_pod": TWO_TIER_PAGES_PER_POD,
+            "n_groups": N_GROUPS,
+            "users_per_group": USERS_PER_GROUP,
+            "turns_per_user": TURNS_PER_USER,
+            "qps": QPS,
+        },
+        "sim_ttft_p50_speedup": round(speedup, 3),
         "ttft_p50_precise_s": round(p50(ttft_precise), 4),
         "ttft_p50_round_robin_s": round(p50(ttft_rr), 4),
         "ttft_mean_precise_s": round(sum(ttft_precise) / len(ttft_precise), 4),
@@ -732,18 +743,60 @@ def main():
         if "random" in fd:
             stats["device_measured_fleet"]["random"] = fd["random"]
     print(json.dumps(stats), file=sys.stderr)
-
-    print(
-        json.dumps(
-            {
-                "metric": "ttft_p50_speedup_vs_round_robin",
-                "value": round(speedup, 3),
-                "unit": "x",
-                # BASELINE.json target: >=2x TTFT speedup vs round-robin.
-                "vs_baseline": round(speedup / 2.0, 3),
-            }
-        )
+    # Machine-readable stats artifact (VERDICT r4 #1): gen_readme renders the
+    # fleet section from THIS file, never from the driver's stderr tail —
+    # BENCH_r04.json's tail was truncated mid-JSON and degraded the README.
+    # Excluded from the committed artifact: wall_s (volatile — would dirty
+    # the diff on every identical rerun) and device_measured_fleet (a copy
+    # of FLEET_DEVICE_BENCH.json; one source of truth, read directly by
+    # gen_readme's fleet-device section).
+    artifact = {
+        k: v
+        for k, v in stats.items()
+        if k not in ("wall_s", "device_measured_fleet")
+    }
+    fleet_bench = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "benchmarking", "FLEET_BENCH.json",
     )
+    with open(fleet_bench, "w") as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+    # Final parsed line (VERDICT r4 #5): lead with the DEVICE-measured fleet
+    # speedup when a chip-measured artifact exists — the simulated arm
+    # saturated at 6.698x in r02 and stopped measuring progress. The sim
+    # number rides along as a secondary field.
+    dev = stats.get("device_measured_fleet", {})
+    if dev.get("ttft_p50_speedup"):
+        print(
+            json.dumps(
+                {
+                    "metric": "device_fleet_ttft_p50_speedup_vs_round_robin",
+                    "value": round(float(dev["ttft_p50_speedup"]), 3),
+                    "unit": "x",
+                    # BASELINE.json target: >=2x TTFT speedup vs round-robin.
+                    "vs_baseline": round(
+                        float(dev["ttft_p50_speedup"]) / 2.0, 3
+                    ),
+                    "sim_ttft_p50_speedup": round(speedup, 3),
+                    "device": dev.get("device"),
+                    "source": "benchmarking/FLEET_DEVICE_BENCH.json",
+                }
+            )
+        )
+    else:
+        print(
+            json.dumps(
+                {
+                    "metric": "ttft_p50_speedup_vs_round_robin",
+                    "value": round(speedup, 3),
+                    "unit": "x",
+                    # BASELINE.json target: >=2x TTFT speedup vs round-robin.
+                    "vs_baseline": round(speedup / 2.0, 3),
+                }
+            )
+        )
 
 
 if __name__ == "__main__":

@@ -26,6 +26,37 @@ def test_readme_generated_sections_are_fresh():
     )
 
 
+def test_fleet_bench_artifact_matches_bench_config():
+    """Bench-honesty convention: every committed benchmark artifact carries
+    the config that produced it, cross-checked against the script's current
+    constants — so changing bench.py without regenerating FLEET_BENCH.json
+    fails here, not silently in the README."""
+    import json
+    import re as _re
+
+    artifact = json.loads((BENCHMARKING / "FLEET_BENCH.json").read_text())
+    src = (BENCHMARKING.parent / "bench.py").read_text()
+
+    def const(name):
+        m = _re.search(rf"^{name} = ([0-9.]+)", src, _re.M)
+        assert m, f"bench.py constant {name} not found"
+        v = m.group(1)
+        return float(v) if "." in v else int(v)
+
+    cfg = artifact["config"]
+    assert cfg["n_pods"] == const("N_PODS")
+    assert cfg["page_size"] == const("PAGE_SIZE")
+    assert cfg["pages_per_pod"] == const("PAGES_PER_POD")
+    assert cfg["pressured_pages_per_pod"] == const("TWO_TIER_PAGES_PER_POD")
+    assert cfg["n_groups"] == const("N_GROUPS")
+    assert cfg["users_per_group"] == const("USERS_PER_GROUP")
+    assert cfg["turns_per_user"] == const("TURNS_PER_USER")
+    assert cfg["qps"] == const("QPS")
+    # Volatile / duplicated fields must stay out of the committed artifact.
+    assert "wall_s" not in artifact
+    assert "device_measured_fleet" not in artifact
+
+
 def test_device_bench_json_is_physical():
     import json
 
