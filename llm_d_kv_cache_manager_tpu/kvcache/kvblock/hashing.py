@@ -18,6 +18,7 @@ always-available pure-Python reference implementation for both shapes.
 
 from __future__ import annotations
 
+import hashlib
 from typing import Iterable, List, Optional, Sequence
 
 _FNV64_OFFSET = 0xCBF29CE484222325
@@ -97,6 +98,34 @@ def chunk_hash(
     return fnv64a(cbor_hash_payload(parent, tokens, extra))
 
 
+def _cbor_text(s: str) -> bytes:
+    """Canonical CBOR text string (major type 3, shortest-form length)."""
+    data = s.encode("utf-8")
+    out = bytearray()
+    _cbor_uint_head(3, len(data), out)
+    return bytes(out) + data
+
+
+def _sha256_low64(data: bytes) -> int:
+    return int.from_bytes(hashlib.sha256(data).digest(), "big") & _MASK64
+
+
+def sha256_cbor_init_hash(seed: str) -> int:
+    """Root parent hash under vLLM's `sha256_cbor_64bit` algorithm: the
+    lower 64 bits of sha256 over the canonical-CBOR TEXT encoding of the
+    PYTHONHASHSEED string (vLLM v1 `init_none_hash` with that hash fn)."""
+    return _sha256_low64(_cbor_text(seed))
+
+
+def sha256_cbor_chunk_hash(
+    parent: int, tokens: Sequence[int], extra: Optional[Sequence[int]] = None
+) -> int:
+    """One chain link under vLLM's `sha256_cbor_64bit`: same canonical-CBOR
+    payload `[parent, [tokens...], extra|null]` as the FNV scheme, hashed
+    with sha256 and truncated to the lower 64 bits."""
+    return _sha256_low64(cbor_hash_payload(parent, tokens, extra))
+
+
 def prefix_hashes(
     parent: int,
     token_chunks: Iterable[Sequence[int]],
@@ -125,20 +154,32 @@ def prefix_hashes_fast(
     tokens: Sequence[int],
     block_size: int,
     extra: Optional[Sequence[int]] = None,
+    algo: str = "fnv64_cbor",
 ) -> List[int]:
     """Chunk `tokens` into full blocks of `block_size` and chain-hash them.
 
-    Uses the C extension when available (the common extra=None path);
-    pure Python otherwise.
+    `algo` selects the chain hash: "fnv64_cbor" (reference parity, default)
+    or "sha256_cbor_64bit" (vLLM `--prefix-caching-hash-algo` parity). The C
+    extension accelerates the common fnv64_cbor/extra=None path; pure Python
+    otherwise.
     """
     n_full = len(tokens) // block_size
     if n_full == 0:
         return []
-    if _native is not None and extra is None:
+    if algo == "fnv64_cbor" and _native is not None and extra is None:
         # The C extension requires genuine Python ints; token ids often
         # arrive as numpy/jax integer scalars from engine code.
         return list(_native.prefix_hashes(
             int(parent), [int(t) for t in tokens], block_size
         ))
     chunks = [tokens[i * block_size:(i + 1) * block_size] for i in range(n_full)]
-    return prefix_hashes(parent, chunks, extra)
+    if algo == "fnv64_cbor":
+        return prefix_hashes(parent, chunks, extra)
+    if algo == "sha256_cbor_64bit":
+        hashes: List[int] = []
+        h = parent
+        for chunk in chunks:
+            h = sha256_cbor_chunk_hash(h, chunk, extra)
+            hashes.append(h)
+        return hashes
+    raise ValueError(f"unknown hash algo: {algo!r}")

@@ -25,6 +25,14 @@ class TokenProcessorConfig:
     block_size: int = DEFAULT_BLOCK_SIZE
     # Must match the engine fleet's PYTHONHASHSEED (vLLM NONE_HASH alignment).
     hash_seed: str = ""
+    # Chain-hash algorithm. "fnv64_cbor" is the reference's scheme
+    # (token_processor.go:94-112). "sha256_cbor_64bit" reproduces vLLM v1's
+    # `--prefix-caching-hash-algo=sha256_cbor_64bit` bit-for-bit (proven by
+    # tests/test_hash_parity.py::TestVllmVectors against the vendored
+    # oracle) — pin it when the indexer's request keys must equal the
+    # engine's own block hashes rather than merely mapping to them through
+    # the dual-key engine→request bookkeeping.
+    hash_algo: str = "fnv64_cbor"
 
     @classmethod
     def default(cls) -> "TokenProcessorConfig":
@@ -36,7 +44,16 @@ class ChunkedTokenDatabase:
 
     def __init__(self, config: Optional[TokenProcessorConfig] = None):
         self.config = config or TokenProcessorConfig.default()
-        self._init_hash = hashing.init_hash(self.config.hash_seed)
+        if self.config.hash_algo == "fnv64_cbor":
+            self._init_hash = hashing.init_hash(self.config.hash_seed)
+        elif self.config.hash_algo == "sha256_cbor_64bit":
+            self._init_hash = hashing.sha256_cbor_init_hash(
+                self.config.hash_seed
+            )
+        else:
+            raise ValueError(
+                f"unknown hash_algo: {self.config.hash_algo!r}"
+            )
 
     @property
     def block_size(self) -> int:
@@ -64,6 +81,7 @@ class ChunkedTokenDatabase:
         parent_hash = parent_key.chunk_hash if parent_key is not None else self._init_hash
         extra = None if lora_id is None else [int(lora_id)]
         hashes = hashing.prefix_hashes_fast(
-            parent_hash, tokens, self.config.block_size, extra
+            parent_hash, tokens, self.config.block_size, extra,
+            algo=self.config.hash_algo,
         )
         return [Key(model_name, h) for h in hashes]
