@@ -556,7 +556,7 @@ def run_winning_regime():
 
     Transfer beats recompute when a model carries few KV bytes per token of
     compute (engine/costs.py): here a wide-MQA int8-KV model class —
-    ~7.3 GFLOP/token of recompute against ~1 KB/token of KV — whose
+    ~6.7 GFLOP/token of recompute against ~1 KB/token of KV — whose
     per-token alpha/gamma/delta are derived from the SAME measured rig
     rates as everything else (DEVICE_BENCH.json; assumed v5e rates only if
     the artifact is missing). Scenario: a fleet serves multi-turn
@@ -639,7 +639,7 @@ def run_winning_regime():
                     "warm p50s should therefore be ~equal — an in-artifact "
                     "control)",
         "model_class": "wide MQA + int8 KV (d_model 8192, n_layers 4, "
-                       "n_kv_heads 1): ~7.3 GF/token vs ~1.06 KB/token",
+                       "n_kv_heads 1): ~6.7 GF/token vs ~1.06 KB/token",
         "rates_source": rates["source"],
         "alpha_recompute_s_per_token": round(alpha_w, 8),
         "gamma_staged_s_per_token": round(gamma_w, 8),

@@ -43,8 +43,9 @@ EOF
 step device_bench python benchmarking/device_bench.py
 step fleet_device_bench python benchmarking/fleet_device_bench.py
 # bench.py re-reads the regenerated DEVICE_BENCH rates (gamma/delta
-# provenance, cost-model seeds) — run it before the README render so the
-# committed prose reflects the fresh constants.
+# provenance, cost-model seeds) and writes its machine-readable stats to
+# benchmarking/FLEET_BENCH.json — the artifact gen_readme renders the fleet
+# section from — so it must run before the README render step.
 step bench python bench.py
 step gen_readme python benchmarking/gen_readme.py
 step coherence_tests python -m pytest \

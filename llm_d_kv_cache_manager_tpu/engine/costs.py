@@ -106,7 +106,12 @@ def measured_rates(path: Optional[str] = None) -> Optional[dict]:
 
 def flops_per_token(model_config) -> float:
     """~2 FLOPs per parameter touched per token (matmul dominated):
-    attention projections + gated MLP + LM head; embedding lookups free."""
+    attention projections + gated MLP; embedding lookups free. The LM head
+    is deliberately EXCLUDED: this function prices recomputing cached
+    prefix KV blocks, and prefix tokens never produce logits (the head
+    runs once per request, on the last position) — including it would
+    overestimate recompute_s and bias the gate toward admitting transfers,
+    the wrong direction for the no-regression guarantee."""
     c = model_config
     attn = (
         c.d_model * c.n_q_heads * c.head_dim  # wq
@@ -118,8 +123,7 @@ def flops_per_token(model_config) -> float:
     n_experts_active = getattr(c, "top_k", None)
     if getattr(c, "n_experts", 0) and n_experts_active:
         mlp = n_experts_active * mlp + c.d_model * c.n_experts  # + router
-    head = c.d_model * c.vocab_size
-    return 2.0 * (c.n_layers * (attn + mlp) + head)
+    return 2.0 * c.n_layers * (attn + mlp)
 
 
 def kv_bytes_per_token(model_config, quantized: bool = False) -> float:

@@ -304,6 +304,14 @@ class TieredKVStore:
             [READY], 1
         ) == 0:
             return 0
+        # Membership-filter BEFORE charging the ready-cap budget: a submit
+        # can carry dozens of hashes that exist nowhere, and each would
+        # otherwise consume a budget slot (displacing genuinely restorable
+        # blocks from this submit) just to be discarded by the background
+        # fetch. _source_of is membership-only — no bytes move.
+        candidates = [h for h in chunk_hashes if self._source_of(h) is not None]
+        if not candidates:
+            return 0
         todo: List[int] = []
         with self._mu:
             # Never fetch past the ready-buffer cap: chains restore
@@ -311,7 +319,7 @@ class TieredKVStore:
             # the part load_chain consumes first — and the evicted
             # payloads' fetch traffic would be pure waste.
             budget = self._ready_cap - len(self._ready) - len(self._inflight)
-            for h in chunk_hashes:
+            for h in candidates:
                 if budget <= 0:
                     break
                 if h in self._ready or h in self._inflight:

@@ -18,7 +18,8 @@ defaulted to "gpu"); events carrying an explicit Medium override it.
 Shard queues are bounded (the reference bounds ingest with rate-limited k8s
 workqueues, pool.go:103-144). On overflow the OLDEST queued message for that
 shard is dropped and counted (`kvcache_events_dropped_total`), but its
-BlockRemoved events are still applied before the rest is discarded: dropping
+BlockRemoved events are still applied — by the shard worker between
+messages, so they stay ordered after any in-flight store digest: dropping
 a store self-heals (the engine re-stores hot blocks, and LRU churn evicts the
 rest), while dropping a removal would leave a permanent false-positive entry
 the engine never corrects. So overload sheds the expensive work (re-hashing
@@ -29,10 +30,11 @@ growing manager memory without bound.
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import Deque, List, Optional
 
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.hashing import fnv32a
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index
@@ -92,6 +94,15 @@ class EventPool:
             queue.Queue(maxsize=depth) for _ in range(self.config.concurrency)
         ]
         self._workers: List[threading.Thread] = []
+        # Removal-only digests of drop-oldest victims, applied by the SHARD
+        # WORKER between messages (never by the producer thread): the victim
+        # was the oldest queued message, so every message queued before it
+        # has already been dequeued — only the worker's single in-flight
+        # message could still race, and draining at the top of the worker
+        # iteration serializes behind it, preserving per-pod ordering.
+        self._pending_drop_removals: List[Deque[Message]] = [
+            collections.deque() for _ in range(self.config.concurrency)
+        ]
         self._subscriber = None
         self._started = False
         self._shutdown = False
@@ -133,8 +144,11 @@ class EventPool:
         if self._subscriber is not None:
             self._subscriber.stop()
             self._subscriber = None
-        for q in self._queues:
-            q.put(None)
+        # Non-blocking sentinel delivery: a blocking put on a full bounded
+        # queue would hang shutdown behind a stuck digest; _offer drops the
+        # oldest victim (removals preserved) and never loses a sentinel.
+        for shard, q in enumerate(self._queues):
+            self._offer(q, None, shard)
         for t in self._workers:
             t.join(timeout=5.0)
         self._workers = []
@@ -143,6 +157,25 @@ class EventPool:
         """Block until all queued events are processed (test/bench helper)."""
         for q in self._queues:
             q.join()
+        # Workers are idle after join (task_done fires post-digest), so
+        # flushing any still-pending drop-removals here cannot land before
+        # an in-flight store for the same block.
+        for pending in self._pending_drop_removals:
+            self._flush_pending(pending)
+
+    @staticmethod
+    def _flush_pending_pop(pending: "Deque[Message]") -> Optional[Message]:
+        try:
+            return pending.popleft()
+        except IndexError:  # lost a check-then-act race with another drainer
+            return None
+
+    def _flush_pending(self, pending: "Deque[Message]") -> None:
+        while pending:
+            victim = self._flush_pending_pop(pending)
+            if victim is None:
+                return
+            self._apply_removals_only(victim)
 
     # -- ingestion ---------------------------------------------------------
 
@@ -199,7 +232,12 @@ class EventPool:
                 self._record_drop(victim, shard)
 
     def _record_drop(self, victim: Message, shard: int) -> None:
-        self._apply_removals_only(victim)
+        # Hand the victim's removals to the shard worker instead of applying
+        # them here: the worker may still be digesting an older message whose
+        # BlockStored for the same block hasn't landed, and a producer-thread
+        # removal could then be overwritten by that late store — the exact
+        # false positive the removals-kept policy exists to prevent.
+        self._pending_drop_removals[shard].append(victim)
         metrics.count_event_dropped()
         with self._dropped_mu:
             self._dropped += 1
@@ -234,9 +272,15 @@ class EventPool:
     # -- workers -----------------------------------------------------------
 
     def _worker_loop(self, q: "queue.Queue[Optional[Message]]") -> None:
+        shard = self._queues.index(q)
+        pending = self._pending_drop_removals[shard]
         while True:
             msg = q.get()
             try:
+                # Apply dropped victims' removals first: any drop happened
+                # because the queue was full, so this iteration's dequeue is
+                # ordered after every message older than the victim.
+                self._flush_pending(pending)
                 if msg is None:
                     return
                 self._process_event(msg)

@@ -34,11 +34,12 @@ class TestEstimators:
 
         c = LlamaConfig(vocab_size=1024, d_model=256, n_layers=4,
                         n_q_heads=8, n_kv_heads=4, head_dim=32, d_ff=512)
-        # ~2 flops per matmul parameter: attn + gated MLP + head.
+        # ~2 flops per matmul parameter: attn + gated MLP. No LM-head term:
+        # prefix-block recompute never produces logits (ADVICE r4), and
+        # pricing it in would bias the gate toward admitting transfers.
         attn = 256 * 8 * 32 + 2 * 256 * 4 * 32 + 8 * 32 * 256
         mlp = 3 * 256 * 512
-        head = 256 * 1024
-        assert costs.flops_per_token(c) == 2.0 * (4 * (attn + mlp) + head)
+        assert costs.flops_per_token(c) == 2.0 * 4 * (attn + mlp)
 
     def test_moe_counts_only_active_experts(self):
         from llm_d_kv_cache_manager_tpu.models.mixtral import MixtralConfig

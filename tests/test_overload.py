@@ -98,6 +98,65 @@ class TestEventPoolFlood:
             pool.shutdown()
 
 
+class _GatedIndex(InMemoryIndex):
+    """InMemoryIndex whose add() blocks until released — pins a store
+    digest in-flight on the shard worker."""
+
+    def __init__(self):
+        super().__init__()
+        self.in_add = threading.Event()
+        self.release_add = threading.Event()
+
+    def add(self, engine_keys, request_keys, entries):
+        self.in_add.set()
+        assert self.release_add.wait(timeout=10.0)
+        return super().add(engine_keys, request_keys, entries)
+
+
+class TestDropRemovalOrdering:
+    def test_dropped_removal_lands_after_inflight_store(self):
+        """ADVICE r4: a drop-victim's BlockRemoved must be applied by the
+        shard worker AFTER any in-flight store digest for the same block —
+        applying it on the producer thread lets the late store resurrect
+        the entry, the exact false positive the removals-kept policy
+        claims to prevent."""
+        from llm_d_kv_cache_manager_tpu.kvevents.events import BlockRemoved
+
+        index = _GatedIndex()
+        tp = ChunkedTokenDatabase(TokenProcessorConfig())
+        pool = EventPool(
+            EventPoolConfig(concurrency=1, max_queue_depth=1), index, tp
+        )
+        pool.start(with_subscriber=False)
+        try:
+            # msg1: store for block 1 — worker picks it up and blocks in add.
+            pool.add_task(_msg(1))
+            assert index.in_add.wait(timeout=5.0)
+            # msg2 (removal for block 1) fills the queue; msg3 drops it.
+            removal = EventBatch(
+                ts=2.0, events=[BlockRemoved(block_hashes=[1])]
+            )
+            pool.add_task(Message(
+                topic="kv@pod-a@m", payload=removal.to_msgpack(), seq=2,
+                pod_identifier="pod-a", model_name="m",
+            ))
+            pool.add_task(_msg(99))
+            assert pool.dropped_events == 1
+            # The removal must still be pending — not applied mid-store.
+            index.release_add.set()
+            pool.drain()
+            engine_key = tp.tokens_to_kv_block_keys(
+                None, list(range(16)), "m"
+            )  # noqa: F841 - request key of block 1's chain
+            from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key
+            assert index.get_request_key(Key("m", 1)) is None, (
+                "dropped removal was overwritten by the in-flight store"
+            )
+        finally:
+            index.release_add.set()
+            pool.shutdown()
+
+
 class _SlowTokenizer:
     """Minimal Tokenizer stub that blocks until released."""
 
