@@ -98,6 +98,16 @@ QPS = 20.0
 # until the pod's prefill slot frees up.
 ALPHA_PREFILL_S_PER_TOKEN = 0.00035
 BETA_OVERHEAD_S = 0.02
+# Decode holds its KV pages for the response duration (reference ITL mean
+# 0.020s, 37-capacity/README.md:235-238). Concurrent decodes are what put
+# real pressure on the page pool: when an admission cannot allocate, the
+# engine preempts its youngest running sequence (vLLM recompute-preemption),
+# whose pages get reclaimed — emitting the BlockRemoved events only PRECISE
+# tracking sees. This models the 73-capacity regime where estimated
+# scheduling collapses (TTFT p90 31.08s vs 0.54s precise,
+# /root/reference/benchmarking/73-capacity/README.md:238-246): routing
+# history keeps pointing at caches that pressure already destroyed.
+ITL_S_PER_TOKEN = 0.02
 # Two-tier restore costs: re-landing a KV block from the host staging store
 # (DMA) or a peer pod (DCN) is bandwidth-bound vs 350us/token to recompute
 # on the MXU. The defaults below are assumptions; when the device bench has
@@ -187,6 +197,33 @@ def build_workload(seed: int = 42, qps: float = QPS):
         arrival += rng.expovariate(qps)
         requests.append((arrival, conv_id))
     return requests, conversations, rng
+
+
+# Capacity-regime workload (the reference's 73-capacity shape,
+# /root/reference/benchmarking/73-capacity/README.md:8-23): SINGLE-TURN
+# requests drawn uniformly from many groups sharing long system prompts,
+# with the groups' aggregate prefix footprint near the fleet's KV capacity
+# — so LRU/preemption churn constantly rotates which prefixes are
+# resident. Multi-turn chat makes routing history self-fulfilling (the
+# conversation re-warms whatever pod it lands on); single-turn fan-in is
+# where an estimator that never sees engine evictions goes stale.
+CAPACITY_GROUPS = 48
+CAPACITY_PAGES_PER_POD = 512
+CAPACITY_REQUESTS = 300
+
+
+def build_capacity_workload(seed: int = 42, qps: float = QPS):
+    """(requests, group_prompts, rng): time-ordered (arrival, group_id)
+    single-turn draws over CAPACITY_GROUPS shared-prefix groups."""
+    rng = random.Random(seed)
+    groups = shared_prefix_conversations(rng, CAPACITY_GROUPS, 1, SYSTEM_PROMPT_WORDS)
+    group_ids = list(groups)
+    arrival = 0.0
+    requests = []
+    for _ in range(CAPACITY_REQUESTS):
+        arrival += rng.expovariate(qps)
+        requests.append((arrival, rng.choice(group_ids)))
+    return requests, groups, rng
 
 
 class FleetSim:
@@ -283,6 +320,11 @@ class FleetSim:
         self.total_tokens = 0
         self.restored_blocks = 0
         self.onboarded_blocks = 0
+        # Per-pod running decodes: (decode_finish_time, state, n_tokens).
+        # Their pages stay referenced until release, so admission pressure
+        # and preemption are real block-manager dynamics, not bookkeeping.
+        self.pod_active = [[] for _ in range(N_PODS)]
+        self.preemptions = 0
 
     def _sink_for(self, pod_id: str):
         def sink(batch):
@@ -351,8 +393,39 @@ class FleetSim:
             self.affinity.popitem(last=False)
         return pod
 
+    def _release_finished(self, now: float) -> None:
+        """Free sequences whose decode completed before `now`: their pages
+        move to the evictable prefix cache (still indexed until the block
+        manager actually reclaims them for a later allocation)."""
+        for idx, active in enumerate(self.pod_active):
+            if not active:
+                continue
+            keep = []
+            for finish, state, n_tokens in active:
+                if finish <= now:
+                    self.pods[idx].free(state)
+                else:
+                    keep.append((finish, state, n_tokens))
+            self.pod_active[idx] = keep
+
+    def _preempt_youngest(self, pod_idx: int) -> float:
+        """vLLM recompute-preemption: evict the running sequence with the
+        most decode left (the youngest), freeing its pages for the incoming
+        admission. Returns the preempted sequence's re-prefill compute cost
+        — work the pod must redo when the victim resumes, charged to the
+        pod's clock so saturation compounds the way the reference's
+        73-capacity run shows. The victim's page reclaim emits BlockRemoved
+        through the block manager, which only precise tracking observes."""
+        active = self.pod_active[pod_idx]
+        k = max(range(len(active)), key=lambda j: active[j][0])
+        _finish, victim, n_tokens = active.pop(k)
+        self.pods[pod_idx].free(victim)
+        self.preemptions += 1
+        return self.alpha * n_tokens
+
     def serve(self, arrival: float, prompt: str) -> float:
         """Returns TTFT for this request under the simulated clock."""
+        self._release_finished(arrival)
         pod_idx = self.route(prompt)
         pod = self.pods[pod_idx]
 
@@ -373,19 +446,29 @@ class FleetSim:
             self.onboarded_blocks += o
             return r, o
 
-        try:
-            state, cached = pod.prefill(tokens)
-        except OutOfPagesError:
-            # Sequence larger than the pod's whole free pool: serve uncached
-            # (count the full prefill). Any tier traffic the failed allocate
-            # already performed is still charged and counted.
-            restored, onboarded = tier_delta()
-            return (
-                BETA_OVERHEAD_S
-                + self.alpha * len(tokens)
-                + self.gamma * restored * PAGE_SIZE
-                + self.delta * onboarded * PAGE_SIZE
-            )
+        state = None
+        requeue_s = 0.0
+        while state is None:
+            try:
+                state, cached = pod.prefill(tokens)
+            except OutOfPagesError:
+                if self.pod_active[pod_idx]:
+                    requeue_s += self._preempt_youngest(pod_idx)
+                    continue
+                # Sequence larger than the pod's whole free pool even with
+                # every decode preempted: serve uncached (count the full
+                # prefill). Any tier traffic the failed allocate already
+                # performed is still charged and counted.
+                restored, onboarded = tier_delta()
+                start = max(arrival, self.pod_free_at[pod_idx])
+                prefill_s = (
+                    BETA_OVERHEAD_S
+                    + self.alpha * len(tokens)
+                    + self.gamma * restored * PAGE_SIZE
+                    + self.delta * onboarded * PAGE_SIZE
+                )
+                self.pod_free_at[pod_idx] = start + prefill_s + requeue_s
+                return (start - arrival) + prefill_s
         self.hit_tokens += min(cached, len(tokens))
         restored, onboarded = tier_delta()
 
@@ -398,13 +481,19 @@ class FleetSim:
         )
         start = max(arrival, self.pod_free_at[pod_idx])
         ttft = (start - arrival) + prefill_s
-        self.pod_free_at[pod_idx] = start + prefill_s
+        # Preempted victims resume behind this admission: their re-prefill
+        # compute occupies the pod before its next free slot.
+        self.pod_free_at[pod_idx] = start + prefill_s + requeue_s
 
         if self.host_tier:
             # Publish the committed pages to this pod's transfer server so
             # peers can onboard them over DCN (dedup'd; pages stay in HBM).
             pod.export_sequence(state)
-        pod.free(state)  # pages stay cached for future turns
+        # The sequence decodes its response before releasing pages — the
+        # concurrent-occupancy dynamic that makes KV pressure (and hence
+        # preemption) real. Released lazily by _release_finished.
+        decode_finish = start + prefill_s + ITL_S_PER_TOKEN * RESPONSE_WORDS
+        self.pod_active[pod_idx].append((decode_finish, state, len(tokens)))
         self.event_pool.drain()
         return ttft
 
@@ -415,8 +504,13 @@ class FleetSim:
             pod.close()
 
 
-def run_strategy(strategy: str, qps: float = QPS, **sim_kwargs):
-    requests, conversations, rng = build_workload(qps=qps)
+def run_strategy(
+    strategy: str, qps: float = QPS, workload: str = "chat", **sim_kwargs
+):
+    if workload == "capacity":
+        requests, conversations, rng = build_capacity_workload(qps=qps)
+    else:
+        requests, conversations, rng = build_workload(qps=qps)
     sim = FleetSim(strategy, **sim_kwargs)
     ttfts = []
     try:
@@ -424,14 +518,19 @@ def run_strategy(strategy: str, qps: float = QPS, **sim_kwargs):
             question = _text(rng, QUESTION_WORDS)
             prompt = conversations[conv_id] + " [user] " + question
             ttfts.append(sim.serve(arrival, prompt))
-            # Assistant response extends the conversation (next turn's prefix).
-            conversations[conv_id] = prompt + " [assistant] " + _text(rng, RESPONSE_WORDS)
+            if workload != "capacity":
+                # Assistant response extends the conversation (next turn's
+                # prefix); capacity-regime requests are single-turn.
+                conversations[conv_id] = (
+                    prompt + " [assistant] " + _text(rng, RESPONSE_WORDS)
+                )
         hit_rate = sim.hit_tokens / max(sim.total_tokens, 1)
         lat = sorted(sim.read_latencies)
         read_p50 = lat[len(lat) // 2] if lat else 0.0
         extras = {
             "restored_blocks": sim.restored_blocks,
             "onboarded_blocks": sim.onboarded_blocks,
+            "preemptions": sim.preemptions,
             "gated_blocks": sum(
                 pod.tier_store.stats["gated_blocks"]
                 for pod in sim.pods if pod.tier_store is not None
@@ -533,15 +632,17 @@ def run_qps_ladder(pressured_raw=None):
         row = {}
         for arm in arms:
             if qps == QPS and pressured_raw and arm in pressured_raw:
-                ttfts, hit = pressured_raw[arm]
+                ttfts, hit, ex = pressured_raw[arm]
             else:
-                ttfts, hit, _, _ = run_strategy(
-                    arm, qps=qps, pages_per_pod=TWO_TIER_PAGES_PER_POD
+                ttfts, hit, _, ex = run_strategy(
+                    arm, qps=qps, workload="capacity",
+                    pages_per_pod=CAPACITY_PAGES_PER_POD,
                 )
             row[arm] = {
                 "ttft_p50_s": round(p50(ttfts), 4),
                 "ttft_p90_s": round(p90(ttfts), 4),
                 "prefix_hit_rate": round(hit, 4),
+                "preemptions": ex["preemptions"],
             }
         row["precise_vs_round_robin_p90"] = round(
             row["round_robin"]["ttft_p90_s"]
@@ -664,29 +765,28 @@ def main():
     ttft_rr, _, _, _ = run_strategy("round_robin")
 
     # The reference's 4-arm comparison (precise / estimated / load / random,
-    # 37-capacity/README.md:230-253) plus round_robin — run under HBM
-    # pressure (the reference's runs sit at ~73% resident fill) because
-    # that's where the arms genuinely separate: estimation is only wrong
-    # once eviction invalidates routing history.
+    # 37-capacity/README.md:230-253) plus round_robin — run on the
+    # capacity-regime workload (single-turn shared-prefix fan-in at ~70%
+    # nominal resident fill, the 73-capacity shape) because that's where
+    # the arms genuinely separate: estimation is only wrong once
+    # eviction/preemption invalidates routing history, and multi-turn chat
+    # re-warms whatever pod the conversation lands on.
     arms = ("precise", "estimated", "load", "random", "round_robin")
     results = {}
     raw = {}
     for arm in arms:
-        ttfts, hit, _, _ = run_strategy(
-            arm, pages_per_pod=TWO_TIER_PAGES_PER_POD
+        ttfts, hit, _, ex = run_strategy(
+            arm, workload="capacity", pages_per_pod=CAPACITY_PAGES_PER_POD
         )
-        raw[arm] = (ttfts, hit)
+        raw[arm] = (ttfts, hit, ex)
         results[arm] = {
             "ttft_p50_s": round(p50(ttfts), 4),
             "ttft_p90_s": round(p90(ttfts), 4),
             "ttft_mean_s": round(sum(ttfts) / len(ttfts), 4),
             "prefix_hit_rate": round(hit, 4),
+            "preemptions": ex["preemptions"],
         }
-    # The pressured precise/round_robin arms double as the two-tier
-    # host-tier-OFF baselines (identical deterministic configs).
-    two_tier = run_two_tier_comparison(
-        baseline_precise=raw["precise"], baseline_rr=raw["round_robin"]
-    )
+    two_tier = run_two_tier_comparison()
     winning = run_winning_regime()
     ladder = run_qps_ladder(pressured_raw=raw)
 
@@ -701,6 +801,10 @@ def main():
             "users_per_group": USERS_PER_GROUP,
             "turns_per_user": TURNS_PER_USER,
             "qps": QPS,
+            "itl_s_per_token": ITL_S_PER_TOKEN,
+            "capacity_groups": CAPACITY_GROUPS,
+            "capacity_pages_per_pod": CAPACITY_PAGES_PER_POD,
+            "capacity_requests": CAPACITY_REQUESTS,
         },
         "sim_ttft_p50_speedup": round(speedup, 3),
         "ttft_p50_precise_s": round(p50(ttft_precise), 4),
@@ -710,7 +814,13 @@ def main():
         "prefix_hit_rate": round(hit_rate, 4),
         "read_path_p50_ms": round(read_p50 * 1e3, 3),
         "strategies_under_pressure": {
-            "hbm_pages_per_pod": TWO_TIER_PAGES_PER_POD,
+            "hbm_pages_per_pod": CAPACITY_PAGES_PER_POD,
+            "workload": (
+                f"capacity regime: single-turn fan-in over "
+                f"{CAPACITY_GROUPS} shared-prefix groups (~70% nominal "
+                "resident fill) with decode page-holds and "
+                "recompute-preemption — the 73-capacity shape"
+            ),
             "arms": results,
         },
         "two_tier": two_tier,

@@ -52,6 +52,10 @@ def test_fleet_bench_artifact_matches_bench_config():
     assert cfg["users_per_group"] == const("USERS_PER_GROUP")
     assert cfg["turns_per_user"] == const("TURNS_PER_USER")
     assert cfg["qps"] == const("QPS")
+    assert cfg["itl_s_per_token"] == const("ITL_S_PER_TOKEN")
+    assert cfg["capacity_groups"] == const("CAPACITY_GROUPS")
+    assert cfg["capacity_pages_per_pod"] == const("CAPACITY_PAGES_PER_POD")
+    assert cfg["capacity_requests"] == const("CAPACITY_REQUESTS")
     # Volatile / duplicated fields must stay out of the committed artifact.
     assert "wall_s" not in artifact
     assert "device_measured_fleet" not in artifact
