@@ -8,8 +8,11 @@
 # Steps:
 #   1. device_bench (full): DEVICE_BENCH.json — multistep batch x steps
 #      grid, pipeline-depth sweep, seq-4096 prefill, flash-vs-jnp prefill.
-#   2. fleet_device_bench (full): FLEET_DEVICE_BENCH.json — 200 req/arm,
-#      precise/random/round_robin, measured TTFT.
+#   2. fleet_device_bench (full): FLEET_DEVICE_BENCH.json — open-loop v3
+#      (Poisson @ qps, per-pod queue), 200 req/arm,
+#      precise/random/round_robin, measured service times. If precise
+#      saturates (queue_wait_p90 >> service_p50), lower FULL_MODES.v3.qps
+#      and rerun before committing the artifact.
 #   3. gen_readme: re-render the generated README sections.
 #   4. pytest: artifact coherence + cost-model pins.
 set -u
