@@ -21,7 +21,7 @@ COPY services ./services
 
 RUN pip install --no-cache-dir \
         msgpack xxhash pyzmq tokenizers prometheus-client aiohttp \
-        "transformers>=4.40" grpcio protobuf \
+        "transformers>=4.40" grpcio protobuf gunicorn uvloop \
     && cd native && python setup.py build_ext \
     && cd ../kv_connectors/cpp && make
 

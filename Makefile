@@ -1,8 +1,24 @@
 # Build/test entry points (parity with the reference's Makefile targets:
-# build/test/bench/lint + pre-commit install — /root/reference/Makefile,
-# /root/reference/hooks/pre-commit.sh).
+# build/test/bench/lint/image-build/image-push + pre-commit install —
+# /root/reference/Makefile, /root/reference/hooks/pre-commit.sh).
 
-.PHONY: native test bench clean proto lint precommit-install
+.PHONY: native test bench clean proto lint precommit-install \
+	image-build image-push
+
+# Container image coordinates (override per environment/registry). The
+# release workflow (.github/workflows/ci-release.yaml) builds the same
+# Dockerfile on v* tags; these targets are the local/manual equivalent.
+IMAGE_REGISTRY ?= ghcr.io/llm-d
+IMAGE_NAME ?= kv-cache-manager-tpu
+IMAGE_TAG ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo dev)
+IMG ?= $(IMAGE_REGISTRY)/$(IMAGE_NAME):$(IMAGE_TAG)
+CONTAINER_TOOL ?= $(shell command -v docker >/dev/null 2>&1 && echo docker || echo podman)
+
+image-build:
+	$(CONTAINER_TOOL) build -t $(IMG) .
+
+image-push:
+	$(CONTAINER_TOOL) push $(IMG)
 
 native:
 	cd native && python setup.py build_ext

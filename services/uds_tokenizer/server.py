@@ -165,6 +165,66 @@ def create_app_for_worker(
     return make_app(_worker_service)
 
 
+async def gunicorn_app() -> web.Application:
+    """The gunicorn entry target:
+
+        gunicorn services.uds_tokenizer.server:gunicorn_app \
+            --worker-class aiohttp.GunicornUVLoopWebWorker \
+            --bind unix:/tmp/tokenizer/tokenizer-uds.socket --bind 0.0.0.0:8080
+
+    gunicorn owns the sockets (UDS + TCP probe); each prefork worker builds
+    its app through the flock-guarded per-process init. Mirrors the
+    reference's production entry (server.py:317-353)."""
+    return create_app_for_worker()
+
+
+def _gunicorn_argv(
+    socket_path: str, probe_port: int, workers: int, with_uvloop: bool
+) -> list[str]:
+    """argv for the production preforking server (pure; unit-tested)."""
+    worker_class = (
+        "aiohttp.GunicornUVLoopWebWorker" if with_uvloop
+        else "aiohttp.GunicornWebWorker"
+    )
+    argv = [
+        "gunicorn",
+        "services.uds_tokenizer.server:gunicorn_app",
+        "--worker-class", worker_class,
+        "--workers", str(workers),
+        "--bind", f"unix:{socket_path}",
+    ]
+    if probe_port > 0:
+        argv += ["--bind", f"0.0.0.0:{probe_port}"]
+    return argv
+
+
+def _exec_production(socket_path: str, probe_port: int, workers: int) -> None:
+    """Replace this process with gunicorn (the Helm chart's sidecar entry).
+    Falls back to the in-process dev runner — loudly — when gunicorn is not
+    installed, so a mis-built image still serves rather than crash-loops."""
+    os.makedirs(os.path.dirname(socket_path) or ".", exist_ok=True)
+    if os.path.exists(socket_path):
+        os.unlink(socket_path)
+    try:
+        import gunicorn  # noqa: F401
+    except ImportError:
+        logger.warning(
+            "--production requested but gunicorn is not installed; "
+            "falling back to the single-process dev runner"
+        )
+        install_uvloop_if_present()
+        asyncio.run(run_server(socket_path, probe_port))
+        return
+    try:
+        import uvloop  # noqa: F401
+        with_uvloop = True
+    except ImportError:
+        with_uvloop = False
+    argv = _gunicorn_argv(socket_path, probe_port, workers, with_uvloop)
+    logger.info("exec: %s", " ".join(argv))
+    os.execvp(argv[0], argv)
+
+
 def main() -> None:
     logging.basicConfig(level=logging.INFO)
     parser = argparse.ArgumentParser()
@@ -174,7 +234,20 @@ def main() -> None:
         type=int,
         default=int(os.environ.get("PROBE_PORT", DEFAULT_PROBE_PORT)),
     )
+    parser.add_argument(
+        "--production", action="store_true",
+        default=os.environ.get("UDS_PRODUCTION", "") == "1",
+        help="preforking gunicorn workers (uvloop when installed) instead "
+             "of the single-process dev runner",
+    )
+    parser.add_argument(
+        "--workers", type=int,
+        default=int(os.environ.get("UDS_WORKERS", "2")),
+    )
     args = parser.parse_args()
+    if args.production:
+        _exec_production(args.socket, args.probe_port, args.workers)
+        return
     install_uvloop_if_present()
     asyncio.run(run_server(args.socket, args.probe_port))
 
