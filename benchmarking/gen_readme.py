@@ -421,6 +421,36 @@ def device_section() -> str:
                 f"| {dp['onboard_chain_mbps']} "
                 f"| {dp['dcn_onboard_chain_s_per_token']:.1e} |",
             ]
+        if dp.get("batch_ladder"):
+            out += [
+                "",
+                "Batch-size ladder (one dispatch per batch; VERDICT r4 #7 "
+                "— amortizing the fixed dispatch cost):",
+                "",
+                "| pages/dispatch | extract MB/s | insert MB/s |",
+                "|---:|---:|---:|",
+            ] + [
+                f"| {r['pages']} | {r['extract_mbps']} | {r['insert_mbps']} |"
+                for r in dp["batch_ladder"]
+            ]
+        if "extract_stream_mbps" in dp:
+            out += [
+                "",
+                f"Fixed-cost/streaming decomposition (least-squares over "
+                f"the ladder): extract = {dp['extract_fixed_ms']}ms fixed + "
+                f"{dp['extract_stream_mbps']} MB/s streaming; insert = "
+                f"{dp.get('insert_fixed_ms', '—')}ms fixed + "
+                f"{dp.get('insert_stream_mbps', '—')} MB/s streaming — the "
+                "streaming terms are this rig's measured HBM↔host floor.",
+            ]
+        if "extract_overlap_mbps" in dp:
+            out += [
+                "",
+                f"Pipelined extract (enqueued gather waves): "
+                f"**{dp['extract_overlap_mbps']} MB/s** vs "
+                f"{dp.get('extract_batch_mbps', '—')} MB/s single-dispatch "
+                "— whether transfer waves overlap on this rig.",
+            ]
         if "onboard_mbps" in dp:
             out += [
                 "",
