@@ -40,7 +40,8 @@ WORKDIR /app
 USER 10001
 
 # Env contract (see api/http_service.py): ZMQ_ENDPOINT, ZMQ_TOPIC,
-# POOL_CONCURRENCY, PYTHONHASHSEED, BLOCK_SIZE, HTTP_PORT, HF_TOKEN,
+# POOL_CONCURRENCY, PYTHONHASHSEED, BLOCK_SIZE, BLOCK_HASH_ALGO, HTTP_PORT,
+# HF_TOKEN,
 # LOCAL_TOKENIZER_DIR, ENABLE_HF_TOKENIZER, ENABLE_METRICS.
 EXPOSE 8080 5557
 ENTRYPOINT ["python", "-m", "llm_d_kv_cache_manager_tpu.api.http_service"]

@@ -31,9 +31,27 @@ app.kubernetes.io/managed-by: {{ .Release.Service }}
 {{- $bs -}}
 {{- end -}}
 
+{{/*
+Third fleet invariant: the block-hash algorithm. sha256_cbor_64bit (the
+default) is passed to the vLLM pods as --prefix-caching-hash-algo AND to
+the manager as BLOCK_HASH_ALGO, so indexer request keys equal the engine's
+own block hashes bit-for-bit (proven by tests/test_hash_parity.py
+TestVllmVectors). fnv64_cbor keeps the reference scheme; the engines then
+run their default algo and the manager relies on the dual-key
+engine-to-request mapping instead of hash equality.
+*/}}
+{{- define "kvcache.hashAlgo" -}}
+{{- $a := default "sha256_cbor_64bit" .Values.hashAlgo -}}
+{{- if not (has $a (list "fnv64_cbor" "sha256_cbor_64bit")) -}}
+{{- fail (printf "hashAlgo %q is not supported (fnv64_cbor|sha256_cbor_64bit)" $a) -}}
+{{- end -}}
+{{- $a -}}
+{{- end -}}
+
 {{- define "kvcache.validateInvariants" -}}
 {{- include "kvcache.hashSeed" . | trim -}}
 {{- include "kvcache.blockSize" . | trim -}}
+{{- include "kvcache.hashAlgo" . | trim -}}
 {{- if and .Values.valkey.enabled (not .Values.manager.indexUrl) -}}
 {{- /* default wiring: manager uses the chart's valkey */ -}}
 {{- else if and (not .Values.valkey.enabled) (not .Values.manager.indexUrl) (gt (int .Values.manager.replicas) 1) -}}

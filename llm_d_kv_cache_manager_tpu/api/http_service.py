@@ -13,7 +13,7 @@ the ZMQ KVEvents write plane, and Prometheus metrics behind HTTP:
 
 Env config mirrors the reference's variable set (online/main.go:41-58):
 ZMQ_ENDPOINT, ZMQ_TOPIC, POOL_CONCURRENCY, PYTHONHASHSEED (hash seed!),
-BLOCK_SIZE, HTTP_PORT, HF_TOKEN, LOCAL_TOKENIZER_DIR.
+BLOCK_SIZE, BLOCK_HASH_ALGO, HTTP_PORT, HF_TOKEN, LOCAL_TOKENIZER_DIR.
 
 Run: python -m llm_d_kv_cache_manager_tpu.api.http_service
 """
@@ -49,6 +49,9 @@ def config_from_env() -> dict:
         "zmq_topic": os.environ.get("ZMQ_TOPIC", "kv@"),
         "pool_concurrency": int(os.environ.get("POOL_CONCURRENCY", "4")),
         "hash_seed": os.environ.get("PYTHONHASHSEED", ""),
+        # "fnv64_cbor" (reference parity) or "sha256_cbor_64bit" (bit-exact
+        # with vLLM --prefix-caching-hash-algo=sha256_cbor_64bit fleets).
+        "hash_algo": os.environ.get("BLOCK_HASH_ALGO", "fnv64_cbor"),
         "block_size": int(os.environ.get("BLOCK_SIZE", "16")),
         "http_port": int(os.environ.get("HTTP_PORT", "8080")),
         "hf_token": os.environ.get("HF_TOKEN"),
@@ -84,7 +87,9 @@ class ScoringService:
                 )
             indexer_config = IndexerConfig(
                 token_processor_config=TokenProcessorConfig(
-                    block_size=env["block_size"], hash_seed=env["hash_seed"]
+                    block_size=env["block_size"],
+                    hash_seed=env["hash_seed"],
+                    hash_algo=env.get("hash_algo", "fnv64_cbor"),
                 ),
                 kv_block_index_config=index_config,
                 tokenizers_pool_config=TokenizersPoolConfig(

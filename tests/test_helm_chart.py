@@ -108,8 +108,9 @@ class TestScheduling:
 
         known = {
             "ZMQ_ENDPOINT", "ZMQ_TOPIC", "POOL_CONCURRENCY", "PYTHONHASHSEED",
-            "BLOCK_SIZE", "HTTP_PORT", "HF_TOKEN", "ENABLE_HF_TOKENIZER",
-            "ENABLE_METRICS", "INDEX_URL", "UDS_SOCKET",
+            "BLOCK_SIZE", "BLOCK_HASH_ALGO", "HTTP_PORT", "HF_TOKEN",
+            "ENABLE_HF_TOKENIZER", "ENABLE_METRICS", "INDEX_URL",
+            "UDS_SOCKET",
         }
         # config_from_env documents the contract; catch drift both ways.
         import inspect
