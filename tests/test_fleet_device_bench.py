@@ -37,6 +37,63 @@ def test_quick_mode_runs_the_full_stack():
     )
 
 
+def _load_fdb():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("fdb_mod", BENCH)
+    fdb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fdb)
+    return fdb
+
+
+def test_open_loop_replay_clock_math():
+    """The v3 open-loop replay must turn measured service times into queue
+    waits correctly — the chip session is one-shot, so the virtual-clock
+    arithmetic is pinned here with a fake fleet (fixed 0.1s service,
+    0.04s compute-TTFT, one pod)."""
+    fdb = _load_fdb()
+
+    class _FakeFleet:
+        def __init__(self, strategy, n_pods, *a, **k):
+            self.hit_tokens = 0
+            self.total_tokens = 1
+
+        def serve(self, prompt, max_new):
+            return 0.04, 0.1, 1, 0
+        def close(self):
+            pass
+
+    real = fdb.DeviceFleet
+    fdb.DeviceFleet = _FakeFleet
+    try:
+        workload = ({"c": "hello world"}, [("c", 0)] * 50, 7, 3)
+        # Saturating rate: arrivals ~2.5x faster than the single pod's
+        # 0.1s service, so waits must grow roughly linearly.
+        sat = fdb.run_fleet("round_robin", None, workload, 1, 8, 1, 1,
+                            False, qps=25.0)
+        assert sat["ttft_compute_p50_s"] == 0.04
+        assert sat["service_p50_s"] == 0.1
+        # With ~0.06s of new backlog per request, the median request has
+        # waited on the order of a second; far above the compute TTFT.
+        assert sat["queue_wait_p50_s"] > 0.5
+        assert abs(
+            sat["ttft_p50_s"] - (sat["queue_wait_p50_s"] + 0.04)
+        ) < 0.05
+        # Idle rate: arrivals ~25x slower than service — no queueing, so
+        # measured TTFT must equal the compute TTFT.
+        idle = fdb.run_fleet("round_robin", None, workload, 1, 8, 1, 1,
+                             False, qps=0.4)
+        assert idle["queue_wait_p90_s"] == 0.0
+        assert idle["ttft_p50_s"] == 0.04
+        # Closed-loop fallback unchanged.
+        closed = fdb.run_fleet("round_robin", None, workload, 1, 8, 1, 1,
+                               False, qps=None)
+        assert closed["ttft_p50_s"] == 0.04
+        assert "queue_wait_p50_s" not in closed
+    finally:
+        fdb.DeviceFleet = real
+
+
 def test_committed_artifact_is_coherent():
     if not ARTIFACT.exists():
         import pytest
@@ -46,11 +103,7 @@ def test_committed_artifact_is_coherent():
     assert d["backend"] == "tpu", "artifact must come from a real-chip run"
     # The artifact must have been produced by the CURRENT full-mode config —
     # otherwise the README republishes numbers this code can't reproduce.
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location("fdb", BENCH)
-    fdb = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(fdb)
+    fdb = _load_fdb()
     # The artifact pins the configuration that produced it; that config
     # must be one this code still ships, field for field — a sys_words or
     # turns drift changes hit rates without touching the pod shape.
