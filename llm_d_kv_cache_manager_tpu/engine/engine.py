@@ -135,6 +135,38 @@ class _DevicePageCodec(PageCodec):
             for i in range(n)
         ]
 
+    def extract_many_async(self, page_ids):
+        """Snapshot pages for background staging: the gather dispatch and
+        the device→host copy start NOW (enqueued behind whatever compute is
+        already queued, so the transfer overlaps it), and resolve() pays
+        only the residual sync. The gather consumes kv_cache in enqueue
+        order, so a later scatter/donation reusing these pages cannot
+        corrupt the snapshot."""
+        import jax
+        import jax.numpy as jnp
+
+        ids = list(page_ids)
+        if not ids:
+            return lambda: []
+        n = len(ids)
+        bucket = _pad_bucket(n)
+        padded = np.asarray(ids + [ids[-1]] * (bucket - n), dtype=np.int32)
+        parts = _gather_pages(self.pod.kv_cache, jnp.asarray(padded))
+        for p in parts:
+            try:
+                p.copy_to_host_async()
+            except Exception:  # noqa: BLE001 - a hint; device_get still works
+                pass
+
+        def resolve():
+            host = jax.device_get(parts)
+            return [
+                b"".join(np.ascontiguousarray(p[i]).tobytes() for p in host)
+                for i in range(n)
+            ]
+
+        return resolve
+
     def insert(self, page_id: int, payload: bytes) -> None:
         self.insert_many([(page_id, payload)])
 
@@ -215,6 +247,13 @@ class EnginePodConfig:
     # Ready-buffer bound for the async payload prefetcher (blocks held in
     # host RAM awaiting their device insert); <=0 disables prefetch.
     prefetch_capacity_blocks: int = 64
+    # Eager staging: free() snapshots the sequence's committed pages (one
+    # enqueued gather whose host copy overlaps queued compute) and a
+    # background thread admits them to the host store — so a later reclaim
+    # finds them resident instead of paying a synchronous extract on the
+    # allocation path (VERDICT r4 #7 overlap lever). Off by default:
+    # free-then-rehit workloads would snapshot pages that never evict.
+    eager_stage: bool = False
 
 
 class EnginePod:
@@ -589,6 +628,17 @@ class EnginePod:
         return token
 
     def free(self, state: SequenceState) -> None:
+        if (
+            self.tier_store is not None
+            and self.config.eager_stage
+            and self.config.with_model
+        ):
+            # Snapshot while the pages are still committed; the gather is
+            # enqueued on this (serving) thread, so it precedes any later
+            # allocation's overwrite in device order.
+            self.tier_store.stage_async(
+                list(self.block_manager.committed_blocks(state))
+            )
         self.block_manager.free(state)
 
     # -- data plane -----------------------------------------------------------
