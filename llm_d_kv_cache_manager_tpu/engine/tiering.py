@@ -407,7 +407,11 @@ class TieredKVStore:
         """Stop the prefetcher and stager (idempotent; safe when they never
         started). Pending batches drain unfetched/unresolved — see
         _prefetch_loop / _stager_loop."""
-        self._closed = True
+        with self._mu:
+            # Under _mu: stage_async's closed-check is also under the lock,
+            # so a racing free() can no longer register entries (and
+            # _ensure_stager no longer spawns) after this point.
+            self._closed = True
         if self._prefetch_thread is not None and self._prefetch_thread.is_alive():
             self._prefetch_q.put(None)
             self._prefetch_thread.join(timeout=5.0)
@@ -430,6 +434,7 @@ class TieredKVStore:
         only the residual sync instead of a fresh extract."""
         fresh = []
         n_resident = 0
+        pending_blocks = []
         pending_entries = []
         with self._mu:
             for block in blocks:
@@ -438,6 +443,7 @@ class TieredKVStore:
                     n_resident += 1
                 elif block[0] in self._pending_stage:
                     entry = self._pending_stage[block[0]]
+                    pending_blocks.append(block)
                     if entry not in pending_entries:
                         pending_entries.append(entry)
                 else:
@@ -445,7 +451,18 @@ class TieredKVStore:
         for entry in pending_entries:
             # An entry may cover more blocks than requested; admitting the
             # superset is harmless (they were all freed together).
-            n_resident += self._resolve_entry(entry)
+            self._resolve_entry(entry)
+        # Count only the REQUESTED blocks that actually landed (the
+        # superset's extras get counted by their own reclaim wave, if any)
+        # and fall back to a synchronous extract for requested blocks whose
+        # snapshot failed to admit — the page content is still valid here,
+        # so losing the snapshot must not lose the block.
+        with self._mu:
+            for block in pending_blocks:
+                if block[0] in self._staged:
+                    n_resident += 1
+                else:
+                    fresh.append(block)
         if not fresh:
             return n_resident
         payloads = self.codec.extract_many([b[3] for b in fresh])
@@ -495,9 +512,11 @@ class TieredKVStore:
         the allocation path. Returns the number of snapshots initiated;
         blocks beyond the in-flight budget fall back to the synchronous
         reclaim-time stage."""
-        if self._closed or self._async_stage_cap <= 0 or not blocks:
+        if self._async_stage_cap <= 0 or not blocks:
             return 0
         with self._mu:
+            if self._closed:
+                return 0
             budget = self._async_stage_cap - self._pending_pages
             fresh = []
             for b in blocks:
@@ -509,14 +528,24 @@ class TieredKVStore:
                 budget -= 1
             if not fresh:
                 return 0
-            # Enqueue the snapshot while holding the lock: registration
-            # must be atomic with the membership check or a concurrent
-            # stage_async could double-snapshot the same hashes.
-            resolve = self.codec.extract_many_async([b[3] for b in fresh])
-            entry = {"blocks": fresh, "resolve": resolve, "claimed": False}
+            # Register under the lock (atomic with the membership check so
+            # a concurrent stage_async can't double-snapshot), but keep the
+            # codec call OUTSIDE it — device I/O under _mu would stall
+            # every membership check. Claimants arriving before the
+            # snapshot is enqueued wait on `ready`.
+            entry = {
+                "blocks": fresh, "resolve": None, "claimed": False,
+                "ready": threading.Event(),
+            }
             for b in fresh:
                 self._pending_stage[b[0]] = entry
             self._pending_pages += len(fresh)
+        try:
+            entry["resolve"] = self.codec.extract_many_async(
+                [b[3] for b in fresh]
+            )
+        finally:
+            entry["ready"].set()
         self._ensure_stager()
         self._stage_q.put(entry)
         return len(fresh)
@@ -536,14 +565,20 @@ class TieredKVStore:
     def _resolve_entry(self, entry: dict) -> int:
         if not self._claim_entry(entry):
             return 0
+        entry["ready"].wait(timeout=30.0)
+        resolve = entry["resolve"]
+        if resolve is None:  # snapshot enqueue itself failed
+            return 0
         try:
-            payloads = entry["resolve"]()
+            payloads = resolve()
         except Exception as e:  # noqa: BLE001 - best-effort snapshot
             logger.debug("eager stage resolve failed: %s", e)
             return 0
         return self._admit_payloads(entry["blocks"], payloads)
 
     def _ensure_stager(self) -> None:
+        if self._closed:
+            return
         if self._stage_thread is None or not self._stage_thread.is_alive():
             self._stage_thread = threading.Thread(
                 target=self._stager_loop, name="kv-tier-stager", daemon=True
@@ -553,27 +588,33 @@ class TieredKVStore:
     def _stager_loop(self) -> None:
         while True:
             entry = self._stage_q.get()
-            if entry is None:
-                return
             try:
+                if entry is None:
+                    return
                 if not self._closed:
                     self._resolve_entry(entry)
                 else:
                     self._claim_entry(entry)  # drop without resolving
             except Exception as e:  # noqa: BLE001 - stager must not die
                 logger.debug("eager stage failed: %s", e)
+            finally:
+                self._stage_q.task_done()
 
     def drain_async_stages(self) -> None:
-        """Resolve every in-flight snapshot inline (test/shutdown helper)."""
+        """Resolve every in-flight snapshot (test/shutdown helper): claims
+        whatever is still pending inline, then waits for the stager thread
+        to finish any entry it already claimed but has not admitted."""
         while True:
             with self._mu:
                 entries = {
                     id(e): e for e in self._pending_stage.values()
                 }
             if not entries:
-                return
+                break
             for entry in entries.values():
                 self._resolve_entry(entry)
+        if self._stage_thread is not None and self._stage_thread.is_alive():
+            self._stage_q.join()
 
     @property
     def staged_count(self) -> int:
