@@ -380,6 +380,18 @@ def device_section() -> str:
             + f". `_PIPELINE_DEPTH` ships at the measured best "
             f"({best['depth']}).",
         ]
+    es = d.get("eager_stage") or {}
+    if "reclaim_path_speedup" in es:
+        out += [
+            "",
+            f"Eager staging (`EnginePodConfig.eager_stage`: free() "
+            "snapshots pages; the extract+admit rides queued compute "
+            "instead of the allocation path): reclaim-heavy cycle "
+            f"**{es['cycle_ms_sync']}ms → {es['cycle_ms_eager']}ms "
+            f"({es['reclaim_path_speedup']}×)**, identical staging work in "
+            f"both arms ({es['offloads_sync']} offloads each, "
+            f"{es['restores']} restores).",
+        ]
     dp = d.get("data_plane")
     if dp and "extract_mbps" in dp:
         out += [
