@@ -61,13 +61,34 @@ import json
 d = json.load(open("benchmarking/DEVICE_BENCH.json"))
 best = d.get("analysis", {}).get("multistep_best")
 print("multistep best:", best)
+print("engine decode waves:", [
+    (r.get("n_steps"), r.get("pct_of_hbm_roofline"))
+    for r in d.get("engine_decode_wave", []) if "n_steps" in r
+])
+print("eager stage:", {
+    k: d.get("eager_stage", {}).get(k)
+    for k in ("reclaim_path_speedup", "offloads_sync", "offloads_eager")
+})
+dp = d.get("data_plane", {})
+print("data-plane ladder:", dp.get("batch_ladder"))
+print("data-plane fit: extract", dp.get("extract_fixed_ms"), "ms +",
+      dp.get("extract_stream_mbps"), "MB/s; insert",
+      dp.get("insert_fixed_ms"), "ms +", dp.get("insert_stream_mbps"),
+      "MB/s; overlap", dp.get("extract_overlap_mbps"), "MB/s")
 print("pipeline depth:", d.get("pipeline_depth"))
 flash = [r for r in d.get("prefill_flash", []) if "seq" in r]
 base = {r["seq"]: r["ms"] for r in d.get("prefill", [])}
 for r in flash:
     print(f"flash prefill seq {r['seq']}: {r['ms']}ms vs jnp {base.get(r['seq'])}ms")
 f = json.load(open("benchmarking/FLEET_DEVICE_BENCH.json"))
+p = f.get("precise", {})
 print("fleet ttft_p50_speedup:", f.get("ttft_p50_speedup"),
-      "requests/arm:", f.get("precise", {}).get("requests"))
+      "requests/arm:", p.get("requests"), "qps:", p.get("qps"))
+print("precise queue p50/p90:", p.get("queue_wait_p50_s"),
+      p.get("queue_wait_p90_s"), "service p50:", p.get("service_p50_s"))
+if (p.get("queue_wait_p90_s") or 0) > 3 * (p.get("service_p50_s") or 1e9):
+    print("WARNING: precise arm looks SATURATED at this qps — lower "
+          "FULL_MODES['v3']['qps'] in fleet_device_bench.py and rerun "
+          "before committing the artifact")
 EOF
 exit "$fails"
