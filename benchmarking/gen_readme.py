@@ -181,30 +181,56 @@ def fleet_device_section() -> str:
         )
     d = _load(path)
     c = d["config"]
+    open_loop = "qps" in d.get("precise", {})
     out = [
         f"{c['n_pods']} real-compute EnginePods ({c['d_model']}d × "
         f"{c['n_layers']}L flagship-lite, {c['n_pages_per_pod']} pages/pod, "
         f"{c['decode_steps']}-step on-device decode) on `{d['device']}`; "
         "full stack per request: tokenization → `Indexer.get_pod_scores` → "
         "paged prefill/decode on the chip → msgpack KVEvents → index. "
-        "TTFT is wall-clock to the first sampled token; closed-loop, so "
-        "the precise-vs-round-robin gap is pure prefill compute saved by "
-        "cache hits (no queueing model).",
+        + (
+            f"Open-loop: Poisson arrivals at {d['precise']['qps']:g} QPS "
+            "with per-pod FIFO queues, replayed against measured per-"
+            "request service times on a virtual per-pod clock (one chip "
+            "serializes the pods); TTFT = queue wait + measured time to "
+            "first token."
+            if open_loop
+            else "TTFT is wall-clock to the first sampled token; "
+            "closed-loop, so the precise-vs-round-robin gap is pure "
+            "prefill compute saved by cache hits (no queueing model)."
+        ),
         "",
-        "| Strategy | TTFT p50 (s) | TTFT p90 (s) | TTFT mean (s) "
-        "| Hit rate | Output tok/s |",
-        "|---|---:|---:|---:|---:|---:|",
     ]
+    if open_loop:
+        out += [
+            "| Strategy | TTFT p50 (s) | TTFT p90 (s) | Queue wait "
+            "p50/p90 (s) | Service p50 (s) | Hit rate |",
+            "|---|---:|---:|---:|---:|---:|",
+        ]
+    else:
+        out += [
+            "| Strategy | TTFT p50 (s) | TTFT p90 (s) | TTFT mean (s) "
+            "| Hit rate | Output tok/s |",
+            "|---|---:|---:|---:|---:|---:|",
+        ]
     for arm in ("precise", "random", "round_robin"):
         if arm not in d:
             continue
         r = d[arm]
         bold = "**" if arm == "precise" else ""
-        out.append(
-            f"| {arm} | {bold}{r['ttft_p50_s']}{bold} | {r['ttft_p90_s']} "
-            f"| {r['ttft_mean_s']} | {r['prefix_hit_rate']:.1%} "
-            f"| {r['output_tokens_per_s']} |"
-        )
+        if open_loop:
+            out.append(
+                f"| {arm} | {bold}{r['ttft_p50_s']}{bold} "
+                f"| {r['ttft_p90_s']} "
+                f"| {r['queue_wait_p50_s']} / {r['queue_wait_p90_s']} "
+                f"| {r['service_p50_s']} | {r['prefix_hit_rate']:.1%} |"
+            )
+        else:
+            out.append(
+                f"| {arm} | {bold}{r['ttft_p50_s']}{bold} | {r['ttft_p90_s']} "
+                f"| {r['ttft_mean_s']} | {r['prefix_hit_rate']:.1%} "
+                f"| {r['output_tokens_per_s']} |"
+            )
     if "precise" in d and "ttft_p50_speedup" in d:
         out += [
             "",
