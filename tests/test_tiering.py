@@ -288,6 +288,74 @@ class TestCrossPodOnboard:
         finally:
             pod.close()
 
+    def test_eager_stage_budget_duplicates_and_failed_resolve(self):
+        """Edge cases of the eager path, against a fake connector/codec:
+        the in-flight budget truncates, duplicate snapshots are suppressed,
+        and a snapshot whose resolve raises falls back to the synchronous
+        extract at reclaim (the block must not be lost)."""
+        from llm_d_kv_cache_manager_tpu.engine.tiering import (
+            PageCodec,
+            TieredKVStore,
+        )
+
+        class _FakeConnector:
+            def __init__(self):
+                self.store = {}
+
+            def stage(self, h, payload, token_ids, n, parent, lora_id=None):
+                self.store[h] = payload
+
+            def drop(self, h):
+                self.store.pop(h, None)
+
+            def fetch_staged(self, h, max_size):
+                return self.store.get(h)
+
+        class _Codec(PageCodec):
+            page_nbytes = 4
+
+            def __init__(self):
+                self.sync_calls = 0
+                self.fail_async = False
+
+            def extract_many(self, page_ids):
+                self.sync_calls += 1
+                return [b"p%03d" % i for i in page_ids]
+
+            def extract_many_async(self, page_ids):
+                payloads = [b"p%03d" % i for i in page_ids]
+                if self.fail_async:
+                    def boom():
+                        raise RuntimeError("snapshot lost")
+                    return boom
+                return lambda: payloads
+
+        def block(i):
+            return (1000 + i, [i], None, i, None)
+
+        conn, codec = _FakeConnector(), _Codec()
+        store = TieredKVStore(conn, codec, async_stage_capacity_pages=2)
+        try:
+            # Budget: only 2 of 4 snapshots start; duplicates suppressed.
+            assert store.stage_async([block(i) for i in range(4)]) == 2
+            assert store.stage_async([block(0), block(1)]) == 0
+            store.drain_async_stages()
+            assert store.staged_count == 2
+            # The un-snapshotted blocks stage synchronously at reclaim.
+            assert store._stage_many([block(i) for i in range(4)]) == 4
+            assert store.staged_count == 4
+
+            # Failed resolve: the reclaim-time claim falls back to a
+            # synchronous extract instead of losing the block.
+            codec.fail_async = True
+            assert store.stage_async([block(9)]) == 1
+            codec.sync_calls = 0
+            assert store._stage_many([block(9)]) == 1
+            assert codec.sync_calls == 1  # the fallback extract
+            assert conn.fetch_staged(1009, 64) == b"p%03d" % 9
+        finally:
+            store.close()
+
     def test_resolver_skips_self_and_non_host_tiers(self):
         index = InMemoryIndex()
         from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key
