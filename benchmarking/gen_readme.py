@@ -126,32 +126,39 @@ def fleet_section() -> str:
     if ladder:
         lines += [
             "",
-            "TTFT vs arrival rate (the reference's QPS-ladder shape, "
-            "`37-capacity/README.md:342-347` — precise holds sub-second "
-            "TTFT while cache-oblivious arms explode once prefill queues "
-            "stop clearing):",
+            "TTFT vs arrival rate on the capacity-regime workload (the "
+            "reference's QPS-ladder shape, `37-capacity/README.md:342-347` "
+            "— precise holds the lowest TTFT at every rung while "
+            "cache-oblivious arms explode once prefill queues stop "
+            "clearing; the parenthesized preemption counts trace WHY: "
+            "worse routing → more recompute → more KV pressure → more "
+            "preempted sequences):",
             "",
             "| QPS | precise p50/p90 (s) | estimated p50/p90 (s) "
             "| load p50/p90 (s) | round-robin p50/p90 (s) "
             "| precise vs rr (p90) |",
             "|---:|---:|---:|---:|---:|---:|",
         ]
+
+        def _cell(r, bold=False):
+            if not r:
+                return "—"
+            b = "**" if bold else ""
+            pre = (
+                f" ({r['preemptions']}p)" if "preemptions" in r else ""
+            )
+            return f"{b}{r['ttft_p50_s']} / {r['ttft_p90_s']}{b}{pre}"
+
         for name, row in sorted(
             ladder.items(), key=lambda kv: float(kv[0].split("_")[1])
         ):
             qps = name.split("_")[1]
-            est = row.get("estimated")
-            est_cell = (
-                f"{est['ttft_p50_s']} / {est['ttft_p90_s']}" if est else "—"
-            )
             lines.append(
                 f"| {qps} "
-                f"| **{row['precise']['ttft_p50_s']} / "
-                f"{row['precise']['ttft_p90_s']}** "
-                f"| {est_cell} "
-                f"| {row['load']['ttft_p50_s']} / {row['load']['ttft_p90_s']} "
-                f"| {row['round_robin']['ttft_p50_s']} / "
-                f"{row['round_robin']['ttft_p90_s']} "
+                f"| {_cell(row['precise'], bold=True)} "
+                f"| {_cell(row.get('estimated'))} "
+                f"| {_cell(row['load'])} "
+                f"| {_cell(row['round_robin'])} "
                 f"| {row['precise_vs_round_robin_p90']}× |"
             )
     wr = stats.get("data_plane_winning_regime") or {}
