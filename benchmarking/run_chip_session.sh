@@ -33,18 +33,27 @@ step() {
   return 0
 }
 
-# The axon plugin can hang indefinitely when the tunnel is down, so the
-# probe itself needs a hard timeout.
-timeout 120 python - <<'EOF' || { echo "no TPU visible (or tunnel hang); aborting"; exit 2; }
+# DRY=1: validate the session pipeline on CPU (quick-mode benches, no
+# artifact writes) so a script bug can't burn real chip time.
+QUICK=""
+if [ "${DRY:-0}" = "1" ]; then
+  echo "=== DRY RUN: CPU quick modes, committed artifacts untouched"
+  QUICK="--quick"
+  export JAX_PLATFORMS=cpu
+else
+  # The axon plugin can hang indefinitely when the tunnel is down, so the
+  # probe itself needs a hard timeout.
+  timeout 120 python - <<'EOF' || { echo "no TPU visible (or tunnel hang); aborting"; exit 2; }
 import jax
 assert jax.default_backend() == "tpu" or any(
     "tpu" in str(d).lower() or "axon" in str(d).lower() for d in jax.devices()
 ), jax.devices()
 print("TPU:", jax.devices())
 EOF
+fi
 
-step device_bench python benchmarking/device_bench.py
-step fleet_device_bench python benchmarking/fleet_device_bench.py
+step device_bench python benchmarking/device_bench.py $QUICK
+step fleet_device_bench python benchmarking/fleet_device_bench.py $QUICK
 # bench.py re-reads the regenerated DEVICE_BENCH rates (gamma/delta
 # provenance, cost-model seeds) and writes its machine-readable stats to
 # benchmarking/FLEET_BENCH.json — the artifact gen_readme renders the fleet
