@@ -463,12 +463,14 @@ def main():
     # other arms never compile). Quick mode skips the warmup — its CI
     # consumers assert hit-rate ordering, never timing — and accordingly
     # suppresses the speedup field rather than print compile noise.
-    # The sim's other two arms are deliberately absent here: closed-loop
-    # serving (no queue, one request in flight, events drained each serve)
-    # makes load-aware degenerate to a constant pod and makes
-    # estimated-affinity placement coincide with precise — bench.py's
-    # queueing simulation is where those arms separate (reference
-    # 37-capacity table).
+    # The sim's other two arms are deliberately absent here even in the
+    # open-loop v3 replay: serving stays serialized (one request in device
+    # flight; events drain each serve), so estimated-affinity placement
+    # still coincides with precise on this sticky multi-turn workload (the
+    # preemption dynamics that break the estimator live in bench.py's
+    # capacity-regime sim), and load-aware would need the virtual per-pod
+    # clock plumbed into route() — a methodology change to take
+    # deliberately, not a free extra row.
     # Quick mode runs the same arm set so CI exercises every route()
     # branch the full-mode artifact depends on.
     arms = ("precise", "random", "round_robin")

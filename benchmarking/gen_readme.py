@@ -51,7 +51,6 @@ def fleet_section() -> str:
         f"| TTFT mean (s) | **{stats.get('ttft_mean_precise_s', '—')}** "
         f"| {stats.get('ttft_mean_round_robin_s', '—')} |",
         f"| Prefix-cache hit rate | **{stats.get('prefix_hit_rate', 0):.1%}** | — |",
-        f"| Read-path p50 (ms) | {stats.get('read_path_p50_ms', '—')} | — |",
         "",
         f"→ **{sim_speedup}x simulated TTFT p50 speedup vs round-robin** "
         f"({round(sim_speedup / 2.0, 3)}× the BASELINE.json 2× target). "

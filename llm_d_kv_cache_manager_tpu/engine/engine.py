@@ -30,6 +30,9 @@ from llm_d_kv_cache_manager_tpu.engine.block_manager import (
 from llm_d_kv_cache_manager_tpu.engine.tiering import PageCodec
 from llm_d_kv_cache_manager_tpu.kvevents.events import EventBatch
 from llm_d_kv_cache_manager_tpu.kvevents.publisher import Publisher, make_topic
+from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
+
+logger = kvlog.get_logger("engine")
 
 _GATHER_PAGES = None
 _SCATTER_PAGES = None
@@ -626,10 +629,17 @@ class EnginePod:
         ):
             # Snapshot while the pages are still committed; the gather is
             # enqueued on this (serving) thread, so it precedes any later
-            # allocation's overwrite in device order.
-            self.tier_store.stage_async(
-                list(self.block_manager.committed_blocks(state))
-            )
+            # allocation's overwrite in device order. Best-effort: a
+            # snapshot failure (e.g. OOM allocating gather outputs under
+            # the very pressure that triggered the free) must never leak
+            # the sequence's pages — the blocks just fall back to the
+            # synchronous reclaim-time stage.
+            try:
+                self.tier_store.stage_async(
+                    list(self.block_manager.committed_blocks(state))
+                )
+            except Exception as e:  # noqa: BLE001 - staging is best-effort
+                logger.debug("eager stage snapshot failed on free: %s", e)
         self.block_manager.free(state)
 
     # -- data plane -----------------------------------------------------------

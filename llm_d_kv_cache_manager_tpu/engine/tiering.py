@@ -535,7 +535,7 @@ class TieredKVStore:
             # snapshot is enqueued wait on `ready`.
             entry = {
                 "blocks": fresh, "resolve": None, "claimed": False,
-                "ready": threading.Event(),
+                "ready": threading.Event(), "done": threading.Event(),
             }
             for b in fresh:
                 self._pending_stage[b[0]] = entry
@@ -544,8 +544,15 @@ class TieredKVStore:
             entry["resolve"] = self.codec.extract_many_async(
                 [b[3] for b in fresh]
             )
-        finally:
+        except Exception as e:  # noqa: BLE001 - snapshot is best-effort
+            # Unregister so the budget isn't leaked and the blocks fall
+            # back to the synchronous reclaim-time stage.
             entry["ready"].set()
+            self._claim_entry(entry)
+            entry["done"].set()
+            logger.debug("eager stage snapshot failed: %s", e)
+            return 0
+        entry["ready"].set()
         self._ensure_stager()
         self._stage_q.put(entry)
         return len(fresh)
@@ -564,17 +571,25 @@ class TieredKVStore:
 
     def _resolve_entry(self, entry: dict) -> int:
         if not self._claim_entry(entry):
-            return 0
-        entry["ready"].wait(timeout=30.0)
-        resolve = entry["resolve"]
-        if resolve is None:  # snapshot enqueue itself failed
+            # Another thread (stager vs inline reclaim) owns this entry:
+            # wait for its admit so the caller's membership re-check sees
+            # the landed blocks instead of paying a duplicate synchronous
+            # extract for work already in flight.
+            entry["done"].wait(timeout=30.0)
             return 0
         try:
-            payloads = resolve()
-        except Exception as e:  # noqa: BLE001 - best-effort snapshot
-            logger.debug("eager stage resolve failed: %s", e)
-            return 0
-        return self._admit_payloads(entry["blocks"], payloads)
+            entry["ready"].wait(timeout=30.0)
+            resolve = entry["resolve"]
+            if resolve is None:  # snapshot enqueue itself failed
+                return 0
+            try:
+                payloads = resolve()
+            except Exception as e:  # noqa: BLE001 - best-effort snapshot
+                logger.debug("eager stage resolve failed: %s", e)
+                return 0
+            return self._admit_payloads(entry["blocks"], payloads)
+        finally:
+            entry["done"].set()
 
     def _ensure_stager(self) -> None:
         if self._closed:
@@ -595,6 +610,7 @@ class TieredKVStore:
                     self._resolve_entry(entry)
                 else:
                     self._claim_entry(entry)  # drop without resolving
+                    entry["done"].set()
             except Exception as e:  # noqa: BLE001 - stager must not die
                 logger.debug("eager stage failed: %s", e)
             finally:

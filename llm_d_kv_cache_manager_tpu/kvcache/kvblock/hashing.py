@@ -113,7 +113,18 @@ def _sha256_low64(data: bytes) -> int:
 def sha256_cbor_init_hash(seed: str) -> int:
     """Root parent hash under vLLM's `sha256_cbor_64bit` algorithm: the
     lower 64 bits of sha256 over the canonical-CBOR TEXT encoding of the
-    PYTHONHASHSEED string (vLLM v1 `init_none_hash` with that hash fn)."""
+    PYTHONHASHSEED string (vLLM v1 `init_none_hash` with that hash fn).
+
+    An empty seed maps to vLLM's UNSET-PYTHONHASHSEED derivation —
+    `hash_fn(None)` = sha256 over CBOR null (0xF6) — because that is what
+    an engine without the env var actually computes; hashing the empty
+    TEXT string (0x60) instead would silently zero every score against
+    such a fleet. A set-but-empty PYTHONHASHSEED cannot occur on the
+    engine side at all: CPython aborts at startup unless the var is
+    "random" or an integer, so "" here can only mean "the fleet runs
+    unseeded"."""
+    if seed == "":
+        return _sha256_low64(b"\xf6")  # CBOR null
     return _sha256_low64(_cbor_text(seed))
 
 

@@ -277,20 +277,25 @@ class EventPool:
         while True:
             msg = q.get()
             try:
-                # Apply dropped victims' removals first: any drop happened
-                # because the queue was full, so this iteration's dequeue is
-                # ordered after every message older than the victim.
-                self._flush_pending(pending)
-                if msg is None:
-                    return
-                self._process_event(msg)
+                if msg is not None:
+                    self._process_event(msg)
             except Exception as e:  # noqa: BLE001 - a worker must never die
                 logger.warning(
                     "event processing failed (topic=%s): %s",
                     getattr(msg, "topic", "?"), e,
                 )
-            finally:
-                q.task_done()
+            # Apply dropped victims' removals AFTER the dequeued message:
+            # `msg` left the queue before any currently-pending victim was
+            # dropped (drops only evict messages still queued), so it is
+            # older than every victim — flushing before it would let a
+            # store digest overwrite a removal that arrived later.
+            try:
+                self._flush_pending(pending)
+            except Exception as e:  # noqa: BLE001 - a worker must never die
+                logger.warning("pending drop flush failed: %s", e)
+            q.task_done()
+            if msg is None:
+                return
 
     def _process_event(self, msg: Message) -> None:
         try:

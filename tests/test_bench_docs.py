@@ -58,6 +58,7 @@ def test_fleet_bench_artifact_matches_bench_config():
     assert cfg["capacity_requests"] == const("CAPACITY_REQUESTS")
     # Volatile / duplicated fields must stay out of the committed artifact.
     assert "wall_s" not in artifact
+    assert "read_path_p50_ms" not in artifact
     assert "device_measured_fleet" not in artifact
 
 
