@@ -6,13 +6,15 @@
 #   bash benchmarking/run_chip_session.sh [outdir]
 #
 # Steps:
-#   1. device_bench (full): DEVICE_BENCH.json — multistep batch x steps
-#      grid, pipeline-depth sweep, seq-4096 prefill, flash-vs-jnp prefill.
-#   2. fleet_device_bench (full): FLEET_DEVICE_BENCH.json — open-loop v3
+#   1. fleet_device_bench (full): FLEET_DEVICE_BENCH.json — open-loop v3
 #      (Poisson @ qps, per-pod queue), 200 req/arm,
-#      precise/random/round_robin, measured service times. If precise
-#      saturates (queue_wait_p90 >> service_p50), lower FULL_MODES.v3.qps
-#      and rerun before committing the artifact.
+#      precise/random/round_robin, measured service times. Runs FIRST: it
+#      is the round's highest-stakes number. If precise saturates
+#      (queue_wait_p90 >> service_p50), lower FULL_MODES.v3.qps and rerun
+#      before committing the artifact.
+#   2. device_bench (full): DEVICE_BENCH.json — multistep batch x steps
+#      grid, engine decode waves, eager-stage A/B, data-plane ladder/fit,
+#      pipeline-depth sweep, seq-4096 prefill, flash-vs-jnp prefill.
 #   3. gen_readme: re-render the generated README sections.
 #   4. pytest: artifact coherence + cost-model pins.
 set -u
@@ -52,8 +54,11 @@ print("TPU:", jax.devices())
 EOF
 fi
 
-step device_bench python benchmarking/device_bench.py $QUICK
+# Fleet bench FIRST: the measured >=2x TTFT target is the round's
+# highest-stakes number, and a late-arriving tunnel window may not survive
+# the full device-bench grid.
 step fleet_device_bench python benchmarking/fleet_device_bench.py $QUICK
+step device_bench python benchmarking/device_bench.py $QUICK
 # bench.py re-reads the regenerated DEVICE_BENCH rates (gamma/delta
 # provenance, cost-model seeds) and writes its machine-readable stats to
 # benchmarking/FLEET_BENCH.json — the artifact gen_readme renders the fleet
