@@ -264,6 +264,65 @@ class TestVendoredOracleFuzz:
             )
 
 
+class TestVllmAlgoEventPath:
+    """End-to-end property of sha256_cbor_64bit mode: when the engine's
+    own block hashes (computed here by the vendored vLLM oracle) flow
+    through the event pool into an indexer configured with the same algo,
+    engine keys and recomputed request keys COINCIDE — the dual-key
+    mapping degenerates to identity, which is the point of pinning the
+    algorithm fleet-wide."""
+
+    def test_engine_and_request_keys_coincide(self, monkeypatch):
+        import sys as _sys
+
+        _sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+        from third_party import vllm_kv_cache_utils as oracle
+
+        monkeypatch.setenv("PYTHONHASHSEED", "0")
+        oracle.init_none_hash(oracle.sha256_cbor_64bit)
+
+        tokens = list(range(48))
+        parent = None
+        engine_hashes = []
+        for i in range(3):
+            bh = oracle.hash_block_tokens(
+                oracle.sha256_cbor_64bit, parent, tokens[i * 16:(i + 1) * 16]
+            )
+            engine_hashes.append(bh.hash_value)
+            parent = bh.hash_value
+
+        db = ChunkedTokenDatabase(TokenProcessorConfig(
+            block_size=16, hash_seed="0", hash_algo="sha256_cbor_64bit"
+        ))
+        index = InMemoryIndex()
+        pool = EventPool(EventPoolConfig(concurrency=1), index, db)
+        pool.start(with_subscriber=False)
+        try:
+            batch = EventBatch(ts=1.0, events=[BlockStored(
+                block_hashes=engine_hashes, parent_block_hash=None,
+                token_ids=tokens, block_size=16,
+            )])
+            pool.add_task(Message(
+                topic="kv@pod-v@m", payload=batch.to_msgpack(), seq=1,
+                pod_identifier="pod-v", model_name="m",
+            ))
+            pool.drain()
+        finally:
+            pool.shutdown()
+
+        request_keys = db.tokens_to_kv_block_keys(None, tokens, "m")
+        assert [k.chunk_hash for k in request_keys] == engine_hashes
+        hits = index.lookup(request_keys, set())
+        assert all(
+            any(e.pod_identifier == "pod-v" for e in hits.get(k, []))
+            for k in request_keys
+        )
+        # Identity mapping: the engine key IS the request key.
+        for h, rk in zip(engine_hashes, request_keys):
+            assert index.get_request_key(Key("m", h)) == rk
+            assert rk.chunk_hash == h
+
+
 class TestUnseededFleetParity:
     """A fleet running WITHOUT PYTHONHASHSEED: vLLM derives NONE_HASH from
     CBOR null (hash_fn(None)), and the indexer's hash_seed="" must map to
