@@ -18,7 +18,15 @@ Decode runs the on-device multi-step loop (decode_steps=N) so per-token
 dispatch overhead doesn't swamp the device numbers on a tunneled chip.
 
 Run: python benchmarking/fleet_device_bench.py [--quick]
+                                               [--workload sharegpt]
+                                               [--trace PATH]
   --quick: CPU-sized config + tiny workload (CI smoke).
+  --workload sharegpt: serve a ShareGPT-shaped trace (workloads/
+    subsystem) instead of the synthetic conversations — open-loop against
+    the trace's scripted arrivals; writes
+    FLEET_DEVICE_BENCH_SHAREGPT.json so the synthetic artifact series
+    stays comparable. --trace replays the exact JSONL trace bench.py
+    recorded (byte-identical prompt stream across both harnesses).
 Writes benchmarking/FLEET_DEVICE_BENCH.json (full mode) and prints it.
 """
 
@@ -115,7 +123,7 @@ FULL_MODES = {
 FULL_MODE_DEFAULT = "v3"
 FULL_MODE = FULL_MODES[FULL_MODE_DEFAULT]
 
-from llm_d_kv_cache_manager_tpu.utils.workload import (  # noqa: E402
+from llm_d_kv_cache_manager_tpu.workloads.synthetic import (  # noqa: E402
     shared_prefix_conversations,
     text as _text,
 )
@@ -287,6 +295,36 @@ def build_workload(n_groups, users, turns, sys_words, q_words, seed=7):
     return conversations, order, seed, q_words
 
 
+# ShareGPT full-mode trace shape (workloads/ subsystem): table-faithful
+# lengths; sessions sized so the working set stresses the pods the way the
+# synthetic v3 config does. Quick mode shrinks lengths via length_scale so
+# grown prompts stay inside the CPU config's 128-page per-seq cap.
+SHAREGPT_FULL = {"n_sessions": 24, "max_turns": 8, "length_scale": 1.0,
+                 "session_rate_per_s": 0.5}
+SHAREGPT_QUICK = {"n_sessions": 3, "max_turns": 2, "length_scale": 0.05,
+                  "session_rate_per_s": 2.0}
+
+
+def build_sharegpt_trace(params, n_pods, seed=7, trace_path=None):
+    """Materialized request list [(arrival_s, prompt, output_len), ...] from
+    a generated (or replayed: `trace_path`) ShareGPT trace. The same JSONL
+    trace replayed here and in bench.py serves a byte-identical prompt
+    stream — the record/replay contract of workloads/trace.py."""
+    from llm_d_kv_cache_manager_tpu.workloads import (
+        ShareGPTConfig,
+        generate,
+        read_trace,
+    )
+
+    if trace_path:
+        trace = read_trace(trace_path)
+    else:
+        trace = generate(ShareGPTConfig(
+            seed=seed, prefix_groups=n_pods, **params
+        ))
+    return [(r.arrival_s, r.prompt, r.output_len) for r in trace.materialize()]
+
+
 def _pctl(xs, q):
     s = sorted(xs)
     return s[min(int(len(s) * q), len(s) - 1)]
@@ -294,7 +332,7 @@ def _pctl(xs, q):
 
 def run_fleet(strategy, model_config, workload, n_pods, n_pages,
               decode_steps, max_new, use_kernel, max_pages_per_seq=256,
-              limit=None, qps=None):
+              limit=None, qps=None, trace=None):
     """`limit` truncates the request stream — the warmup passes use it:
     XLA programs are keyed by power-of-2 shape buckets (prefill chunk
     length, table width, batch), and the bucket set saturates within the
@@ -307,7 +345,19 @@ def run_fleet(strategy, model_config, workload, n_pods, n_pages,
     on-chip service time, and advances a virtual per-pod clock —
     TTFT = queue wait (from measured busy intervals) + measured time to
     first token. With qps=None the run is closed-loop and TTFT is the
-    measured compute time alone."""
+    measured compute time alone.
+
+    `trace` (a [(arrival_s, prompt, output_len), ...] list from
+    build_sharegpt_trace) replaces the synthetic conversation loop: prompts
+    and arrival times come from the trace, so the run is open-loop against
+    the trace's own scripted arrivals (`qps` is ignored; generation stays
+    capped at max_new so timed decode work is comparable across arms)."""
+    if trace is not None:
+        return _run_fleet_trace(
+            strategy, model_config, trace, n_pods, n_pages, decode_steps,
+            max_new, use_kernel, max_pages_per_seq=max_pages_per_seq,
+            limit=limit,
+        )
     conversations, order, seed, q_words = workload
     # Fresh rng per run: every strategy (and the warmup) must serve the
     # IDENTICAL question/response text AND arrival times, or the
@@ -362,9 +412,64 @@ def run_fleet(strategy, model_config, workload, n_pods, n_pages,
     return out
 
 
+def _run_fleet_trace(strategy, model_config, trace, n_pods, n_pages,
+                     decode_steps, max_new, use_kernel,
+                     max_pages_per_seq=256, limit=None):
+    """Serve a materialized workload trace through the real fleet.
+
+    Open-loop against the trace's scripted arrivals: requests replay in
+    arrival order with measured service times advancing a virtual per-pod
+    clock (the same single-chip replay methodology as the qps mode)."""
+    fleet = DeviceFleet(strategy, n_pods, model_config, n_pages,
+                        decode_steps, use_kernel,
+                        max_pages_per_seq=max_pages_per_seq)
+    ttfts, totals, toks = [], [], 0
+    compute_ttfts, waits = [], []
+    free_at = [0.0] * n_pods
+    try:
+        for arrival, prompt, _output_len in (
+            trace if limit is None else trace[:limit]
+        ):
+            ttft_c, total, n_gen, pod_idx = fleet.serve(prompt, max_new)
+            wait = max(0.0, free_at[pod_idx] - arrival)
+            free_at[pod_idx] = max(arrival, free_at[pod_idx]) + total
+            waits.append(wait)
+            compute_ttfts.append(ttft_c)
+            ttfts.append(wait + ttft_c)
+            totals.append(total)
+            toks += n_gen
+        hit_rate = fleet.hit_tokens / max(fleet.total_tokens, 1)
+    finally:
+        fleet.close()
+    return {
+        "ttft_p50_s": round(_pctl(ttfts, 0.5), 4),
+        "ttft_p90_s": round(_pctl(ttfts, 0.9), 4),
+        "ttft_mean_s": round(statistics.mean(ttfts), 4),
+        "prefix_hit_rate": round(hit_rate, 4),
+        "output_tokens_per_s": round(toks / max(sum(totals), 1e-9), 1),
+        "requests": len(ttfts),
+        "queue_wait_p50_s": round(_pctl(waits, 0.5), 4),
+        "queue_wait_p90_s": round(_pctl(waits, 0.9), 4),
+        "service_p50_s": round(_pctl(totals, 0.5), 4),
+        "service_mean_s": round(statistics.mean(totals), 4),
+        "ttft_compute_p50_s": round(_pctl(compute_ttfts, 0.5), 4),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument(
+        "--workload", choices=("synthetic", "sharegpt"), default="synthetic",
+        help="synthetic (default; keeps FLEET_DEVICE_BENCH.json comparable "
+             "across rounds) or sharegpt (trace-driven ShareGPT replay; "
+             "writes FLEET_DEVICE_BENCH_SHAREGPT.json instead)",
+    )
+    ap.add_argument(
+        "--trace", default=None, metavar="PATH",
+        help="replay a recorded JSONL workload trace (sharegpt mode only) — "
+             "the same file bench.py --trace accepts",
+    )
     args = ap.parse_args()
 
     if os.environ.get("JAX_PLATFORMS"):
@@ -421,14 +526,30 @@ def main():
             sys_words=fm["sys_words"], q_words=fm["q_words"],
         )
 
+    trace = None
+    if args.workload == "sharegpt":
+        params = SHAREGPT_QUICK if args.quick else SHAREGPT_FULL
+        trace = build_sharegpt_trace(params, n_pods, trace_path=args.trace)
+
     report = {
         "backend": jax.default_backend(),
         "device": str(jax.devices()[0]),
+        "workload": args.workload,
         "config": {
             "d_model": cfg.d_model, "n_layers": cfg.n_layers,
             "n_pods": n_pods, "n_pages_per_pod": n_pages,
             "decode_steps": decode_steps, "max_new_tokens": max_new,
             "note": (
+                (
+                    "ShareGPT trace replay (workloads/ subsystem): prompts "
+                    "and OPEN-LOOP arrival times come from the trace; "
+                    "measured service times advance a virtual per-pod "
+                    "clock, TTFT = queue wait + measured time to first "
+                    "token. Decode stays capped at max_new so the timed "
+                    "device work is comparable across arms."
+                )
+                if trace is not None
+                else
                 (
                     "open-loop replay: Poisson arrivals at "
                     f"{qps} QPS with a per-pod FIFO queue. One chip "
@@ -454,6 +575,12 @@ def main():
         # not just the pod shape — a sys_words drift changes hit rates).
         report["config"]["full_mode"] = dict(FULL_MODE)
         report["config"]["full_mode_version"] = FULL_MODE_DEFAULT
+    if trace is not None:
+        report["config"]["sharegpt"] = dict(
+            SHAREGPT_QUICK if args.quick else SHAREGPT_FULL
+        )
+        report["config"]["trace_source"] = args.trace or "generated"
+        report["config"]["trace_requests"] = len(trace)
     # XLA's jit cache is process-global: whichever strategy runs first
     # would pay every compile (bucketed prefill bounds these, but each
     # (bucket, table, batch) pair still compiles once) and the second
@@ -493,20 +620,26 @@ def main():
         for warm_strategy in arms:
             run_fleet(warm_strategy, cfg, workload, n_pods, n_pages,
                       decode_steps, max_new, on_tpu,
-                      max_pages_per_seq=mpps,
+                      max_pages_per_seq=mpps, trace=trace,
                       limit=(None if warm_strategy == "round_robin"
-                             else 2 * FULL_MODE["groups"] * FULL_MODE["users"]))
+                             else (len(trace) // 3 if trace is not None
+                                   else 2 * FULL_MODE["groups"]
+                                   * FULL_MODE["users"])))
     for arm in arms:
         report[arm] = run_fleet(
             arm, cfg, workload, n_pods, n_pages, decode_steps, max_new,
-            on_tpu, max_pages_per_seq=mpps, qps=qps)
+            on_tpu, max_pages_per_seq=mpps, qps=qps, trace=trace)
     if not args.quick:
         report["ttft_p50_speedup"] = round(
             report["round_robin"]["ttft_p50_s"]
             / max(report["precise"]["ttft_p50_s"], 1e-9), 3
         )
+    # ShareGPT runs land in their own artifact: FLEET_DEVICE_BENCH.json is
+    # the synthetic-workload series every committed round compares against.
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "FLEET_DEVICE_BENCH.json")
+                       "FLEET_DEVICE_BENCH_SHAREGPT.json"
+                       if args.workload == "sharegpt"
+                       else "FLEET_DEVICE_BENCH.json")
     if not args.quick:
         with open(out, "w") as f:
             json.dump(report, f, indent=2)

@@ -93,7 +93,7 @@ def main():
         TokenizationPool,
         TokenizersPoolConfig,
     )
-    from llm_d_kv_cache_manager_tpu.utils.workload import text
+    from llm_d_kv_cache_manager_tpu.workloads.synthetic import text
 
     rng = random.Random(3)
     prompt = text(rng, 1000)  # ~1.9k tokens with the fixture tokenizer

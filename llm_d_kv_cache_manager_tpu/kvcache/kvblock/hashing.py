@@ -115,16 +115,24 @@ def sha256_cbor_init_hash(seed: str) -> int:
     lower 64 bits of sha256 over the canonical-CBOR TEXT encoding of the
     PYTHONHASHSEED string (vLLM v1 `init_none_hash` with that hash fn).
 
-    An empty seed maps to vLLM's UNSET-PYTHONHASHSEED derivation —
-    `hash_fn(None)` = sha256 over CBOR null (0xF6) — because that is what
-    an engine without the env var actually computes; hashing the empty
-    TEXT string (0x60) instead would silently zero every score against
-    such a fleet. A set-but-empty PYTHONHASHSEED cannot occur on the
-    engine side at all: CPython aborts at startup unless the var is
-    "random" or an integer, so "" here can only mean "the fleet runs
-    unseeded"."""
+    An empty seed is a HARD ERROR (ADVICE round-5): upstream vLLM
+    (v0.9–0.10) draws NONE_HASH from per-process `os.urandom` whenever
+    PYTHONHASHSEED is unset or empty — for EVERY hash function, not just
+    the pickle-sha256 one (the `hash_fn is sha256` condition upstream only
+    gates a warning log). An unseeded fleet's root hash is therefore
+    random per engine process, parity with it is impossible by
+    construction, and any fixed derivation here (earlier revisions used
+    sha256 over CBOR null) silently zeroes every score against a real
+    fleet. Note the empty string really can reach us: CPython treats an
+    empty PYTHONHASHSEED env var as unset rather than rejecting it."""
     if seed == "":
-        return _sha256_low64(b"\xf6")  # CBOR null
+        raise ValueError(
+            "hash_algo='sha256_cbor_64bit' requires a non-empty hash_seed: "
+            "an unseeded vLLM fleet derives NONE_HASH from per-process "
+            "os.urandom, so no fixed seed can ever match it. Set "
+            "PYTHONHASHSEED on every engine pod and configure the same "
+            "value as the indexer's hash_seed."
+        )
     return _sha256_low64(_cbor_text(seed))
 
 

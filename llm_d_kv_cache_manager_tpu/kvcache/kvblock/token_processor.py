@@ -31,7 +31,10 @@ class TokenProcessorConfig:
     # tests/test_hash_parity.py::TestVllmVectors against the vendored
     # oracle) — pin it when the indexer's request keys must equal the
     # engine's own block hashes rather than merely mapping to them through
-    # the dual-key engine→request bookkeeping.
+    # the dual-key engine→request bookkeeping. sha256_cbor_64bit REQUIRES a
+    # non-empty hash_seed: an unseeded vLLM fleet draws a per-process
+    # random NONE_HASH (os.urandom, all hash fns), so parity with it is
+    # impossible and construction fails loudly instead of scoring zero.
     hash_algo: str = "fnv64_cbor"
 
     @classmethod

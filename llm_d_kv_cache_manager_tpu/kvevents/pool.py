@@ -26,6 +26,17 @@ the engine never corrects. So overload sheds the expensive work (re-hashing
 token chains for stores) and keeps the cheap work that protects index
 soundness, and a misbehaving fleet degrades index freshness instead of
 growing manager memory without bound.
+
+The pending-removal hand-off itself is bounded too (ADVICE round-5): a
+victim is decoded AT DROP TIME on the producer thread and only its
+BlockRemoved digests are retained — a store-only victim (the common case:
+stores dominate event volume and carry the big token-id payloads) leaves
+nothing behind, so sustained overload against a stuck shard worker cannot
+regrow the unbounded buffer the bounded queues exist to prevent. The
+per-shard pending deque is additionally capped (`max_pending_drop_removals`);
+past the cap the OLDEST pending removal digest is discarded and counted
+(`removals_lost`) — a deliberate last-resort trade of index soundness
+(a possible stale entry the engine never corrects) for bounded memory.
 """
 
 from __future__ import annotations
@@ -66,6 +77,10 @@ class EventPoolConfig:
     # Per-shard queue bound; <=0 means unbounded (not recommended in
     # production — a stalled worker then grows memory without limit).
     max_queue_depth: int = 4096
+    # Per-shard cap on retained drop-victim removal digests (see module
+    # docstring). Past it the oldest pending digest is discarded and
+    # counted in `removals_lost`. <=0 means uncapped.
+    max_pending_drop_removals: int = 4096
 
 
 @dataclass
@@ -94,13 +109,16 @@ class EventPool:
             queue.Queue(maxsize=depth) for _ in range(self.config.concurrency)
         ]
         self._workers: List[threading.Thread] = []
-        # Removal-only digests of drop-oldest victims, applied by the SHARD
-        # WORKER between messages (never by the producer thread): the victim
-        # was the oldest queued message, so every message queued before it
-        # has already been dequeued — only the worker's single in-flight
-        # message could still race, and draining at the top of the worker
-        # iteration serializes behind it, preserving per-pod ordering.
-        self._pending_drop_removals: List[Deque[Message]] = [
+        # Removal-only digests of drop-oldest victims — extracted at drop
+        # time (producer thread; store payloads discarded there, see module
+        # docstring) but APPLIED by the SHARD WORKER between messages: the
+        # victim was the oldest queued message, so every message queued
+        # before it has already been dequeued — only the worker's single
+        # in-flight message could still race, and draining at the top of
+        # the worker iteration serializes behind it, preserving per-pod
+        # ordering. Entries are (pod_identifier_with_rank, model_name,
+        # [BlockRemoved, ...]) tuples, never whole Messages.
+        self._pending_drop_removals: List[Deque[tuple]] = [
             collections.deque() for _ in range(self.config.concurrency)
         ]
         self._subscriber = None
@@ -108,6 +126,7 @@ class EventPool:
         self._shutdown = False
         self._mu = threading.Lock()
         self._dropped = 0
+        self._removals_lost = 0
         self._dropped_mu = threading.Lock()
 
     # -- lifecycle ---------------------------------------------------------
@@ -164,18 +183,20 @@ class EventPool:
             self._flush_pending(pending)
 
     @staticmethod
-    def _flush_pending_pop(pending: "Deque[Message]") -> Optional[Message]:
+    def _flush_pending_pop(pending: "Deque[tuple]") -> Optional[tuple]:
         try:
             return pending.popleft()
         except IndexError:  # lost a check-then-act race with another drainer
             return None
 
-    def _flush_pending(self, pending: "Deque[Message]") -> None:
+    def _flush_pending(self, pending: "Deque[tuple]") -> None:
         while pending:
-            victim = self._flush_pending_pop(pending)
-            if victim is None:
+            digest = self._flush_pending_pop(pending)
+            if digest is None:
                 return
-            self._apply_removals_only(victim)
+            pod, model_name, events = digest
+            for ev in events:
+                self._digest_block_removed(pod, model_name, ev)
 
     # -- ingestion ---------------------------------------------------------
 
@@ -184,6 +205,13 @@ class EventPool:
         """Messages dropped because their shard queue was full."""
         with self._dropped_mu:
             return self._dropped
+
+    @property
+    def removals_lost(self) -> int:
+        """BlockRemoved digests discarded because the per-shard pending
+        cap was hit — each one is a potential stale index entry."""
+        with self._dropped_mu:
+            return self._removals_lost
 
     def add_task(self, msg: Message) -> None:
         """Shard by FNV-1a(pod) so per-pod ordering is preserved.
@@ -232,16 +260,40 @@ class EventPool:
                 self._record_drop(victim, shard)
 
     def _record_drop(self, victim: Message, shard: int) -> None:
-        # Hand the victim's removals to the shard worker instead of applying
-        # them here: the worker may still be digesting an older message whose
-        # BlockStored for the same block hasn't landed, and a producer-thread
-        # removal could then be overwritten by that late store — the exact
-        # false positive the removals-kept policy exists to prevent.
-        self._pending_drop_removals[shard].append(victim)
+        # Extract the victim's removals NOW (producer thread — decoding one
+        # msgpack batch per dropped message is the bounded backpressure we
+        # want) but hand them to the shard worker for APPLICATION: the
+        # worker may still be digesting an older message whose BlockStored
+        # for the same block hasn't landed, and a producer-thread removal
+        # could then be overwritten by that late store — the exact false
+        # positive the removals-kept policy exists to prevent. Store-only
+        # victims retain NOTHING: their payloads (the big token-id lists)
+        # die here, which is what keeps a stuck worker's pending buffer
+        # from regrowing without bound.
+        digest = self._extract_removals(victim)
+        lost = 0
+        if digest is not None:
+            pending = self._pending_drop_removals[shard]
+            cap = self.config.max_pending_drop_removals
+            while cap > 0 and len(pending) >= cap:
+                stale = self._flush_pending_pop(pending)
+                if stale is None:
+                    break
+                lost += len(stale[2])
+            pending.append(digest)
         metrics.count_event_dropped()
         with self._dropped_mu:
             self._dropped += 1
             dropped = self._dropped
+            self._removals_lost += lost
+            removals_lost = self._removals_lost
+        if lost:
+            logger.warning(
+                "pending drop-removal cap hit on shard %d: discarded %d "
+                "BlockRemoved digest(s) (%d lost total) — the index may "
+                "retain stale entries for those blocks",
+                shard, lost, removals_lost,
+            )
         if dropped == 1 or dropped % 1000 == 0:
             logger.warning(
                 "event ingest overloaded: dropped %d message(s) "
@@ -249,25 +301,26 @@ class EventPool:
                 dropped, shard, self.config.max_queue_depth,
             )
 
-    def _apply_removals_only(self, msg: Message) -> None:
-        """Digest just the BlockRemoved events of a message being dropped.
+    def _extract_removals(self, msg: Message) -> Optional[tuple]:
+        """(pod_with_rank, model, [BlockRemoved...]) of a message being
+        dropped — or None when it carries no removals (nothing retained).
 
         Evictions are cheap (no token re-hashing) and must not be lost: a
         missed removal leaves a false-positive index entry the engine never
-        corrects. Runs on the producer thread — bounded work per dropped
-        message is exactly the backpressure we want.
+        corrects.
         """
         try:
             batch = EventBatch.from_msgpack(msg.payload)
         except Exception:  # noqa: BLE001 - poison pill: nothing to preserve
-            return
+            return None
         pod = msg.pod_identifier
         rank = batch.data_parallel_rank
         if isinstance(rank, int) and not isinstance(rank, bool) and rank >= 0:
             pod = f"{pod}@dp{rank}"
-        for event in batch.events:
-            if isinstance(event, BlockRemoved):
-                self._digest_block_removed(pod, msg.model_name, event)
+        removals = [e for e in batch.events if isinstance(e, BlockRemoved)]
+        if not removals:
+            return None
+        return (pod, msg.model_name, removals)
 
     # -- workers -----------------------------------------------------------
 

@@ -1,0 +1,47 @@
+"""Trace-driven workload engine for the fleet benches.
+
+The BASELINE metric is defined over a **ShareGPT replay**; this package
+makes that measurable without network egress:
+
+- `tables`    — committed ShareGPT length/turn quantile tables (vendored
+                data, versioned, provenance documented).
+- `sharegpt`  — deterministic multi-turn session generator matching those
+                tables; conversations grow by concatenating prior turns
+                (the mechanism that creates prefix-cache hits).
+- `arrivals`  — open-loop Poisson / bursty ON-OFF arrival processes with
+                per-session think time.
+- `spec`      — the in-memory trace model (`WorkloadTrace`, delta-text
+                turns, deterministic `materialize()` into full prompts).
+- `trace`     — canonical JSONL record/replay (bit-identical round-trip,
+                shared by bench.py and the device harness).
+- `stats`     — sampling helpers + KS/TV fidelity validation of generated
+                traces against the committed tables.
+- `synthetic` — the historical word-salad backend (both benches' default,
+                kept for artifact continuity; formerly utils/workload.py).
+"""
+
+from llm_d_kv_cache_manager_tpu.workloads.sharegpt import (  # noqa: F401
+    ShareGPTConfig,
+    generate,
+    uniform_control,
+)
+from llm_d_kv_cache_manager_tpu.workloads.spec import (  # noqa: F401
+    MaterializedRequest,
+    TraceTurn,
+    WorkloadTrace,
+)
+from llm_d_kv_cache_manager_tpu.workloads.trace import (  # noqa: F401
+    read_trace,
+    write_trace,
+)
+
+__all__ = [
+    "ShareGPTConfig",
+    "generate",
+    "uniform_control",
+    "MaterializedRequest",
+    "TraceTurn",
+    "WorkloadTrace",
+    "read_trace",
+    "write_trace",
+]

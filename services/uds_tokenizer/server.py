@@ -178,6 +178,17 @@ async def gunicorn_app() -> web.Application:
     return create_app_for_worker()
 
 
+# Repo root, derived from this file: gunicorn resolves the app module
+# against its --chdir (which it inserts into sys.path), so the production
+# entry must pin it explicitly — `services` is an implicit namespace
+# package that only imports when the repo root is on the path, and relying
+# on the launch cwd crash-loops every worker with ModuleNotFoundError from
+# any other directory (ADVICE round-5).
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
 def _gunicorn_argv(
     socket_path: str, probe_port: int, workers: int, with_uvloop: bool
 ) -> list[str]:
@@ -189,6 +200,7 @@ def _gunicorn_argv(
     argv = [
         "gunicorn",
         "services.uds_tokenizer.server:gunicorn_app",
+        "--chdir", _REPO_ROOT,
         "--worker-class", worker_class,
         "--workers", str(workers),
         "--bind", f"unix:{socket_path}",

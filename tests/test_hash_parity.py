@@ -323,39 +323,53 @@ class TestVllmAlgoEventPath:
             assert rk.chunk_hash == h
 
 
-class TestUnseededFleetParity:
-    """A fleet running WITHOUT PYTHONHASHSEED: vLLM derives NONE_HASH from
-    CBOR null (hash_fn(None)), and the indexer's hash_seed="" must map to
-    the same derivation in sha256_cbor_64bit mode — hashing the empty
-    text string instead would silently zero every score against such a
-    fleet (CPython refuses a set-but-empty PYTHONHASHSEED at startup, so
-    "" can only mean unseeded)."""
+class TestUnseededFleetIsUnpairable:
+    """A fleet running WITHOUT PYTHONHASHSEED cannot be scored against
+    (ADVICE round-5): upstream vLLM draws NONE_HASH from per-process
+    os.urandom for EVERY hash fn when the seed is unset/empty (the
+    `hash_fn is sha256` condition upstream only gates a warning), so no
+    fixed derivation on the indexer side can ever match. The indexer
+    therefore refuses sha256_cbor_64bit with an empty seed instead of
+    silently zeroing every score, and the vendored oracle reproduces the
+    per-process randomness so this impossibility is asserted against the
+    oracle, not assumed."""
 
-    def test_empty_seed_matches_vllm_unset_derivation(self, monkeypatch):
+    def test_oracle_unseeded_none_hash_is_per_process_random(
+        self, monkeypatch
+    ):
         import sys as _sys
 
         _sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
         from third_party import vllm_kv_cache_utils as oracle
 
         monkeypatch.delenv("PYTHONHASHSEED", raising=False)
+        draws = set()
+        for _ in range(4):
+            oracle.init_none_hash(oracle.sha256_cbor_64bit)
+            draws.add(oracle.NONE_HASH)
+        assert len(draws) == 4, (
+            "unseeded NONE_HASH must be a fresh urandom draw every init — "
+            "a stable value would mean the oracle drifted from upstream "
+            "again"
+        )
+        # Empty-string PYTHONHASHSEED is treated as unset, not as a seed
+        # (CPython does the same for the interpreter's own hash seeding).
+        monkeypatch.setenv("PYTHONHASHSEED", "")
         oracle.init_none_hash(oracle.sha256_cbor_64bit)
+        assert oracle.NONE_HASH not in draws
 
-        db = ChunkedTokenDatabase(TokenProcessorConfig(
-            block_size=16, hash_seed="", hash_algo="sha256_cbor_64bit"
-        ))
-        assert db.init_hash == oracle.NONE_HASH & 0xFFFFFFFFFFFFFFFF
+    def test_sha256_cbor_with_empty_seed_is_a_hard_error(self):
+        with pytest.raises(ValueError, match="os.urandom"):
+            ChunkedTokenDatabase(TokenProcessorConfig(
+                block_size=16, hash_seed="", hash_algo="sha256_cbor_64bit"
+            ))
 
-        tokens = list(range(32))
-        parent = None
-        expected = []
-        for i in range(2):
-            bh = oracle.hash_block_tokens(
-                oracle.sha256_cbor_64bit, parent, tokens[i * 16:(i + 1) * 16]
-            )
-            expected.append(bh.hash_value)
-            parent = bh.hash_value
-        keys = db.tokens_to_kv_block_keys(None, tokens, "m")
-        assert [k.chunk_hash for k in keys] == expected
+    def test_fnv64_cbor_keeps_the_reference_empty_seed_default(self):
+        # The reference scheme's root is FNV-64a(seed bytes) with "" as a
+        # working default (token_processor.go) — only the vLLM-parity algo
+        # has the impossible-unseeded-fleet semantics.
+        db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=16))
+        assert db.init_hash == hashing.init_hash("")
 
 
 class TestVllmVectors:

@@ -157,6 +157,81 @@ class TestDropRemovalOrdering:
             pool.shutdown()
 
 
+class TestDropMemoryBound:
+    """ADVICE r5: the pending drop-removal hand-off must itself be bounded.
+    Victims are decoded at drop time and only BlockRemoved digests are
+    retained — store payloads die on the producer thread — and the
+    per-shard pending deque is capped."""
+
+    def test_store_only_victims_retain_nothing(self):
+        """A flood of BlockStored messages against a stalled worker (never
+        started) drops 196 victims; none of them may leave anything in the
+        pending buffer — this is the unbounded-regrowth path the cap and
+        the decode-at-drop-time policy close."""
+        pool = _make_event_pool(depth=4)
+        for i in range(200):
+            pool.add_task(_msg(i))
+        assert pool.dropped_events == 196
+        assert all(len(d) == 0 for d in pool._pending_drop_removals)
+        assert pool.removals_lost == 0
+
+    def _removal_msg(self, i: int) -> Message:
+        from llm_d_kv_cache_manager_tpu.kvevents.events import BlockRemoved
+
+        batch = EventBatch(ts=float(i), events=[BlockRemoved(block_hashes=[i])])
+        return Message(
+            topic="kv@pod-a@m", payload=batch.to_msgpack(), seq=i,
+            pod_identifier="pod-a", model_name="m",
+        )
+
+    def test_pending_removals_capped_oldest_first_and_counted(self):
+        pool = EventPool(
+            EventPoolConfig(
+                concurrency=1, max_queue_depth=1,
+                max_pending_drop_removals=8,
+            ),
+            InMemoryIndex(),
+            ChunkedTokenDatabase(TokenProcessorConfig()),
+        )
+        for i in range(50):
+            pool.add_task(self._removal_msg(i))
+        # 49 victims dropped (depth 1), 8 digests retained, the rest
+        # discarded oldest-first and counted as potential stale entries.
+        assert pool.dropped_events == 49
+        pending = pool._pending_drop_removals[0]
+        assert len(pending) == 8
+        assert [d[2][0].block_hashes[0] for d in pending] == list(range(41, 49))
+        assert pool.removals_lost == 41
+
+    def test_retained_removals_still_reach_the_index(self):
+        """The survivors of the cap must still evict on flush: block 0's
+        store lands first, then its removal message is dropped under
+        pressure — after drain the entry must be gone."""
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key
+
+        pool = EventPool(
+            EventPoolConfig(concurrency=1, max_queue_depth=1,
+                            max_pending_drop_removals=8),
+            InMemoryIndex(),
+            ChunkedTokenDatabase(TokenProcessorConfig()),
+        )
+        pool.start(with_subscriber=False)
+        try:
+            pool.add_task(_msg(1))
+            pool.drain()
+            assert pool.index.get_request_key(Key("m", 1)) is not None
+            # The removal gets dropped by the next message racing in while
+            # the queue is full — both enqueued without the worker running
+            # a digest in between is not guaranteed, so force the drop path
+            # directly: depth 1 + two back-to-back adds.
+            pool.add_task(self._removal_msg(1))
+            pool.add_task(_msg(99))
+            pool.drain()
+            assert pool.index.get_request_key(Key("m", 1)) is None
+        finally:
+            pool.shutdown()
+
+
 class _SlowTokenizer:
     """Minimal Tokenizer stub that blocks until released."""
 

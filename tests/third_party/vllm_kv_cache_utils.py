@@ -11,8 +11,8 @@ This module reproduces the relevant ~100 lines of
 https://github.com/vllm-project/vllm) as of the v1 engine's NamedTuple-era
 BlockHash API (v0.9-0.10 line, 2025):
 
-- `init_none_hash(hash_fn)` — binds NONE_HASH to PYTHONHASHSEED (random when
-  unset and the fn is pickle-sha256).
+- `init_none_hash(hash_fn)` — binds NONE_HASH to PYTHONHASHSEED (per-process
+  random when the seed is unset/empty, for every hash fn).
 - `sha256(obj)` — full-width int of sha256 over `pickle.dumps(obj,
   HIGHEST_PROTOCOL)` (engine arg "sha256").
 - `sha256_cbor_64bit(obj)` — LOWER 64 bits of sha256 over canonical-CBOR
@@ -125,13 +125,17 @@ def sha256_cbor_64bit(input: Any) -> int:  # noqa: A002 - upstream name
 def init_none_hash(hash_fn: Callable[[Any], int]) -> None:
     """Derive NONE_HASH (the root parent) from PYTHONHASHSEED.
 
-    Upstream semantics: with no seed and the pickle-sha256 fn, NONE_HASH is
-    random per process (prefix caching stays process-local); otherwise it is
-    `hash_fn(seed_string)` so independent processes agree.
+    Upstream semantics (vLLM v0.9–0.10): with PYTHONHASHSEED unset or
+    empty, NONE_HASH is drawn from per-process `os.urandom` for EVERY hash
+    function — prefix caching stays process-local — and the `hash_fn is
+    sha256` condition upstream only gates a warning log, not the urandom
+    branch (ADVICE round-5: an earlier vendoring drifted by gating the
+    branch on it). With a seed set, NONE_HASH is `hash_fn(seed_string)` so
+    independent processes agree.
     """
     global NONE_HASH
     hash_seed = os.getenv("PYTHONHASHSEED")
-    if not hash_seed and hash_fn is sha256:
+    if not hash_seed:
         NONE_HASH = int.from_bytes(os.urandom(32), byteorder="big")
     else:
         NONE_HASH = hash_fn(hash_seed)
