@@ -2,7 +2,7 @@
 # build/test/bench/lint/image-build/image-push + pre-commit install —
 # /root/reference/Makefile, /root/reference/hooks/pre-commit.sh).
 
-.PHONY: native test bench clean proto lint precommit-install \
+.PHONY: native test bench bench-micro clean proto lint precommit-install \
 	image-build image-push
 
 # Container image coordinates (override per environment/registry). The
@@ -41,6 +41,13 @@ precommit-install:
 
 bench: native
 	python bench.py
+
+# Control-plane microbench in CI-smoke sizes, including the index-contention
+# legs (lookup_mt / mixed_rw: InMemoryIndex vs ShardedIndex under concurrent
+# event digestion). Full mode (rewrites MICRO_BENCH.json):
+#   python benchmarking/micro_bench.py
+bench-micro:
+	JAX_PLATFORMS=cpu python benchmarking/micro_bench.py --quick
 
 proto:
 	protoc --python_out=. llm_d_kv_cache_manager_tpu/api/indexer.proto

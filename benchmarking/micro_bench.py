@@ -19,6 +19,12 @@ Legs (all through public APIs):
 - lookup: in-memory index lookup, 128-key chain
 - event_digest: ZMQ-shaped msgpack BlockStored batches through the
   sharded pool into the index (events/s, end to end)
+- lookup_mt: 8 reader threads hammering 128-key chain lookups while the
+  kvevents pool digests BlockStored batches into the SAME index —
+  InMemoryIndex (one global lock) vs ShardedIndex (lock-striped), with
+  the aggregate read throughput ratio as speedup_x
+- mixed_rw: concurrent readers (lookup+score), direct add writers, and
+  evictors over the same index, again for both backends
 
 Run: python benchmarking/micro_bench.py [--quick]
 Writes MICRO_BENCH.json (full mode) and prints it.
@@ -27,6 +33,7 @@ Writes MICRO_BENCH.json (full mode) and prints it.
 from __future__ import annotations
 
 import argparse
+import gc
 import json
 import os
 import random
@@ -49,6 +56,10 @@ CHAT_TEMPLATE = (
 def _timeit(fn, iters: int, warmup: int = 5):
     for _ in range(warmup):
         fn()
+    # Flush GC debt from earlier legs: a gen-2 collection over the warm
+    # tokenizer/index heap costs tens of ms and lands in whichever leg is
+    # allocating when it comes due, skewing that leg ~5x run to run.
+    gc.collect()
     samples = []
     for _ in range(iters):
         t0 = time.perf_counter()
@@ -61,6 +72,116 @@ def _timeit(fn, iters: int, warmup: int = 5):
         "mean_us": round(statistics.mean(samples) * 1e6, 1),
         "iters": iters,
     }
+
+
+def _contention_leg(
+    make_index,
+    chain,
+    pods,
+    token_processor,
+    batches,
+    duration_s: float,
+    n_readers: int,
+    n_writers: int = 0,
+    n_evictors: int = 0,
+    score_fn=None,
+):
+    """Readers (and optional direct writers/evictors) against one index while
+    the kvevents pool digests stores into it at a FIXED feed rate — both
+    backends face identical write pressure, so the read throughputs (and
+    their ratio) compare like for like. Returns aggregate rates."""
+    import collections
+    import threading
+
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+    from llm_d_kv_cache_manager_tpu.kvevents.pool import EventPool, EventPoolConfig
+
+    index = make_index()
+    index.add(chain, chain, pods)
+
+    stop = threading.Event()
+    lookups = [0] * n_readers
+    writes = [0] * max(n_writers, 1)
+    evictions = [0] * max(n_evictors, 1)
+    evictable = collections.deque(maxlen=4096)  # chains the writers landed
+
+    def reader(slot: int):
+        while not stop.is_set():
+            hits = index.lookup(chain, set())
+            if score_fn is not None:
+                score_fn(chain, hits)
+            lookups[slot] += 1
+
+    def writer(slot: int):
+        i = 0
+        entry = [PodEntry(f"w{slot}", "hbm")]
+        while not stop.is_set():
+            keys = [Key(MODEL, (slot + 2) * 10_000_000 + i * 8 + j) for j in range(8)]
+            index.add(keys, keys, entry)
+            evictable.append((keys[0], entry))
+            writes[slot] += 1
+            i += 1
+
+    def evictor(slot: int):
+        # Evicts chains the writers actually landed (real entries, not a
+        # miss-path spin that would just burn scheduler time).
+        while not stop.is_set():
+            try:
+                key, entry = evictable.popleft()
+            except IndexError:
+                time.sleep(0.001)
+                continue
+            index.evict(key, entry)
+            evictions[slot] += 1
+
+    ev_pool = EventPool(EventPoolConfig(concurrency=2), index, token_processor)
+    ev_pool.start(with_subscriber=False)
+    fed = [0]
+    FEED_RATE = 2000  # batches/s — fixed write pressure for both backends
+    FEED_TICK = 0.005
+
+    def feeder():
+        i = 0
+        per_tick = max(1, int(FEED_RATE * FEED_TICK))
+        next_tick = time.perf_counter()
+        while not stop.is_set():
+            for _ in range(per_tick):
+                ev_pool.add_task(batches[i % len(batches)])
+                i += 1
+            next_tick += FEED_TICK
+            delay = next_tick - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            else:
+                next_tick = time.perf_counter()  # overloaded: don't burst
+        fed[0] = i
+
+    threads = [threading.Thread(target=reader, args=(i,)) for i in range(n_readers)]
+    threads += [threading.Thread(target=writer, args=(i,)) for i in range(n_writers)]
+    threads += [threading.Thread(target=evictor, args=(i,)) for i in range(n_evictors)]
+    threads.append(threading.Thread(target=feeder))
+    gc.collect()  # same hygiene as _timeit
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    ev_pool.drain()
+    ev_pool.shutdown()
+
+    out = {
+        "lookups_per_s": round(sum(lookups) / dt),
+        "events_fed_per_s": round(fed[0] / dt),
+        "events_dropped": ev_pool.dropped_events,
+    }
+    if n_writers:
+        out["adds_per_s"] = round(sum(writes) / dt)
+    if n_evictors:
+        out["evicts_per_s"] = round(sum(evictions) / dt)
+    return out
 
 
 def main():
@@ -178,6 +299,7 @@ def main():
                     )]).to_msgpack(),
                     seq=i, pod_identifier=f"pod-{i % 8}", model_name=MODEL,
                 ))
+            gc.collect()  # same hygiene as _timeit
             t0 = time.perf_counter()
             for m in batches:
                 ev_pool.add_task(m)
@@ -191,6 +313,45 @@ def main():
             }
         finally:
             ev_pool.shutdown()
+
+        # Contention legs: aggregate read throughput under concurrent event
+        # digestion — seed InMemoryIndex (one global lock, touch-on-read)
+        # vs ShardedIndex (lock-striped, batched, peek-on-read).
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.sharded import (
+            ShardedIndex,
+        )
+
+        mt_duration = 0.3 if args.quick else 1.5
+        backends = {"in_memory": InMemoryIndex, "sharded": ShardedIndex}
+
+        lookup_mt = {"readers": 8, "duration_s": mt_duration}
+        for name, factory in backends.items():
+            lookup_mt[name] = _contention_leg(
+                factory, chain, pods, tp, batches, mt_duration, n_readers=8
+            )
+        lookup_mt["speedup_x"] = round(
+            lookup_mt["sharded"]["lookups_per_s"]
+            / max(1, lookup_mt["in_memory"]["lookups_per_s"]),
+            2,
+        )
+        report["lookup_mt"] = lookup_mt
+
+        mixed_rw = {
+            "readers": 4, "writers": 2, "evictors": 1,
+            "duration_s": mt_duration,
+        }
+        for name, factory in backends.items():
+            mixed_rw[name] = _contention_leg(
+                factory, chain, pods, tp, batches, mt_duration,
+                n_readers=4, n_writers=2, n_evictors=1,
+                score_fn=scorer.score,
+            )
+        mixed_rw["speedup_x"] = round(
+            mixed_rw["sharded"]["lookups_per_s"]
+            / max(1, mixed_rw["in_memory"]["lookups_per_s"]),
+            2,
+        )
+        report["mixed_rw"] = mixed_rw
 
         # Whole read path for context (also in bench.py's read_path_p50_ms).
         report["get_pod_scores"] = _timeit(

@@ -12,6 +12,10 @@ from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
     InMemoryIndex,
     InMemoryIndexConfig,
 )
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.sharded import (
+    ShardedIndex,
+    ShardedIndexConfig,
+)
 
 __all__ = [
     "Key",
@@ -23,4 +27,6 @@ __all__ = [
     "new_index",
     "InMemoryIndex",
     "InMemoryIndexConfig",
+    "ShardedIndex",
+    "ShardedIndexConfig",
 ]

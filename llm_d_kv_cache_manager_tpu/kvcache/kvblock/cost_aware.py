@@ -80,7 +80,7 @@ class CostAwareMemoryIndex(Index):
             for key in request_keys:
                 pod_cache = self._data.get(key)
                 if pod_cache is None:
-                    continue
+                    return pods_per_key  # gap: post-gap hits can't score
                 self._data.move_to_end(key)
                 entries = pod_cache.cache.keys()
                 if not entries:

@@ -5,7 +5,11 @@ an LRU of request-key → per-key pod LRU (capped, default 10 pods/key), plus an
 LRU mapping engine keys → request keys. Semantics preserved exactly:
 
 - lookup: a key present with an empty pod cache cuts the search (the prefix
-  chain is known to break there); a missing key merely doesn't contribute.
+  chain is known to break there). A *missing* key cuts too (a departure from
+  the reference, which merely skips it): `LongestPrefixScorer` empties its
+  active set at any gap in the chain, so entries past the first missing key
+  can never contribute to a score — looking them up is pure wasted lock
+  traffic on the read path.
 - add: double-checked insertion so concurrent adders share one pod cache.
 - evict: resolves engine→request key; removing the last pod removes the key
   from both maps (with a re-check to shrink the race window).
@@ -61,8 +65,10 @@ class InMemoryIndex(Index):
         for key in request_keys:
             pod_cache = self._data.get(key)
             if pod_cache is None:
-                kvlog.trace(logger, "key not found in index: %s", key)
-                continue
+                # Gap in the prefix chain: the scorer's active set empties
+                # here, so post-gap hits are unusable — stop looking them up.
+                kvlog.trace(logger, "key not found, cutting search: %s", key)
+                return pods_per_key
             entries = pod_cache.cache.keys()
             if not entries:
                 kvlog.trace(logger, "no pods for key, cutting search: %s", key)

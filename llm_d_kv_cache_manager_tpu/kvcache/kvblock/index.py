@@ -59,6 +59,15 @@ class IndexConfig:
     redis_config: Optional["RedisIndexConfig"] = None
     enable_metrics: bool = False
     metrics_logging_interval_s: float = 60.0
+    # In-memory striping (kvblock/sharded.py). When the in-memory backend is
+    # selected (explicitly or by default), `sharded=True` builds a
+    # lock-striped ShardedIndex over `num_shards` segments instead of the
+    # single-lock InMemoryIndex; scores are identical, only contention
+    # behavior changes. `recency_refresh_interval` is the touch=False read
+    # fast path's refresh cadence (1 = touch every lookup, seed behavior).
+    sharded: bool = True
+    num_shards: int = 16  # DEFAULT_NUM_SHARDS (sharded.py)
+    recency_refresh_interval: int = 64  # DEFAULT_RECENCY_REFRESH (sharded.py)
 
     @classmethod
     def default(cls) -> "IndexConfig":
@@ -74,11 +83,9 @@ def new_index(config: Optional[IndexConfig] = None) -> Index:
     if config is None:
         config = IndexConfig.default()
 
-    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import InMemoryIndex
-
     index: Optional[Index] = None
     if config.in_memory_config is not None:
-        index = InMemoryIndex(config.in_memory_config)
+        index = _new_memory_index(config, config.in_memory_config)
     elif config.cost_aware_config is not None:
         from llm_d_kv_cache_manager_tpu.kvcache.kvblock.cost_aware import (
             CostAwareMemoryIndex,
@@ -90,7 +97,7 @@ def new_index(config: Optional[IndexConfig] = None) -> Index:
 
         index = RedisIndex(config.redis_config)
     else:
-        index = InMemoryIndex(None)
+        index = _new_memory_index(config, None)
 
     if config.enable_metrics:
         from llm_d_kv_cache_manager_tpu.metrics.collector import (
@@ -106,3 +113,28 @@ def new_index(config: Optional[IndexConfig] = None) -> Index:
         index = InstrumentedIndex(index)
 
     return index
+
+
+def _new_memory_index(config: IndexConfig, in_memory_config) -> Index:
+    """In-memory backend: lock-striped ShardedIndex by default, the seed's
+    single-lock InMemoryIndex when `config.sharded` is off."""
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+        InMemoryIndex,
+        InMemoryIndexConfig,
+    )
+
+    if not config.sharded:
+        return InMemoryIndex(in_memory_config)
+
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.sharded import (
+        ShardedIndex,
+        ShardedIndexConfig,
+    )
+
+    imc = in_memory_config or InMemoryIndexConfig()
+    return ShardedIndex(ShardedIndexConfig(
+        size=imc.size,
+        pod_cache_size=imc.pod_cache_size,
+        num_shards=config.num_shards,
+        recency_refresh_interval=config.recency_refresh_interval,
+    ))

@@ -32,15 +32,22 @@ class KVBlockScorerConfig:
     )
 
 
-def _max_weight(
-    entries: Sequence[PodEntry], pod_id: str, weights: Dict[str, float]
-) -> float:
-    best = 0.0
+def _pod_max_weights(
+    entries: Sequence[PodEntry], weights: Dict[str, float]
+) -> Dict[str, float]:
+    """One pass over a key's entries → {pod: max device-tier weight}.
+
+    Replaces the per-pod `_max_weight` rescan (O(pods × entries) per key)
+    with a single O(entries) pass; scores are bit-identical because the
+    same max is taken over the same floats before any addition happens.
+    """
+    best: Dict[str, float] = {}
     for entry in entries:
-        if entry.pod_identifier == pod_id:
-            w = weights.get(entry.device_tier, 1.0)
-            if w > best:
-                best = w
+        w = weights.get(entry.device_tier, 1.0)
+        pod = entry.pod_identifier
+        prev = best.get(pod)
+        if prev is None or w > prev:
+            best[pod] = w
     return best
 
 
@@ -58,20 +65,20 @@ class LongestPrefixScorer:
         if not keys:
             return {}
 
-        pods_first = key_to_pods.get(keys[0], [])
-        active = {e.pod_identifier for e in pods_first}
-        scores: Dict[str, float] = {
-            pod: _max_weight(pods_first, pod, self.medium_weights) for pod in active
-        }
+        weights = self.medium_weights
+        scores = _pod_max_weights(key_to_pods.get(keys[0], []), weights)
+        active = set(scores)
 
         for key in keys[1:]:
             if not active:
                 break
-            pods_here = key_to_pods.get(key, [])
-            active &= {e.pod_identifier for e in pods_here}
+            here = _pod_max_weights(key_to_pods.get(key, []), weights)
+            active &= here.keys()
             for pod in active:
-                scores[pod] += _max_weight(pods_here, pod, self.medium_weights)
+                scores[pod] += here[pod]
 
+        # Pods that dropped out keep the score accumulated so far; pods that
+        # never held block 0 were never admitted to `scores`.
         return scores
 
 

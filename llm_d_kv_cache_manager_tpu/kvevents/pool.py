@@ -15,6 +15,13 @@ Undecodable messages are dropped ("poison pills"), never retried
 (pool.go:182-187). The default device tier here is TPU "hbm" (the reference
 defaulted to "gpu"); events carrying an explicit Medium override it.
 
+The digest path feeds the shared KV-block index through its batched `add`
+(one call per BlockStored event, whole chain at once). With the default
+lock-striped `ShardedIndex` (kvblock/sharded.py) that add groups keys by
+`chunk_hash % num_shards` — the same FNV hash family as this pool's
+per-pod message sharding — and takes each stripe's lock once, so shard
+workers no longer serialize against the read plane's scoring lookups.
+
 Shard queues are bounded (the reference bounds ingest with rate-limited k8s
 workqueues, pool.go:103-144). On overflow the OLDEST queued message for that
 shard is dropped and counted (`kvcache_events_dropped_total`), but its

@@ -10,9 +10,11 @@ evictions, dp-rank filter matching) surface here as model mismatches.
 
 Documented per-backend delta honored by the model: the Redis backend CUTS
 the lookup walk at the first key with no post-filter entries (missing or
-fully filtered) while the in-memory backends continue past it
-(reference redis.go:199-205 vs in_memory.go:112-117; pinned individually
-in tests/test_index.py) — `cut_on_empty` per backend.
+fully filtered, redis.go:199-205) — `cut="empty"`. The in-memory backends
+(InMemoryIndex, CostAwareMemoryIndex, ShardedIndex) cut at the first
+*missing* key but continue past present-but-filtered-out keys —
+`cut="missing"` (the scorer can't use post-gap hits, so the early exit is
+score-invariant; pinned individually in tests/test_index.py).
 """
 
 import random
@@ -33,6 +35,10 @@ from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import (
     RedisIndex,
     RedisIndexConfig,
 )
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.sharded import (
+    ShardedIndex,
+    ShardedIndexConfig,
+)
 from tests.fake_redis import FakeRedisServer
 
 MODEL = "fuzz-model"
@@ -42,13 +48,15 @@ N_KEYS = 24
 
 
 class SemanticsModel:
-    """Executable contract: what any backend must answer. `cut_on_empty`
-    is the Redis delta: the walk stops at the first key whose post-filter
-    entry list is empty (missing OR fully filtered — redis_index.lookup),
-    while the in-memory backends continue past missing/filtered keys."""
+    """Executable contract: what any backend must answer. `cut` selects the
+    per-backend walk-termination delta: "empty" (Redis) stops at the first
+    key whose post-filter entry list is empty (missing OR fully filtered),
+    "missing" (in-memory family) stops at the first key absent from the
+    store but continues past present-but-filtered-out keys."""
 
-    def __init__(self, cut_on_empty: bool):
-        self.cut = cut_on_empty
+    def __init__(self, cut: str):
+        assert cut in ("missing", "empty")
+        self.cut = cut
         self.store = {}  # Key -> set[PodEntry]
         self.engine_map = {}  # Key -> Key
 
@@ -71,7 +79,11 @@ class SemanticsModel:
     def lookup(self, keys, pod_filter):
         out = {}
         for key in keys:
-            entries = self.store.get(key) or set()
+            entries = self.store.get(key)
+            if not entries:
+                if self.cut == "missing":
+                    return out  # in-memory family: gap ends the walk
+                entries = set()
             if pod_filter:
                 hits = {
                     e for e in entries
@@ -80,8 +92,8 @@ class SemanticsModel:
             else:
                 hits = set(entries)
             if not hits:
-                if self.cut:
-                    return out
+                if self.cut == "empty":
+                    return out  # redis: filtered-to-empty ends the walk too
                 continue
             out[key] = hits
         return out
@@ -90,9 +102,9 @@ class SemanticsModel:
         return self.engine_map.get(engine_key)
 
 
-def _fuzz(index, cut_on_empty: bool, seed: int, n_ops: int = 300):
+def _fuzz(index, cut: str, seed: int, n_ops: int = 300):
     rng = random.Random(seed)
-    model = SemanticsModel(cut_on_empty)
+    model = SemanticsModel(cut)
     keys = [Key(MODEL, 1000 + i) for i in range(N_KEYS)]
     # Engine keys are distinct from request keys (dual-key bookkeeping).
     engine_of = {k: Key(MODEL, 5000 + k.chunk_hash) for k in keys}
@@ -149,21 +161,32 @@ def _fuzz(index, cut_on_empty: bool, seed: int, n_ops: int = 300):
 @pytest.mark.parametrize("seed", [11, 23, 47])
 class TestDifferentialFuzz:
     def test_in_memory(self, seed):
-        _fuzz(InMemoryIndex(), cut_on_empty=False, seed=seed)
+        _fuzz(InMemoryIndex(), cut="missing", seed=seed)
 
     def test_cost_aware(self, seed):
         # Budget far above the working set: economics eviction never fires,
         # so the semantics model applies unmodified.
         _fuzz(
             CostAwareMemoryIndex(CostAwareIndexConfig(max_size_bytes="64MiB")),
-            cut_on_empty=False, seed=seed,
+            cut="missing", seed=seed,
+        )
+
+    def test_sharded(self, seed):
+        # Capacity far above the working set (no per-shard eviction), so the
+        # striped index must be indistinguishable from the model.
+        _fuzz(ShardedIndex(), cut="missing", seed=seed)
+
+    def test_sharded_touch_every_lookup(self, seed):
+        _fuzz(
+            ShardedIndex(ShardedIndexConfig(recency_refresh_interval=1)),
+            cut="missing", seed=seed,
         )
 
     def test_redis(self, seed):
         server = FakeRedisServer()
         index = RedisIndex(RedisIndexConfig(url=server.url))
         try:
-            _fuzz(index, cut_on_empty=True, seed=seed)
+            _fuzz(index, cut="empty", seed=seed)
         finally:
             index.close()
             server.close()

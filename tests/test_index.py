@@ -25,6 +25,10 @@ from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import (
     RedisIndex,
     RedisIndexConfig,
 )
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.sharded import (
+    ShardedIndex,
+    ShardedIndexConfig,
+)
 
 
 def _k(i: int, model: str = "m") -> Key:
@@ -56,6 +60,13 @@ BACKENDS = {
         InMemoryIndex(InMemoryIndexConfig(size=1000, pod_cache_size=10))
     ),
     "redis": _redis_backend,
+    "sharded": lambda: ShardedIndex(
+        ShardedIndexConfig(size=1000, pod_cache_size=10)
+    ),
+    # touch-every-lookup: the seed's recency behavior over striped segments
+    "sharded_touch": lambda: ShardedIndex(
+        ShardedIndexConfig(size=1000, pod_cache_size=10, recency_refresh_interval=1)
+    ),
 }
 
 
@@ -159,15 +170,18 @@ class TestCommonIndexBehavior:
 
 
 class TestInMemorySpecific:
-    def test_missing_key_does_not_cut_lookup(self):
-        # In-memory semantics: a *missing* key doesn't cut (only a present key
-        # with an empty pod set does) — reference in_memory.go:137-139. The
-        # Redis backend cuts on misses too (redis.go:199-205), hence not in
-        # the shared suite.
+    def test_missing_key_cuts_lookup(self):
+        # A missing key now cuts the walk, like the Redis backend
+        # (redis.go:199-205) and unlike the reference's in-memory index
+        # (in_memory.go:137-139): LongestPrefixScorer empties its active set
+        # at any gap, so post-gap entries can never score — returning them
+        # is pure wasted lock traffic. Scores are unchanged by the cut.
         index = InMemoryIndex(InMemoryIndexConfig(size=10, pod_cache_size=2))
         index.add([_k(2)], [_k(2)], [_pod("p1")])
         got = index.lookup([_k(1), _k(2)], set())
-        assert got == {_k(2): [_pod("p1")]}
+        assert got == {}
+        # The present key is still served when the walk reaches it first.
+        assert index.lookup([_k(2), _k(1)], set()) == {_k(2): [_pod("p1")]}
 
     def test_lru_size_bound(self):
         index = InMemoryIndex(InMemoryIndexConfig(size=5, pod_cache_size=2))

@@ -16,6 +16,16 @@ LEGS = (
 )
 
 
+CONTENTION_LEGS = ("lookup_mt", "mixed_rw")
+
+
+def _check_contention_legs(report):
+    for leg in CONTENTION_LEGS:
+        for backend in ("in_memory", "sharded"):
+            assert report[leg][backend]["lookups_per_s"] > 0, (leg, backend)
+        assert report[leg]["speedup_x"] > 0
+
+
 def test_quick_mode_measures_every_leg():
     out = subprocess.run(
         [sys.executable, str(BENCH), "--quick"],
@@ -27,6 +37,7 @@ def test_quick_mode_measures_every_leg():
     for leg in LEGS:
         assert report[leg]["p50_us"] > 0, leg
     assert report["event_digest"]["blocks_per_s"] > 0
+    _check_contention_legs(report)
     # The warm path must actually be riding the prefix store.
     assert report["tokenize"]["p50_us"] < report["tokenize_cold"]["p50_us"]
 
@@ -41,3 +52,11 @@ def test_committed_artifact_is_coherent():
         assert d[leg]["p50_us"] > 0, leg
     assert d["tokenize"]["p50_us"] < d["tokenize_cold"]["p50_us"]
     assert d["event_digest"]["blocks_per_s"] > 0
+    _check_contention_legs(d)
+    # The committed artifact must demonstrate the striped index relieving
+    # read contention (acceptance: >=3x at 8 readers with concurrent
+    # digestion; keep a margin below that so a noisy rerun on slower
+    # hardware doesn't flake the suite while still catching regressions).
+    assert d["lookup_mt"]["readers"] == 8
+    assert d["lookup_mt"]["speedup_x"] >= 2.0
+    assert d["mixed_rw"]["speedup_x"] >= 1.0

@@ -1,0 +1,385 @@
+"""ShardedIndex: equivalence with the seed index, concurrency, and wiring.
+
+Three layers:
+- property equivalence: randomized add/evict/lookup interleavings (including
+  lora_id keyspaces) must produce bit-identical lookup maps AND
+  `GetPodScores`-style scorer output between the seed `InMemoryIndex` and
+  `ShardedIndex` — capacity held above the working set so LRU eviction
+  (which legitimately diverges: global vs per-shard victim choice) never
+  fires.
+- concurrency: readers + writers + evictors race one index; no deadlock, no
+  exceptions, deterministic final state for disjoint writer keyspaces, and
+  per-shard capacity invariants under churn (slow-marked for the heavy run).
+- wiring: `new_index`/`IndexConfig` selection, JSON config round-trip,
+  batched LRU primitives, and the touch=False recency semantics.
+"""
+
+import json
+import random
+import threading
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.config import indexer_config_from_json
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+    InMemoryIndex,
+    InMemoryIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import IndexConfig, new_index
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.instrumented import InstrumentedIndex
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.sharded import (
+    DEFAULT_NUM_SHARDS,
+    ShardedIndex,
+    ShardedIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.scorer import new_kv_block_scorer
+from llm_d_kv_cache_manager_tpu.utils.lru import LRUCache
+
+MODEL = "m"
+
+
+def _k(i: int) -> Key:
+    return Key(MODEL, i)
+
+
+def _pod(name: str, tier: str = "hbm") -> PodEntry:
+    return PodEntry(name, tier)
+
+
+def _chains():
+    """Realistic request-key chains: chained CBOR+FNV hashes over token
+    blocks, in both the base and a LoRA-adapter keyspace."""
+    db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=4))
+    chains = []
+    for lora_id in (None, 7, 12):
+        for base in range(4):
+            tokens = list(range(base * 100, base * 100 + 32))  # 8 blocks
+            chains.append(
+                db.tokens_to_kv_block_keys(None, tokens, MODEL, lora_id=lora_id)
+            )
+    return chains
+
+
+class TestScoreEquivalence:
+    """Acceptance gate: sharded and seed indexes yield identical pod scores
+    over the same op sequence (lookup maps compared exactly too, so list
+    order — oldest-first pod LRU order — must also match)."""
+
+    @pytest.mark.parametrize("seed", [3, 17, 91])
+    def test_randomized_interleavings(self, seed):
+        rng = random.Random(seed)
+        chains = _chains()
+        engine_of = {
+            k: Key(MODEL, (k.chunk_hash * 31 + 1) & 0xFFFFFFFFFFFFFFFF)
+            for chain in chains
+            for k in chain
+        }
+        pods = ["p0", "p1", "p2", "p1@dp0"]
+        tiers = ["hbm", "host"]
+        scorer = new_kv_block_scorer()
+
+        seed_index = InMemoryIndex()
+        sharded = ShardedIndex()
+
+        for _ in range(400):
+            op = rng.random()
+            if op < 0.5:
+                chain = rng.choice(chains)
+                start = rng.randrange(len(chain))
+                sub = chain[start:start + rng.randint(1, 4)]
+                engines = [engine_of[k] for k in sub]
+                entries = [
+                    PodEntry(p, rng.choice(tiers))
+                    for p in rng.sample(pods, rng.randint(1, 3))
+                ]
+                seed_index.add(engines, sub, entries)
+                sharded.add(engines, sub, entries)
+            elif op < 0.7:
+                chain = rng.choice(chains)
+                key = rng.choice(chain)
+                victims = [PodEntry(rng.choice(pods), rng.choice(tiers))]
+                seed_index.evict(engine_of[key], victims)
+                sharded.evict(engine_of[key], victims)
+            else:
+                chain = rng.choice(chains)
+                pod_filter = set(rng.sample(pods, 2)) if rng.random() < 0.4 else set()
+                got_seed = seed_index.lookup(chain, pod_filter)
+                got_sharded = sharded.lookup(chain, pod_filter)
+                assert got_seed == got_sharded  # exact: keys, lists, order
+                scores_seed = scorer.score(chain, got_seed)
+                scores_sharded = scorer.score(chain, got_sharded)
+                assert scores_seed == scores_sharded  # bit-identical floats
+
+        for chain in chains:  # final sweep, unfiltered
+            got_seed = seed_index.lookup(chain, set())
+            got_sharded = sharded.lookup(chain, set())
+            assert got_seed == got_sharded
+            assert scorer.score(chain, got_seed) == scorer.score(chain, got_sharded)
+
+    def test_touch_every_lookup_matches_too(self):
+        seed_index = InMemoryIndex()
+        sharded = ShardedIndex(ShardedIndexConfig(recency_refresh_interval=1))
+        chain = [_k(i) for i in range(64)]
+        for index in (seed_index, sharded):
+            index.add(chain, chain, [_pod("p1"), _pod("p2", "host")])
+        assert seed_index.lookup(chain, set()) == sharded.lookup(chain, set())
+
+
+class TestConcurrency:
+    def _run_stress(self, index, n_chains, duration_threads=None):
+        """Disjoint writer keyspaces: writer w owns chains w*10^7 + i*8.
+        Evictors remove the first half of each writer's chains. Final state
+        is deterministic: second half present, first half gone."""
+        n_writers, n_readers, n_evictors = 3, 4, 2
+        errors = []
+        read_chain = [_k(5_000_000 + i) for i in range(128)]
+        index.add(read_chain, read_chain, [_pod("r1"), _pod("r2")])
+        writers_done = threading.Event()
+        evictable = [[] for _ in range(n_writers)]
+        ev_lock = threading.Lock()
+        scorer = new_kv_block_scorer()
+
+        def writer(w):
+            try:
+                entry = [_pod(f"w{w}")]
+                for i in range(n_chains):
+                    keys = [_k((w + 1) * 10_000_000 + i * 8 + j) for j in range(8)]
+                    index.add(keys, keys, entry)
+                    if i < n_chains // 2:
+                        with ev_lock:
+                            evictable[w].append((keys[0], entry))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def evictor(slot):
+            try:
+                while True:
+                    item = None
+                    with ev_lock:
+                        for lst in evictable:
+                            if lst:
+                                item = lst.pop()
+                                break
+                    if item is None:
+                        if writers_done.is_set():
+                            return
+                        continue
+                    key, entry = item
+                    # Evict the whole 8-key chain via its engine keys.
+                    for j in range(8):
+                        index.evict(_k(key.chunk_hash + j), entry)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def reader():
+            try:
+                while not writers_done.is_set():
+                    hits = index.lookup(read_chain, set())
+                    scorer.score(read_chain, hits)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=writer, args=(w,)) for w in range(n_writers)
+        ]
+        threads += [
+            threading.Thread(target=evictor, args=(s,)) for s in range(n_evictors)
+        ]
+        threads += [threading.Thread(target=reader) for _ in range(n_readers)]
+        for t in threads:
+            t.start()
+        for t in threads[:n_writers]:
+            t.join(timeout=60)
+        writers_done.set()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), "deadlocked thread"
+        assert not errors, errors
+        return read_chain
+
+    def test_stress_no_deadlock_no_lost_state(self):
+        # Capacity far above the working set: no LRU eviction, so the final
+        # state is exactly writers' second-half chains plus the read chain.
+        index = ShardedIndex(ShardedIndexConfig(size=10**6, num_shards=8))
+        n_chains = 60
+        read_chain = self._run_stress(index, n_chains)
+
+        got = index.lookup(read_chain, set())
+        assert set(got) == set(read_chain)  # reader chain never touched
+        for w in range(3):
+            for i in range(n_chains):
+                keys = [_k((w + 1) * 10_000_000 + i * 8 + j) for j in range(8)]
+                hits = index.lookup([keys[0]], set())
+                if i < n_chains // 2:
+                    assert hits == {}, f"writer {w} chain {i} not evicted"
+                else:
+                    assert hits == {keys[0]: [_pod(f"w{w}")]}, (
+                        f"writer {w} chain {i} lost"
+                    )
+        # The lock-free read view never resurrects dead keys: every view
+        # entry is backed by a live segment entry once writers quiesce.
+        live = set()
+        for seg in index._segments:
+            live.update(seg.data.keys())
+        assert set(index._view) <= live
+
+    @pytest.mark.slow
+    def test_stress_under_capacity_pressure(self):
+        # Small per-shard capacity: constant LRU churn. Content is
+        # nondeterministic; the invariants are no deadlock, no errors, and
+        # every segment within its striped bound.
+        index = ShardedIndex(
+            ShardedIndexConfig(size=256, num_shards=8, pod_cache_size=4)
+        )
+        self._run_stress(index, n_chains=400)
+        assert all(
+            size <= index.per_shard_capacity for size in index.segment_sizes()
+        )
+        live = set()
+        for seg in index._segments:
+            live.update(seg.data.keys())
+        assert set(index._view) <= live
+
+    def test_per_shard_capacity_bound(self):
+        index = ShardedIndex(ShardedIndexConfig(size=64, num_shards=8))
+        assert index.per_shard_capacity == 8
+        keys = [_k(i) for i in range(500)]
+        for key in keys:
+            index.add([key], [key], [_pod("p1")])
+        sizes = index.segment_sizes()
+        assert all(size <= 8 for size in sizes)
+        assert sum(sizes) <= 64
+        # View tracks the survivors exactly (single-threaded, so no races).
+        live = set()
+        for seg in index._segments:
+            live.update(seg.data.keys())
+        assert set(index._view) == live
+
+
+class TestRecencySemantics:
+    def test_peek_lookup_does_not_refresh_recency(self):
+        # One shard, capacity 2, refresh interval high: lookups peek, so the
+        # looked-up key is still the LRU victim.
+        index = ShardedIndex(
+            ShardedIndexConfig(size=2, num_shards=1, recency_refresh_interval=1000)
+        )
+        index.add([_k(1)], [_k(1)], [_pod("p1")])
+        index.add([_k(2)], [_k(2)], [_pod("p1")])
+        index.lookup([_k(1)], set())  # peek: no recency refresh
+        index.add([_k(3)], [_k(3)], [_pod("p1")])  # evicts k1 (still oldest)
+        assert index.lookup([_k(1)], set()) == {}
+        assert index.lookup([_k(2)], set())
+
+    def test_touch_lookup_refreshes_recency(self):
+        index = ShardedIndex(
+            ShardedIndexConfig(size=2, num_shards=1, recency_refresh_interval=1)
+        )
+        index.add([_k(1)], [_k(1)], [_pod("p1")])
+        index.add([_k(2)], [_k(2)], [_pod("p1")])
+        index.lookup([_k(1)], set())  # touch: k1 becomes most recent
+        index.add([_k(3)], [_k(3)], [_pod("p1")])  # evicts k2 instead
+        assert index.lookup([_k(1)], set())
+        assert index.lookup([_k(2)], set()) == {}
+
+
+class TestWiring:
+    def test_default_index_is_sharded(self):
+        index = new_index()
+        assert isinstance(index, ShardedIndex)
+        assert index.num_shards == DEFAULT_NUM_SHARDS
+
+    def test_sharded_false_restores_seed_backend(self):
+        assert isinstance(new_index(IndexConfig(sharded=False)), InMemoryIndex)
+
+    def test_in_memory_config_feeds_sharded_geometry(self):
+        index = new_index(IndexConfig(
+            in_memory_config=InMemoryIndexConfig(size=100, pod_cache_size=3),
+            num_shards=4,
+        ))
+        assert isinstance(index, ShardedIndex)
+        assert index.num_shards == 4
+        assert index.per_shard_capacity == 25
+
+    def test_metrics_wrap_sharded(self):
+        index = new_index(IndexConfig(enable_metrics=True))
+        assert isinstance(index, InstrumentedIndex)
+        assert isinstance(index.inner, ShardedIndex)
+
+    def test_json_round_trip(self):
+        cfg = indexer_config_from_json(json.dumps({
+            "kv_block_index_config": {
+                "sharded": True,
+                "num_shards": 8,
+                "recency_refresh_interval": 16,
+            }
+        }))
+        index = new_index(cfg.kv_block_index_config)
+        assert isinstance(index, ShardedIndex)
+        assert index.num_shards == 8
+
+    def test_invalid_geometry_raises(self):
+        with pytest.raises(ValueError):
+            ShardedIndex(ShardedIndexConfig(num_shards=0))
+        with pytest.raises(ValueError):
+            ShardedIndex(ShardedIndexConfig(size=0))
+
+    def test_shard_routing_spreads_real_chains(self):
+        # Real chained hashes spread across stripes: a 96-key chain must
+        # touch many of 16 shards (uniform hashes make an empty-ish stripe
+        # astronomically unlikely).
+        db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=4))
+        chain = db.tokens_to_kv_block_keys(None, list(range(384)), MODEL)
+        index = ShardedIndex()
+        shards = {index.shard_of(k) for k in chain}
+        assert len(shards) >= 12
+
+
+class TestBatchedLRUPrimitives:
+    def test_get_many_refreshes_recency(self):
+        lru = LRUCache(3)
+        for i in (1, 2, 3):
+            lru.add(i, i * 10)
+        assert lru.get_many([1, 2, 99]) == {1: 10, 2: 20}
+        lru.add(4, 40)  # evicts 3, the only un-refreshed key
+        assert lru.peek(3) is None
+        assert lru.peek(1) == 10
+
+    def test_peek_many_leaves_recency_alone(self):
+        lru = LRUCache(3)
+        for i in (1, 2, 3):
+            lru.add(i, i * 10)
+        assert lru.peek_many([1, 2]) == {1: 10, 2: 20}
+        lru.add(4, 40)  # evicts 1: peeks didn't refresh
+        assert lru.peek(1) is None
+
+    def test_add_many_counts_evictions(self):
+        lru = LRUCache(2)
+        assert lru.add_many([(1, "a"), (2, "b")]) == 0
+        assert lru.add_many([(3, "c"), (4, "d")]) == 2
+        assert lru.keys() == [3, 4]
+
+    def test_on_evict_fires_for_every_departure(self):
+        gone = []
+        lru = LRUCache(2, on_evict=lambda k, v: gone.append((k, v)))
+        lru.add(1, "a")
+        lru.add(2, "b")
+        lru.add(3, "c")  # capacity eviction
+        lru.remove(2)
+        lru.purge()
+        assert gone == [(1, "a"), (2, "b"), (3, "c")]
+
+    def test_keys_snapshot_tracks_mutation(self):
+        lru = LRUCache(4)
+        lru.add(1, "a")
+        lru.add(2, "b")
+        assert lru.keys() == [1, 2]
+        assert lru.keys() == [1, 2]  # cached snapshot path
+        lru.get(1)  # recency move must invalidate the snapshot
+        assert lru.keys() == [2, 1]
+        lru.remove(2)
+        assert lru.keys() == [1]
