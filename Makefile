@@ -2,8 +2,8 @@
 # build/test/bench/lint/image-build/image-push + pre-commit install —
 # /root/reference/Makefile, /root/reference/hooks/pre-commit.sh).
 
-.PHONY: native test bench bench-micro clean proto lint precommit-install \
-	image-build image-push
+.PHONY: native test bench bench-micro bench-faults clean proto lint \
+	precommit-install image-build image-push
 
 # Container image coordinates (override per environment/registry). The
 # release workflow (.github/workflows/ci-release.yaml) builds the same
@@ -48,6 +48,12 @@ bench: native
 #   python benchmarking/micro_bench.py
 bench-micro:
 	JAX_PLATFORMS=cpu python benchmarking/micro_bench.py --quick
+
+# Fault-injection fleet scenario (fleethealth/): pod crash/restart, event
+# stall, lossy/reordering streams over the synthetic chat workload.
+# Headless; rewrites benchmarking/FLEET_BENCH_FAULTS.json.
+bench-faults:
+	JAX_PLATFORMS=cpu python bench.py --faults
 
 proto:
 	protoc --python_out=. llm_d_kv_cache_manager_tpu/api/indexer.proto

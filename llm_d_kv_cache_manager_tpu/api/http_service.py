@@ -9,11 +9,17 @@ the ZMQ KVEvents write plane, and Prometheus metrics behind HTTP:
                                  "chat_template"?, "pods"?}
                                 -> {"podScores", "templated_messages"}
   GET  /metrics                 Prometheus exposition
-  GET  /health                  liveness
+  GET  /health                  liveness (the process is up, nothing more)
+  GET  /readyz                  readiness: event-plane state (subscriber
+                                thread + consecutive bind failures, shard
+                                queue depths, drop counters) and the
+                                per-pod fleet-health summary; 503 while
+                                the event plane cannot make progress
 
 Env config mirrors the reference's variable set (online/main.go:41-58):
 ZMQ_ENDPOINT, ZMQ_TOPIC, POOL_CONCURRENCY, PYTHONHASHSEED (hash seed!),
-BLOCK_SIZE, BLOCK_HASH_ALGO, HTTP_PORT, HF_TOKEN, LOCAL_TOKENIZER_DIR.
+BLOCK_SIZE, BLOCK_HASH_ALGO, HTTP_PORT, HF_TOKEN, LOCAL_TOKENIZER_DIR,
+plus the fleet-health windows SUSPECT_AFTER_S / STALE_AFTER_S.
 
 Run: python -m llm_d_kv_cache_manager_tpu.api.http_service
 """
@@ -31,6 +37,10 @@ from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import IndexConfig
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
     TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.fleethealth import (
+    FleetHealthConfig,
+    FleetHealthTracker,
 )
 from llm_d_kv_cache_manager_tpu.kvevents.pool import EventPool, EventPoolConfig
 from llm_d_kv_cache_manager_tpu.preprocessing.chat_completions import (
@@ -62,6 +72,10 @@ def config_from_env() -> dict:
         "index_url": os.environ.get("INDEX_URL", ""),
         # UDS tokenizer sidecar socket; empty -> local tokenization only.
         "uds_socket": os.environ.get("UDS_SOCKET", ""),
+        # Fleet-health windows (fleethealth/tracker.py): event silence
+        # beyond these demotes / excludes-and-purges a pod.
+        "suspect_after_s": float(os.environ.get("SUSPECT_AFTER_S", "30")),
+        "stale_after_s": float(os.environ.get("STALE_AFTER_S", "120")),
     }
 
 
@@ -72,6 +86,11 @@ class ScoringService:
         env = env or config_from_env()
         self.env = env
         self.templating = ChatTemplatingProcessor()
+        self.fleet_health = FleetHealthTracker(FleetHealthConfig(
+            suspect_after_s=float(env.get("suspect_after_s", 30.0)),
+            stale_after_s=float(env.get("stale_after_s", 120.0)),
+        ))
+        self._started = False
 
         if indexer is not None:  # injected (tests / embedding)
             self.indexer = indexer
@@ -105,6 +124,14 @@ class ScoringService:
                 config=indexer_config, chat_templating=self.templating
             )
 
+        # Wire fleet health into the read path (degraded-mode scoring) and
+        # the quarantine target. Injected indexers get the same treatment —
+        # their scores must also stop following phantom placements.
+        if self.indexer.fleet_health is None:
+            self.indexer.fleet_health = self.fleet_health
+        if self.fleet_health.index is None:
+            self.fleet_health.bind_index(self.indexer.kv_block_index)
+
         self.event_pool = EventPool(
             EventPoolConfig(
                 zmq_endpoint=env["zmq_endpoint"],
@@ -113,11 +140,13 @@ class ScoringService:
             ),
             self.indexer.kv_block_index,
             self.indexer.token_processor,
+            health_tracker=self.fleet_health,
         )
 
     def start(self, with_subscriber: bool = True) -> None:
         self.indexer.run()
         self.event_pool.start(with_subscriber=with_subscriber)
+        self._started = True
 
     def stop(self) -> None:
         self.event_pool.shutdown()
@@ -174,7 +203,45 @@ class ScoringService:
         )
 
     async def handle_health(self, request: web.Request) -> web.Response:
+        # Liveness ONLY: the process is up and serving HTTP. Whether the
+        # event plane works is a readiness question — see /readyz — so a
+        # restart loop is never triggered by a peer's outage.
         return web.json_response({"status": "ok"})
+
+    def readiness(self) -> dict:
+        """Readiness snapshot: event-plane progress + per-pod health."""
+        subscriber = self.event_pool._subscriber  # noqa: SLF001
+        sub_info = None
+        sub_ready = True  # pools started without a subscriber (embedded
+        # mode / direct event sinks) are ready by construction
+        if subscriber is not None:
+            failures = subscriber.consecutive_failures
+            sub_info = {
+                "thread_alive": subscriber.is_alive(),
+                "consecutive_failures": failures,
+                "endpoint": self.env.get("zmq_endpoint"),
+            }
+            sub_ready = subscriber.is_alive() and failures == 0
+        workers = self.event_pool.workers_alive()
+        pool_info = {
+            "workers_alive": workers,
+            "queue_depths": self.event_pool.queue_depths(),
+            "dropped_events": self.event_pool.dropped_events,
+            "removals_lost": self.event_pool.removals_lost,
+        }
+        ready = bool(self._started and workers > 0 and sub_ready)
+        return {
+            "status": "ready" if ready else "unready",
+            "started": self._started,
+            "subscriber": sub_info,
+            "event_pool": pool_info,
+            "fleet": self.fleet_health.summary(),
+        }
+
+    async def handle_readyz(self, request: web.Request) -> web.Response:
+        payload = await asyncio.to_thread(self.readiness)
+        status = 200 if payload["status"] == "ready" else 503
+        return web.json_response(payload, status=status)
 
     def make_app(self) -> web.Application:
         app = web.Application()
@@ -184,6 +251,7 @@ class ScoringService:
         )
         app.router.add_get("/metrics", self.handle_metrics)
         app.router.add_get("/health", self.handle_health)
+        app.router.add_get("/readyz", self.handle_readyz)
         return app
 
 

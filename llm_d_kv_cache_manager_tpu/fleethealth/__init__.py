@@ -1,0 +1,23 @@
+from llm_d_kv_cache_manager_tpu.fleethealth.faults import (
+    FaultInjector,
+    FaultPlan,
+    PodFaults,
+)
+from llm_d_kv_cache_manager_tpu.fleethealth.tracker import (
+    HEALTHY,
+    STALE,
+    SUSPECT,
+    FleetHealthConfig,
+    FleetHealthTracker,
+)
+
+__all__ = [
+    "FaultInjector",
+    "FaultPlan",
+    "FleetHealthConfig",
+    "FleetHealthTracker",
+    "HEALTHY",
+    "PodFaults",
+    "STALE",
+    "SUSPECT",
+]

@@ -1,0 +1,152 @@
+"""Deterministic fault injection for the KV-event write plane.
+
+Faults are injected at the pod→pool delivery seam (the same place the
+bench's event sink and the ZMQ subscriber hand `Message`s to
+`EventPool.add_task`), so everything downstream — decode, sharding,
+digest, liveness tracking — is the REAL code path under test. The
+injector never inspects payloads; it only drops, duplicates, holds, and
+swaps whole messages, exactly what a crashed pod, a stalled stream, or a
+lossy/reordering transport does.
+
+Fault classes (per pod, composable):
+
+- **crash / restart**: every message in ``[crash_at_s, restart_at_s)`` is
+  swallowed (the pod is gone; nothing publishes). The bench additionally
+  stops *serving* on the pod and replaces it with a cold instance at
+  restart.
+- **stall**: messages in ``[stall_from_s, stall_until_s)`` are swallowed —
+  a wedged publisher/subscriber whose overflow is dropped. The pod keeps
+  serving; the index's view of it silently freezes.
+- **drop_rate**: each message is independently lost with this probability
+  (seeded RNG) — the receiver sees seq gaps.
+- **duplicate_rate**: the message is delivered twice, same seq.
+- **reorder_rate**: the message is held and delivered AFTER the pod's
+  next message — adjacent swap, the receiver sees seq go backwards.
+
+Everything is driven by an injected clock and a seeded RNG: a fault run
+is a pure function of (plan, workload), replayable bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+
+@dataclass
+class PodFaults:
+    crash_at_s: Optional[float] = None
+    restart_at_s: Optional[float] = None  # None with crash_at_s = stays dead
+    stall_from_s: Optional[float] = None
+    stall_until_s: Optional[float] = None
+    drop_rate: float = 0.0
+    duplicate_rate: float = 0.0
+    reorder_rate: float = 0.0
+
+    def crashed(self, now: float) -> bool:
+        if self.crash_at_s is None or now < self.crash_at_s:
+            return False
+        return self.restart_at_s is None or now < self.restart_at_s
+
+    def stalled(self, now: float) -> bool:
+        return (
+            self.stall_from_s is not None
+            and self.stall_from_s <= now
+            and (self.stall_until_s is None or now < self.stall_until_s)
+        )
+
+
+@dataclass
+class FaultPlan:
+    seed: int = 0
+    pods: Dict[str, PodFaults] = field(default_factory=dict)
+
+    def for_pod(self, pod_id: str) -> Optional[PodFaults]:
+        return self.pods.get(pod_id)
+
+    def as_dict(self) -> dict:
+        """JSON-serializable provenance for bench artifacts."""
+        out: Dict[str, dict] = {}
+        for pod, f in sorted(self.pods.items()):
+            out[pod] = {
+                k: v
+                for k, v in (
+                    ("crash_at_s", f.crash_at_s),
+                    ("restart_at_s", f.restart_at_s),
+                    ("stall_from_s", f.stall_from_s),
+                    ("stall_until_s", f.stall_until_s),
+                    ("drop_rate", f.drop_rate),
+                    ("duplicate_rate", f.duplicate_rate),
+                    ("reorder_rate", f.reorder_rate),
+                )
+                if v not in (None, 0.0)
+            }
+        return {"seed": self.seed, "pods": out}
+
+
+class FaultInjector:
+    """Applies a FaultPlan at the message-delivery seam.
+
+    `wrap(pod_id, deliver)` returns a delivery callable with the pod's
+    faults applied; pods without planned faults get `deliver` back
+    unwrapped (zero overhead — the no-fault path stays bit-identical).
+    """
+
+    def __init__(self, plan: FaultPlan, clock: Callable[[], float]):
+        self.plan = plan
+        self.clock = clock
+        self._rng = random.Random(plan.seed)
+        # pod -> (message awaiting swap, its delivery callable)
+        self._held: Dict[str, tuple] = {}
+        self.injected = {
+            "crash_dropped": 0,
+            "stall_dropped": 0,
+            "dropped": 0,
+            "duplicated": 0,
+            "reordered": 0,
+        }
+
+    def wrap(self, pod_id: str, deliver: Callable) -> Callable:
+        faults = self.plan.for_pod(pod_id)
+        if faults is None:
+            return deliver
+
+        def delivery(msg):
+            now = self.clock()
+            if faults.crashed(now):
+                self.injected["crash_dropped"] += 1
+                return
+            if faults.stalled(now):
+                self.injected["stall_dropped"] += 1
+                return
+            if faults.drop_rate and self._rng.random() < faults.drop_rate:
+                self.injected["dropped"] += 1
+                return
+            if faults.reorder_rate:
+                held = self._held.pop(pod_id, None)
+                if held is not None:
+                    # Second half of an adjacent swap: newer first.
+                    deliver(msg)
+                    held[1](held[0])
+                    self.injected["reordered"] += 1
+                    return
+                if self._rng.random() < faults.reorder_rate:
+                    self._held[pod_id] = (msg, deliver)
+                    return
+            deliver(msg)
+            if faults.duplicate_rate and self._rng.random() < faults.duplicate_rate:
+                deliver(msg)
+                self.injected["duplicated"] += 1
+
+        return delivery
+
+    def flush(self) -> None:
+        """Deliver any message still held for a reorder swap (end of run —
+        a real transport would eventually flush its buffer too)."""
+        held, self._held = self._held, {}
+        for msg, deliver in held.values():
+            deliver(msg)
+
+    def held_count(self) -> int:
+        return len(self._held)

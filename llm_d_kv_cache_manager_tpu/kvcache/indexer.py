@@ -72,8 +72,15 @@ class Indexer:
         tokenization_pool: Optional[TokenizationPool] = None,
         kv_block_index: Optional[Index] = None,
         chat_templating=None,
+        fleet_health=None,
     ):
         self.config = config or IndexerConfig()
+        # Optional fleethealth.FleetHealthTracker: when wired, scores pass
+        # through `filter_scores` — suspect pods demoted, stale pods
+        # excluded (and their entries bulk-purged on detection). A healthy
+        # fleet passes through untouched, so enabling the subsystem is
+        # bit-identical on the no-fault path.
+        self.fleet_health = fleet_health
 
         self.prefix_store = (
             tokenization_pool.prefix_store
@@ -82,6 +89,9 @@ class Indexer:
         )
         self.token_processor = ChunkedTokenDatabase(self.config.token_processor_config)
         self.kv_block_index = kv_block_index or new_index(self.config.kv_block_index_config)
+        if fleet_health is not None and fleet_health.index is None:
+            # Quarantine purges target the same index lookups read.
+            fleet_health.bind_index(self.kv_block_index)
 
         # Scorer tier weights follow the top-level backend configs, like the
         # reference's override in NewKVCacheIndexer (indexer.go:93-98).
@@ -144,5 +154,11 @@ class Indexer:
 
         key_to_pods = self.kv_block_index.lookup(block_keys, set(pod_identifiers))
         scores = self.scorer.score(block_keys, key_to_pods)
+        if self.fleet_health is not None:
+            # Degraded-mode scoring: suspect pods demoted, stale pods
+            # excluded. An emptied map is the explicit no-cache-signal
+            # answer — the caller's load/round-robin fallback takes over
+            # instead of routing to phantom placements.
+            scores = self.fleet_health.filter_scores(scores)
         kvlog.trace(logger, "pod scores: %s", scores)
         return scores

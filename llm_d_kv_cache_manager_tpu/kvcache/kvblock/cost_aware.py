@@ -163,6 +163,34 @@ class CostAwareMemoryIndex(Index):
         with self._mu:
             return self._engine_to_request.get(engine_key)
 
+    def remove_pod(self, pod_identifier: str) -> int:
+        """One-pass quarantine purge (Index.remove_pod contract); the byte
+        budget is re-credited as entries leave."""
+        target = {pod_identifier}
+        removed = 0
+        with self._mu:
+            for request_key in list(self._data):
+                pod_cache = self._data[request_key]
+                self._total_cost -= pod_cache.cost
+                with pod_cache.mu:
+                    victims = [
+                        e for e in pod_cache.cache.keys()
+                        if pod_matches(e.pod_identifier, target)
+                    ]
+                    for entry in victims:
+                        pod_cache.cache.remove(entry)
+                    removed += len(victims)
+                    is_empty = len(pod_cache.cache) == 0
+                    pod_cache.cost = calculate_byte_size(
+                        request_key, pod_cache.cache.keys()
+                    )
+                self._total_cost += pod_cache.cost
+                if is_empty:
+                    self._data.pop(request_key, None)
+                    self._total_cost -= pod_cache.cost
+                    self._drop_engine_mappings(request_key)
+        return removed
+
     def _drop_engine_mappings(self, request_key: Key) -> None:
         for engine_key in self._request_to_engines.pop(request_key, ()):  # noqa: B020
             self._engine_to_request.pop(engine_key, None)

@@ -149,3 +149,32 @@ class InMemoryIndex(Index):
 
     def get_request_key(self, engine_key: Key) -> Optional[Key]:
         return self._engine_to_request.get(engine_key)
+
+    def remove_pod(self, pod_identifier: str) -> int:
+        """One-pass quarantine purge (Index.remove_pod contract).
+
+        Walks a snapshot of the key space; the same best-effort caveat as
+        `evict` applies under concurrency (an add racing the pass can
+        repopulate a key, which LRU then collects).
+        """
+        target = {pod_identifier}
+        removed = 0
+        emptied = set()
+        for request_key, pod_cache in self._data.items():
+            with pod_cache.mu:
+                victims = [
+                    e for e in pod_cache.cache.keys()
+                    if pod_matches(e.pod_identifier, target)
+                ]
+                for entry in victims:
+                    pod_cache.cache.remove(entry)
+                removed += len(victims)
+                is_empty = victims and len(pod_cache.cache) == 0
+            if is_empty:
+                self._data.remove(request_key)
+                emptied.add(request_key)
+        if emptied:
+            for engine_key, request_key in self._engine_to_request.items():
+                if request_key in emptied:
+                    self._engine_to_request.remove(engine_key)
+        return removed

@@ -49,6 +49,19 @@ class Index(abc.ABC):
     def get_request_key(self, engine_key: Key) -> Optional[Key]:
         """Resolve an engine key to its request key, or None if unknown."""
 
+    @abc.abstractmethod
+    def remove_pod(self, pod_identifier: str) -> int:
+        """Bulk-purge every entry `pod_identifier` holds, in one pass.
+
+        The quarantine primitive (fleethealth/tracker.py): when a pod is
+        declared stale/dead its placements must stop scoring NOW, not leak
+        until LRU churn or per-block removal events that will never arrive.
+        A bare pod name also removes its DP-ranked identities ("pod@dpN");
+        a ranked name removes only that rank (`key.pod_matches` semantics,
+        same as lookup filters). Keys left with no pods are dropped from
+        both key spaces. Returns the number of pod entries removed.
+        """
+
 
 @dataclass
 class IndexConfig:

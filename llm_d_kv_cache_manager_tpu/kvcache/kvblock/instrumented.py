@@ -56,3 +56,9 @@ class InstrumentedIndex(Index):
 
     def get_request_key(self, engine_key: Key) -> Optional[Key]:
         return self.inner.get_request_key(engine_key)
+
+    def remove_pod(self, pod_identifier: str) -> int:
+        removed = self.inner.remove_pod(pod_identifier)
+        if m.index_evictions is not None and removed:
+            m.index_evictions.inc(removed)
+        return removed

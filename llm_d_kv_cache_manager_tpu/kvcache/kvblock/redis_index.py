@@ -16,6 +16,7 @@ placeholder maps to DCN-attached Valkey on TPU fleets (config flag kept).
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from dataclasses import dataclass
@@ -37,6 +38,16 @@ class RedisIndexConfig:
     url: str = "redis://localhost:6379"
     timeout_s: float = 5.0
     enable_rdma: bool = False  # Valkey-over-DCN placeholder (reference parity)
+    # Reconnect backoff (after a failed reconnect, lookups fail fast for
+    # this long instead of each paying the connect timeout). Consecutive
+    # failures double the window up to the cap; jitter (a uniform fraction
+    # of the window) desynchronizes a fleet of manager replicas all
+    # backing off from the same outage.
+    reconnect_backoff_s: float = 5.0
+    reconnect_backoff_max_s: float = 60.0
+    reconnect_jitter: float = 0.2
+    # SCAN page size for bulk maintenance passes (remove_pod).
+    scan_count: int = 512
 
 
 def _key_str(key: Key) -> str:
@@ -63,9 +74,11 @@ def _parse_entry(field: str) -> Optional[PodEntry]:
     return PodEntry(pod, tier)
 
 
-# After a failed reconnect, skip further reconnect attempts for this long:
-# without it, a partitioned Redis makes EVERY scoring lookup block the full
-# connect timeout before soft-failing — a fleet-wide stall, not a miss.
+# Default for RedisIndexConfig.reconnect_backoff_s (kept as a module
+# constant for back-compat with callers/tests that monkeypatch it): after a
+# failed reconnect, skip further reconnect attempts for this long — without
+# it, a partitioned Redis makes EVERY scoring lookup block the full connect
+# timeout before soft-failing — a fleet-wide stall, not a miss.
 RECONNECT_BACKOFF_S = 5.0
 # Cut-chain events surface at WARNING at most this often (an outage must be
 # operator-visible, not a debug-level mystery hit-rate collapse).
@@ -79,6 +92,14 @@ class RedisIndex(Index):
         self._mu = threading.Lock()  # guards backoff/reconnect bookkeeping
         self._reconnecting = False
         self._down_until = 0.0
+        # Connection lifecycle: "up" -> "down" (first pipeline failure) ->
+        # "backoff" (reconnect failed; lookups fail fast) -> "up". Every
+        # transition is logged and counted
+        # (kvcache_redis_state_transitions_total) — an outage must be
+        # operator-visible, not a silently-absorbed hit-rate collapse.
+        self._state = "up"
+        self._consecutive_failures = 0
+        self._jitter_rng = random.Random()
         # Negative sentinel: monotonic() is time-since-boot, so 0.0 would
         # suppress the FIRST outage warning during early uptime.
         self._last_warn = -_WARN_INTERVAL_S
@@ -109,19 +130,51 @@ class RedisIndex(Index):
                 if time.monotonic() < self._down_until or self._reconnecting:
                     raise  # another thread is on it / already failed
                 self._reconnecting = True
+                self._set_state_locked("down")
             try:
                 self._conn.connect()
                 replies = self._conn.pipeline(commands)
             except OSError:
                 with self._mu:
-                    self._down_until = time.monotonic() + RECONNECT_BACKOFF_S
+                    delay = self._backoff_delay_locked()
+                    self._down_until = time.monotonic() + delay
+                    self._set_state_locked("backoff")
+                logger.warning(
+                    "redis reconnect to %s failed (attempt %d): backing off "
+                    "%.2fs", self.config.url, self._consecutive_failures, delay,
+                )
                 raise
             finally:
                 with self._mu:
                     self._reconnecting = False
             with self._mu:
                 self._down_until = 0.0
+                self._consecutive_failures = 0
+                self._set_state_locked("up")
             return replies
+
+    def _backoff_delay_locked(self) -> float:
+        """Next capped-exponential backoff window (+jitter). Holds `_mu`."""
+        self._consecutive_failures += 1
+        base = max(self.config.reconnect_backoff_s, 0.0)
+        delay = min(
+            base * (2.0 ** (self._consecutive_failures - 1)),
+            max(self.config.reconnect_backoff_max_s, base),
+        )
+        jitter = max(self.config.reconnect_jitter, 0.0)
+        if jitter:
+            delay *= 1.0 + jitter * self._jitter_rng.random()
+        return delay
+
+    def _set_state_locked(self, state: str) -> None:
+        if state == self._state:
+            return
+        old, self._state = self._state, state
+        from llm_d_kv_cache_manager_tpu.metrics import collector as metrics
+
+        metrics.count_redis_transition(state)
+        log = logger.info if state == "up" else logger.warning
+        log("redis index %s: %s -> %s", self.config.url, old, state)
 
     def _warn_cut(self, e: Exception) -> None:
         now = time.monotonic()
@@ -217,3 +270,89 @@ class RedisIndex(Index):
         if value is None or isinstance(value, RespError):
             return None
         return _parse_key(value.decode("utf-8") if isinstance(value, bytes) else value)
+
+    def remove_pod(self, pod_identifier: str) -> int:
+        """One-pass quarantine purge (Index.remove_pod contract).
+
+        SCAN-walks the keyspace (cursor iteration, never the blocking
+        KEYS), HDELs the pod's fields from each request-key hash in
+        pipelined pages, DELs hashes that emptied, and finally drops
+        engine:* mappings that point at deleted request keys. Connection
+        errors propagate like the write path's (callers log and retry the
+        quarantine; the pod stays excluded by health state meanwhile).
+        """
+        target = {pod_identifier}
+        removed = 0
+        emptied: Set[str] = set()
+        for page in self._scan_pages():
+            request_keys = [k for k in page if not k.startswith("engine:")]
+            if not request_keys:
+                continue
+            replies = self._pipeline([("HKEYS", k) for k in request_keys])
+            commands = []
+            victims_per_key: List[tuple] = []
+            for key_str, reply in zip(request_keys, replies):
+                if isinstance(reply, RespError) or reply is None:
+                    continue
+                victims = []
+                for field in reply:
+                    field_str = (
+                        field.decode("utf-8") if isinstance(field, bytes) else field
+                    )
+                    entry = _parse_entry(field_str)
+                    if entry is not None and pod_matches(
+                        entry.pod_identifier, target
+                    ):
+                        victims.append(field_str)
+                if victims:
+                    commands.append(("HDEL", key_str, *victims))
+                    commands.append(("HLEN", key_str))
+                    victims_per_key.append((key_str, len(victims)))
+            if not commands:
+                continue
+            replies = self._pipeline(commands)
+            del_cmds = []
+            for i, (key_str, n_victims) in enumerate(victims_per_key):
+                removed += n_victims
+                if replies[2 * i + 1] == 0:  # the HLEN after the HDEL
+                    del_cmds.append(("DEL", key_str))
+                    emptied.add(key_str)
+            if del_cmds:
+                self._pipeline(del_cmds)
+        if emptied:
+            for page in self._scan_pages(match="engine:*"):
+                engine_keys = [k for k in page if k.startswith("engine:")]
+                if not engine_keys:
+                    continue
+                values = self._pipeline([("GET", k) for k in engine_keys])
+                stale = [
+                    k
+                    for k, v in zip(engine_keys, values)
+                    if isinstance(v, (bytes, str))
+                    and (v.decode("utf-8") if isinstance(v, bytes) else v)
+                    in emptied
+                ]
+                if stale:
+                    self._pipeline([("DEL", *stale)])
+        return removed
+
+    def _scan_pages(self, match: str = "*"):
+        """Yield pages of keys (decoded str) via cursor SCAN."""
+        cursor = "0"
+        while True:
+            reply = self._pipeline(
+                [("SCAN", cursor, "MATCH", match, "COUNT", self.config.scan_count)]
+            )[0]
+            if isinstance(reply, RespError) or reply is None:
+                return
+            cursor_raw, keys = reply[0], reply[1]
+            cursor = (
+                cursor_raw.decode("utf-8")
+                if isinstance(cursor_raw, bytes)
+                else str(cursor_raw)
+            )
+            yield [
+                k.decode("utf-8") if isinstance(k, bytes) else k for k in keys
+            ]
+            if cursor == "0":
+                return

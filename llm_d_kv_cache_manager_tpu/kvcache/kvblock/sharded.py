@@ -317,3 +317,39 @@ class ShardedIndex(Index):
         return self._segments[self.shard_of(engine_key)].engine_to_request.get(
             engine_key
         )
+
+    def remove_pod(self, pod_identifier: str) -> int:
+        """One-pass quarantine purge (Index.remove_pod contract), segment by
+        segment — each stripe locks independently, and the read view is
+        republished under each pod cache's mutex so concurrent lookups only
+        ever see before/after states of a key, never a torn one."""
+        target = {pod_identifier}
+        removed = 0
+        emptied = set()
+        view = self._view
+        for seg in self._segments:
+            for request_key, pod_cache in seg.data.items():
+                with pod_cache.mu:
+                    victims = [
+                        e for e in pod_cache.cache.keys()
+                        if pod_matches(e.pod_identifier, target)
+                    ]
+                    for entry in victims:
+                        pod_cache.cache.remove(entry)
+                    removed += len(victims)
+                    if not victims:
+                        continue
+                    pod_cache.republish()
+                    view[request_key] = pod_cache.entries
+                    is_empty = len(pod_cache.cache) == 0
+                if is_empty:
+                    # The segment LRU's on_evict hook prunes the view entry
+                    # under the segment lock.
+                    seg.data.remove(request_key)
+                    emptied.add(request_key)
+        if emptied:
+            for seg in self._segments:
+                for engine_key, request_key in seg.engine_to_request.items():
+                    if request_key in emptied:
+                        seg.engine_to_request.remove(engine_key)
+        return removed

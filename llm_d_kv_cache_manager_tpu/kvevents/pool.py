@@ -107,10 +107,15 @@ class EventPool:
         config: Optional[EventPoolConfig],
         index: Index,
         token_processor: ChunkedTokenDatabase,
+        health_tracker=None,
     ):
         self.config = config or EventPoolConfig()
         self.index = index
         self.token_processor = token_processor
+        # Optional fleethealth.FleetHealthTracker (duck-typed to avoid an
+        # import cycle): every decoded batch stamps per-pod liveness and
+        # runs seq/ts gap detection; poison pills count as decode failures.
+        self.health_tracker = health_tracker
         depth = max(0, self.config.max_queue_depth)
         self._queues: List["queue.Queue[Optional[Message]]"] = [
             queue.Queue(maxsize=depth) for _ in range(self.config.concurrency)
@@ -219,6 +224,13 @@ class EventPool:
         cap was hit — each one is a potential stale index entry."""
         with self._dropped_mu:
             return self._removals_lost
+
+    def queue_depths(self) -> List[int]:
+        """Approximate per-shard queue depth (readiness introspection)."""
+        return [q.qsize() for q in self._queues]
+
+    def workers_alive(self) -> int:
+        return sum(1 for t in self._workers if t.is_alive())
 
     def add_task(self, msg: Message) -> None:
         """Shard by FNV-1a(pod) so per-pod ordering is preserved.
@@ -362,6 +374,8 @@ class EventPool:
             batch = EventBatch.from_msgpack(msg.payload)
         except Exception as e:  # noqa: BLE001 - poison pill: drop, don't retry
             logger.debug("dropping undecodable event batch (topic=%s): %s", msg.topic, e)
+            if self.health_tracker is not None:
+                self.health_tracker.observe_decode_failure(msg.pod_identifier)
             return
         # DP-rank-aware identity: a DP>1 engine runs one cache per rank, so
         # rank r's blocks are indexed under "pod@dpR" — otherwise the ranks
@@ -375,6 +389,11 @@ class EventPool:
             pod = f"{pod}@dp{rank}"
         elif rank is not None:
             logger.debug("ignoring invalid data_parallel_rank %r", rank)
+        if self.health_tracker is not None:
+            # Liveness + stream-integrity check BEFORE digesting, under the
+            # same DP-rank-qualified identity the index entries use, so the
+            # tracker's state keys always match score keys.
+            self.health_tracker.observe_batch(pod, msg.topic, msg.seq, batch.ts)
         self._digest_events(pod, msg.model_name, batch)
 
     def _digest_events(

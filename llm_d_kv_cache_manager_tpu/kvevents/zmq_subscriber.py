@@ -7,14 +7,21 @@ needs no per-pod endpoint config), subscribes to the topic filter (default
 
     [topic: "kv@<pod-id>@<model>", seq: uint64 big-endian, payload: msgpack]
 
-The receive loop polls with a 250ms timeout so shutdown is responsive, and on
-any socket error tears down and reconnects after 5s, forever.
+The receive loop polls with a 250ms timeout so shutdown is responsive. On
+any socket error it tears down and reconnects forever — but where the
+reference retries at a fixed 5s, this loop uses capped exponential backoff
+with jitter (base `RETRY_INTERVAL_S`, cap `RETRY_MAX_S`): a persistently
+broken endpoint backs off instead of hammering, while jitter keeps a fleet
+of managers from retrying in lockstep. The consecutive-failure count is
+surfaced to the fleet-health tracker (`pool.health_tracker`, when wired)
+and via the `consecutive_failures` attribute, which `/readyz` reports — a
+manager whose event plane cannot bind is *live* but not *ready*.
 """
 
 from __future__ import annotations
 
+import random
 import threading
-import time
 from typing import Optional
 
 import zmq
@@ -24,8 +31,32 @@ from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
 
 logger = kvlog.get_logger("kvevents.zmq_subscriber")
 
-RETRY_INTERVAL_S = 5.0
+RETRY_INTERVAL_S = 5.0  # backoff base (first retry delay)
+RETRY_MAX_S = 60.0  # backoff cap
+RETRY_JITTER = 0.25  # uniform extra fraction of the delay
 POLL_TIMEOUT_MS = 250
+
+
+def backoff_delay(
+    consecutive_failures: int,
+    base: Optional[float] = None,
+    cap: Optional[float] = None,
+    jitter: float = 0.0,
+) -> float:
+    """Capped exponential backoff for the Nth consecutive failure (N>=1).
+
+    `jitter` in [0, 1] stretches the delay by a uniform random fraction;
+    pass 0 (the default) for the deterministic base schedule.
+    """
+    if base is None:
+        base = RETRY_INTERVAL_S
+    if cap is None:
+        cap = RETRY_MAX_S
+    n = max(consecutive_failures, 1)
+    delay = min(base * (2.0 ** (n - 1)), max(cap, base))
+    if jitter > 0.0:
+        delay *= 1.0 + jitter * random.random()
+    return delay
 
 
 class ZMQSubscriber:
@@ -33,6 +64,9 @@ class ZMQSubscriber:
         self.pool = pool
         self.endpoint = endpoint
         self.topic_filter = topic_filter
+        # Consecutive _run_subscriber exits without a successful bind+poll
+        # session; reset on every successful bind. Read by /readyz.
+        self.consecutive_failures = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._ctx: Optional[zmq.Context] = None
@@ -52,12 +86,37 @@ class ZMQSubscriber:
             self._thread.join(timeout=5.0)
             self._thread = None
 
+    def is_alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def _notify_health(self, connected: bool) -> None:
+        tracker = getattr(self.pool, "health_tracker", None)
+        if tracker is None:
+            return
+        try:
+            if connected:
+                tracker.observe_subscriber_connected()
+            else:
+                tracker.observe_subscriber_failure(self.consecutive_failures)
+        except Exception as e:  # noqa: BLE001 - health reporting is advisory
+            logger.debug("health notify failed: %s", e)
+
     def _run(self) -> None:
         while not self._stop.is_set():
             self._run_subscriber()
-            if self._stop.wait(RETRY_INTERVAL_S):
+            if self._stop.is_set():
                 return
-            logger.info("retrying zmq-subscriber")
+            self.consecutive_failures += 1
+            self._notify_health(connected=False)
+            delay = backoff_delay(
+                self.consecutive_failures, jitter=RETRY_JITTER
+            )
+            if self._stop.wait(delay):
+                return
+            logger.info(
+                "retrying zmq-subscriber (attempt %d, waited %.2fs)",
+                self.consecutive_failures + 1, delay,
+            )
 
     def _run_subscriber(self) -> None:
         try:
@@ -69,6 +128,8 @@ class ZMQSubscriber:
             sub.bind(self.endpoint)
             sub.setsockopt_string(zmq.SUBSCRIBE, self.topic_filter)
             logger.info("bound subscriber socket at %s", self.endpoint)
+            self.consecutive_failures = 0
+            self._notify_health(connected=True)
 
             poller = zmq.Poller()
             poller.register(sub, zmq.POLLIN)

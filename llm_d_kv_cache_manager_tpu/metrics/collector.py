@@ -44,6 +44,15 @@ tokenization_backend_fallbacks: Optional[Counter] = None
 # queues are bounded and overload is made visible instead of rate-limited.
 events_dropped: Optional[Counter] = None
 tokenization_rejected: Optional[Counter] = None
+# Fleet-health counters (fleethealth/tracker.py): pod lifecycle transitions,
+# bulk purges of quarantined pods' index entries, and event-stream
+# integrity anomalies (seq gaps / duplicates / reorders / ts regressions).
+pod_state_transitions: Optional[Counter] = None
+stale_entries_purged: Optional[Counter] = None
+event_stream_anomalies: Optional[Counter] = None
+# Redis backend connection lifecycle (kvblock/redis_index.py):
+# down -> backoff -> up, made operator-visible instead of silently retried.
+redis_state_transitions: Optional[Counter] = None
 
 _registered = False
 _register_lock = threading.Lock()
@@ -57,6 +66,8 @@ def register_metrics(registry=None) -> None:
     global tokenization_latency, tokenized_tokens, render_latency
     global tokenization_backend_latency, tokenization_backend_fallbacks
     global events_dropped, tokenization_rejected
+    global pod_state_transitions, stale_entries_purged
+    global event_stream_anomalies, redis_state_transitions
 
     with _register_lock:
         if _registered:
@@ -134,6 +145,30 @@ def register_metrics(registry=None) -> None:
             "Tokenization tasks rejected because the pool queue was full",
             registry=reg,
         )
+        pod_state_transitions = Counter(
+            "kvcache_pod_state_transitions_total",
+            "Pod health-state transitions, labeled by the state entered",
+            labelnames=("state",),
+            registry=reg,
+        )
+        stale_entries_purged = Counter(
+            "kvcache_stale_index_entries_purged_total",
+            "Index pod entries purged by stale-pod quarantine",
+            registry=reg,
+        )
+        event_stream_anomalies = Counter(
+            "kvcache_event_stream_anomalies_total",
+            "Event-stream integrity anomalies detected by the liveness "
+            "tracker",
+            labelnames=("kind",),
+            registry=reg,
+        )
+        redis_state_transitions = Counter(
+            "kvcache_redis_state_transitions_total",
+            "Redis/Valkey index connection state transitions",
+            labelnames=("state",),
+            registry=reg,
+        )
         _registered = True
 
 
@@ -170,6 +205,26 @@ def count_event_dropped(n: int = 1) -> None:
 def count_tokenization_rejected() -> None:
     if tokenization_rejected is not None:
         tokenization_rejected.inc()
+
+
+def count_pod_transition(state: str) -> None:
+    if pod_state_transitions is not None:
+        pod_state_transitions.labels(state=state).inc()
+
+
+def count_stale_purged(n: int) -> None:
+    if stale_entries_purged is not None and n:
+        stale_entries_purged.inc(n)
+
+
+def count_stream_anomaly(kind: str) -> None:
+    if event_stream_anomalies is not None:
+        event_stream_anomalies.labels(kind=kind).inc()
+
+
+def count_redis_transition(state: str) -> None:
+    if redis_state_transitions is not None:
+        redis_state_transitions.labels(state=state).inc()
 
 
 def start_metrics_logging(interval_s: float = 60.0) -> None:

@@ -2,7 +2,8 @@
 
 Test double equivalent to the reference's miniredis dependency
 (/root/reference/pkg/kvcache/kvblock/redis_test.go:22): enough of the
-protocol (PING, SET, GET, DEL, HSET, HDEL, HKEYS, HLEN, FLUSHALL, SELECT)
+protocol (PING, SET, GET, DEL, HSET, HDEL, HKEYS, HLEN, SCAN, FLUSHALL,
+SELECT)
 for the RedisIndex behavior suite, no external server needed.
 """
 
@@ -163,4 +164,26 @@ class FakeRedisServer:
                 return out
             if cmd == b"HLEN":
                 return b":%d\r\n" % len(self._hashes.get(args[0], {}))
+            if cmd == b"SCAN":
+                # SCAN cursor [MATCH pattern] [COUNT n] — single-page
+                # snapshot (cursor always returns 0), glob via fnmatch;
+                # enough for the RedisIndex bulk-maintenance walks.
+                import fnmatch
+
+                pattern = b"*"
+                for i in range(1, len(args) - 1):
+                    if args[i].upper() == b"MATCH":
+                        pattern = args[i + 1]
+                keys = [
+                    k
+                    for k in list(self._strings) + list(self._hashes)
+                    if fnmatch.fnmatchcase(
+                        k.decode("utf-8", "replace"),
+                        pattern.decode("utf-8", "replace"),
+                    )
+                ]
+                out = b"*2\r\n$1\r\n0\r\n*%d\r\n" % len(keys)
+                for k in keys:
+                    out += b"$%d\r\n%s\r\n" % (len(k), k)
+                return out
             return b"-ERR unknown command '%s'\r\n" % cmd

@@ -154,6 +154,46 @@ class TestHttp:
         finally:
             service.indexer.shutdown()
 
+    def test_readyz_reports_event_plane_and_fleet_health(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        service = self._service()
+
+        async def run():
+            async with TestClient(TestServer(service.make_app())) as client:
+                # Liveness stays liveness: /health is 200 even though the
+                # event plane was never started.
+                resp = await client.get("/health")
+                assert resp.status == 200
+
+                # Not started yet: unready, with the reason visible.
+                resp = await client.get("/readyz")
+                assert resp.status == 503
+                data = await resp.json()
+                assert data["status"] == "unready"
+                assert data["started"] is False
+
+                # Started without a subscriber (embedded mode): ready, and
+                # the payload carries queue/drop/pod-health introspection.
+                service.start(with_subscriber=False)
+                resp = await client.get("/readyz")
+                assert resp.status == 200
+                data = await resp.json()
+                assert data["status"] == "ready"
+                assert data["subscriber"] is None
+                assert data["event_pool"]["workers_alive"] >= 1
+                assert data["event_pool"]["dropped_events"] == 0
+                assert isinstance(data["event_pool"]["queue_depths"], list)
+                assert data["fleet"]["counts"] == {
+                    "healthy": 0, "suspect": 0, "stale": 0
+                }
+
+        try:
+            asyncio.run(run())
+        finally:
+            service.stop()
+            # stop() is safe even if start() never ran in a failed test.
+
     def test_score_chat_completions_renders_template(self):
         from aiohttp.test_utils import TestClient, TestServer
 

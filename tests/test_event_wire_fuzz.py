@@ -121,3 +121,91 @@ def test_mutated_frames_never_crash_and_good_traffic_lands():
         assert index.lookup(keys, set())
     finally:
         pool.shutdown()
+
+
+def test_duplicated_reordered_gapped_sequences_stay_consistent_and_detected():
+    """Transport-level stream damage (duplication, adjacent reordering,
+    seq gaps from dropped batches) must leave the pool/index consistent —
+    stores are idempotent, every delivered batch lands — while the
+    liveness tracker's per-topic seq monitoring counts each anomaly class.
+    Deterministic: seeded RNG, drain() instead of sleeps."""
+    from llm_d_kv_cache_manager_tpu.fleethealth import (
+        FleetHealthConfig,
+        FleetHealthTracker,
+    )
+
+    rng = random.Random(7)
+    clock = [0.0]
+    tracker = FleetHealthTracker(
+        FleetHealthConfig(suspect_after_s=1e9, stale_after_s=1e9),
+        clock=lambda: clock[0],
+    )
+    index = InMemoryIndex()
+    tp = ChunkedTokenDatabase(TokenProcessorConfig(block_size=BLOCK))
+    pool = EventPool(
+        EventPoolConfig(concurrency=2), index, tp, health_tracker=tracker
+    )
+    pool.start(with_subscriber=False)
+
+    # One pod's stream (ordering is per-pod), seq assigned at creation.
+    msgs = [_good_message(i) for i in range(60)]
+    for i, m in enumerate(msgs):
+        m.pod_identifier = "pod-0"
+        m.topic = f"kv@pod-0@{MODEL}"
+        m.seq = i
+    delivered = []
+    expect = {"duplicates": 0, "reorders": 0, "drop_groups": 0, "dropped": 0}
+    i = 0
+    while i < len(msgs):
+        roll = rng.random()
+        if roll < 0.15:  # drop -> the next delivered seq opens a gap
+            expect["drop_groups"] += 1
+            expect["dropped"] += 1
+            i += 1
+            # Consecutive drops coalesce into one (wider) gap jump.
+            while i < len(msgs) and rng.random() < 0.15:
+                expect["dropped"] += 1
+                i += 1
+            continue
+        if roll < 0.30 and i + 1 < len(msgs):  # adjacent swap
+            delivered += [msgs[i + 1], msgs[i]]
+            expect["reorders"] += 1
+            i += 2
+            continue
+        if roll < 0.45:  # duplicate
+            delivered += [msgs[i], msgs[i]]
+            expect["duplicates"] += 1
+            i += 1
+            continue
+        delivered.append(msgs[i])
+        i += 1
+    try:
+        for m in delivered:
+            pool.add_task(m)
+        pool.drain()
+        assert all(t.is_alive() for t in pool._workers)
+
+        # Consistency: every delivered batch landed (duplicates idempotent,
+        # reordering within one pod's stream cannot lose a store).
+        for m in delivered:
+            keys = tp.tokens_to_kv_block_keys(
+                None, list(range(m.seq * BLOCK, (m.seq + 1) * BLOCK)), MODEL
+            )
+            hits = index.lookup(keys, set())
+            pods = {e.pod_identifier for e in hits.get(keys[0], [])}
+            assert "pod-0" in pods, f"batch seq={m.seq} lost"
+
+        # Detection: duplicates and reorders have exact expected counts
+        # (a swap [n+1, n] always registers exactly one seq-went-backwards
+        # event). Gap counts are lower-bounded: every drop group opens a
+        # jump > +1, but a swap ALSO opens one (n+1 arrives two past n-1),
+        # so the tracker may legitimately count more gaps than drops.
+        totals = tracker.anomaly_totals()
+        assert totals["duplicates"] == expect["duplicates"]
+        assert totals["reorders"] == expect["reorders"]
+        assert totals["seq_gaps"] >= expect["drop_groups"]
+        assert totals["gap_events"] >= expect["dropped"]
+        assert expect["drop_groups"] > 0 and expect["duplicates"] > 0
+        assert expect["reorders"] > 0  # the schedule exercised every class
+    finally:
+        pool.shutdown()

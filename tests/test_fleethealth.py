@@ -1,0 +1,487 @@
+"""Fleet-health subsystem: liveness state machine, bulk quarantine on every
+index backend, degraded-mode scoring, and the fault-injection seam.
+
+Everything here is deterministic: injected clocks (no sleeps), seeded RNGs,
+CPU only. The fast subset runs in tier-1 (`not slow`).
+"""
+
+import pytest
+
+from tests.fake_redis import FakeRedisServer
+from llm_d_kv_cache_manager_tpu.fleethealth import (
+    HEALTHY,
+    STALE,
+    SUSPECT,
+    FaultInjector,
+    FaultPlan,
+    FleetHealthConfig,
+    FleetHealthTracker,
+    PodFaults,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.cost_aware import (
+    CostAwareIndexConfig,
+    CostAwareMemoryIndex,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+    InMemoryIndex,
+    InMemoryIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import (
+    RedisIndex,
+    RedisIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.sharded import (
+    ShardedIndex,
+    ShardedIndexConfig,
+)
+
+pytestmark = pytest.mark.faults
+
+MODEL = "m"
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _tracker(clock, index=None, suspect=10.0, stale=30.0, factor=0.5):
+    return FleetHealthTracker(
+        FleetHealthConfig(
+            suspect_after_s=suspect,
+            stale_after_s=stale,
+            suspect_demotion_factor=factor,
+        ),
+        index=index,
+        clock=clock,
+    )
+
+
+class TestStateMachine:
+    def test_healthy_suspect_stale_windows(self):
+        clock = Clock()
+        tr = _tracker(clock)
+        tr.observe_batch("pod-a", "kv@pod-a@m", 0, ts=0.0)
+        assert tr.state_of("pod-a") == HEALTHY
+        clock.t = 9.9
+        assert tr.state_of("pod-a") == HEALTHY
+        clock.t = 10.0
+        assert tr.state_of("pod-a") == SUSPECT
+        clock.t = 29.9
+        assert tr.state_of("pod-a") == SUSPECT
+        clock.t = 30.0
+        assert tr.state_of("pod-a") == STALE
+
+    def test_unknown_pod_is_healthy(self):
+        tr = _tracker(Clock())
+        assert tr.state_of("never-seen") == HEALTHY
+
+    def test_events_resume_recovers_and_resets_seq_tracking(self):
+        clock = Clock()
+        tr = _tracker(clock)
+        topic = "kv@pod-a@m"
+        tr.observe_batch("pod-a", topic, 7, ts=0.0)
+        clock.t = 31.0
+        assert tr.state_of("pod-a") == STALE
+        # A restarted publisher restarts at seq 0: the fresh stream must
+        # not be flagged as a giant gap/reorder.
+        tr.observe_batch("pod-a", topic, 0, ts=31.0)
+        assert tr.state_of("pod-a") == HEALTHY
+        summary = tr.summary()
+        rec = summary["pods"]["pod-a"]
+        assert rec["recoveries"] == 1
+        assert rec["reorders"] == 0 and rec["seq_gaps"] == 0
+
+    def test_invalid_windows_rejected(self):
+        with pytest.raises(ValueError):
+            FleetHealthTracker(
+                FleetHealthConfig(suspect_after_s=10.0, stale_after_s=5.0)
+            )
+
+    def test_stale_transition_records_detection_latency(self):
+        clock = Clock()
+        tr = _tracker(clock)
+        tr.observe_batch("pod-a", "kv@pod-a@m", 0, ts=0.0)
+        clock.t = 42.0
+        tr.refresh()
+        rec = tr.summary()["pods"]["pod-a"]
+        assert rec["state"] == STALE
+        assert rec["detection_latency_s"] == pytest.approx(42.0)
+
+
+class TestGapDetection:
+    def test_seq_gap_duplicate_reorder_ts_regression(self):
+        clock = Clock()
+        tr = _tracker(clock)
+        topic = "kv@pod-a@m"
+        tr.observe_batch("pod-a", topic, 1, ts=1.0)
+        tr.observe_batch("pod-a", topic, 2, ts=2.0)  # in order
+        tr.observe_batch("pod-a", topic, 2, ts=2.0)  # duplicate
+        tr.observe_batch("pod-a", topic, 5, ts=3.0)  # gap of 2
+        tr.observe_batch("pod-a", topic, 4, ts=2.5)  # reorder
+        tr.observe_batch("pod-a", topic, 6, ts=0.1)  # ts regression (>1s)
+        totals = tr.anomaly_totals()
+        assert totals["duplicates"] == 1
+        assert totals["seq_gaps"] == 1 and totals["gap_events"] == 2
+        assert totals["reorders"] == 1
+        assert totals["ts_regressions"] == 1
+
+    def test_per_topic_seq_spaces_are_independent(self):
+        tr = _tracker(Clock())
+        tr.observe_batch("pod-a", "kv@pod-a@m1", 5, ts=1.0)
+        tr.observe_batch("pod-a", "kv@pod-a@m2", 1, ts=1.0)
+        assert tr.anomaly_totals()["seq_gaps"] == 0
+
+    def test_decode_failure_does_not_stamp_liveness(self):
+        clock = Clock()
+        tr = _tracker(clock)
+        tr.observe_batch("pod-a", "kv@pod-a@m", 0, ts=0.0)
+        clock.t = 31.0
+        tr.observe_decode_failure("pod-a")  # garbage is not liveness
+        assert tr.state_of("pod-a") == STALE
+        assert tr.anomaly_totals()["decode_failures"] == 1
+
+
+def _seed(index, pod_entries, n_keys=4, base=0):
+    """Store n_keys chained blocks held by `pod_entries`."""
+    request_keys = [Key(MODEL, base + i) for i in range(n_keys)]
+    engine_keys = [Key(MODEL, 10_000 + base + i) for i in range(n_keys)]
+    index.add(engine_keys, request_keys, pod_entries)
+    return engine_keys, request_keys
+
+
+def _backends():
+    return [
+        ("in_memory", lambda: InMemoryIndex(InMemoryIndexConfig(size=1000))),
+        ("sharded", lambda: ShardedIndex(ShardedIndexConfig(size=1000, num_shards=4))),
+        (
+            "cost_aware",
+            lambda: CostAwareMemoryIndex(CostAwareIndexConfig(max_size_bytes="64KiB")),
+        ),
+    ]
+
+
+class TestRemovePod:
+    @pytest.mark.parametrize("name,make", _backends())
+    def test_remove_pod_purges_only_that_pod(self, name, make):
+        index = make()
+        entries = [
+            PodEntry("gone", "hbm"),
+            PodEntry("gone@dp1", "hbm"),  # DP rank of the same pod
+            PodEntry("stays", "hbm"),
+        ]
+        engine_keys, request_keys = _seed(index, entries)
+        removed = index.remove_pod("gone")
+        # 2 entries (bare + ranked) per key.
+        assert removed == 2 * len(request_keys)
+        hits = index.lookup(request_keys, set())
+        assert set(hits) == set(request_keys)
+        for key_entries in hits.values():
+            assert {e.pod_identifier for e in key_entries} == {"stays"}
+        # Idempotent.
+        assert index.remove_pod("gone") == 0
+
+    @pytest.mark.parametrize("name,make", _backends())
+    def test_remove_last_pod_drops_both_key_spaces(self, name, make):
+        index = make()
+        engine_keys, request_keys = _seed(index, [PodEntry("solo", "hbm")])
+        assert index.remove_pod("solo") == len(request_keys)
+        assert index.lookup(request_keys, set()) == {}
+        for ek in engine_keys:
+            assert index.get_request_key(ek) is None
+
+    @pytest.mark.parametrize("name,make", _backends())
+    def test_ranked_identity_removes_only_that_rank(self, name, make):
+        index = make()
+        entries = [PodEntry("p@dp0", "hbm"), PodEntry("p@dp1", "hbm")]
+        _, request_keys = _seed(index, entries)
+        removed = index.remove_pod("p@dp0")
+        assert removed == len(request_keys)
+        hits = index.lookup(request_keys, set())
+        for key_entries in hits.values():
+            assert {e.pod_identifier for e in key_entries} == {"p@dp1"}
+
+    def test_remove_pod_redis(self):
+        server = FakeRedisServer()
+        try:
+            index = RedisIndex(RedisIndexConfig(url=server.url))
+            entries = [
+                PodEntry("gone", "hbm"),
+                PodEntry("gone@dp1", "hbm"),
+                PodEntry("stays", "hbm"),
+            ]
+            engine_keys, request_keys = _seed(index, entries)
+            removed = index.remove_pod("gone")
+            assert removed == 2 * len(request_keys)
+            hits = index.lookup(request_keys, set())
+            assert set(hits) == set(request_keys)
+            for key_entries in hits.values():
+                assert {e.pod_identifier for e in key_entries} == {"stays"}
+            # Removing the survivor empties the hashes AND the engine
+            # mappings behind them.
+            assert index.remove_pod("stays") == len(request_keys)
+            assert index.lookup(request_keys, set()) == {}
+            for ek in engine_keys:
+                assert index.get_request_key(ek) is None
+            index.close()
+        finally:
+            server.close()
+
+    def test_sharded_read_view_pruned(self):
+        # The lock-free read view must not resurrect purged placements.
+        index = ShardedIndex(ShardedIndexConfig(size=1000, num_shards=4))
+        _, request_keys = _seed(index, [PodEntry("gone", "hbm")])
+        assert index.lookup(request_keys, set())  # view populated
+        index.remove_pod("gone")
+        assert index.lookup(request_keys, set()) == {}
+
+
+class TestDegradedScoring:
+    def _scores(self):
+        return {"pod-a": 4.0, "pod-b": 3.0}
+
+    def test_all_healthy_is_identity(self):
+        clock = Clock()
+        tr = _tracker(clock)
+        tr.observe_batch("pod-a", "t", 0, ts=0.0)
+        tr.observe_batch("pod-b", "t", 0, ts=0.0)
+        scores = self._scores()
+        # Same object back: the no-fault read path is bit-identical.
+        assert tr.filter_scores(scores) is scores
+
+    def test_suspect_demoted_stale_excluded(self):
+        clock = Clock()
+        index = InMemoryIndex()
+        tr = _tracker(clock, index=index)
+        tr.observe_batch("pod-a", "t", 0, ts=0.0)
+        clock.t = 5.0
+        tr.observe_batch("pod-b", "t", 0, ts=5.0)
+        clock.t = 12.0  # pod-a quiet 12s: suspect; pod-b quiet 7s: healthy
+        assert tr.filter_scores(self._scores()) == {
+            "pod-a": 2.0, "pod-b": 3.0
+        }
+        clock.t = 31.0  # pod-a stale; pod-b suspect
+        assert tr.filter_scores(self._scores()) == {"pod-b": 1.5}
+
+    def test_all_stale_empties_scores(self):
+        clock = Clock()
+        tr = _tracker(clock)
+        tr.observe_batch("pod-a", "t", 0, ts=0.0)
+        tr.observe_batch("pod-b", "t", 0, ts=0.0)
+        clock.t = 100.0
+        assert tr.filter_scores(self._scores()) == {}
+
+    def test_stale_transition_purges_index(self):
+        clock = Clock()
+        index = InMemoryIndex()
+        tr = _tracker(clock, index=index)
+        _, request_keys = _seed(index, [PodEntry("pod-a", "hbm")])
+        tr.observe_batch("pod-a", "t", 0, ts=0.0)
+        clock.t = 31.0
+        tr.refresh()
+        assert index.lookup(request_keys, set()) == {}
+        assert tr.summary()["pods"]["pod-a"]["purged_entries"] == len(
+            request_keys
+        )
+
+    def test_quarantine_is_explicit_remove(self):
+        clock = Clock()
+        index = InMemoryIndex()
+        tr = _tracker(clock, index=index)
+        _, request_keys = _seed(index, [PodEntry("pod-x", "hbm")])
+        removed = tr.quarantine("pod-x")
+        assert removed == len(request_keys)
+        assert tr.state_of("pod-x") == STALE
+        assert index.lookup(request_keys, set()) == {}
+
+
+class TestIndexerIntegration:
+    def test_get_pod_scores_excludes_stale_pod(self, test_tokenizer_files):
+        from tests.conftest import TEST_MODEL_NAME
+        from llm_d_kv_cache_manager_tpu.kvcache.indexer import (
+            Indexer,
+            IndexerConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+            TokenProcessorConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+            TokenizationPool,
+            TokenizersPoolConfig,
+        )
+
+        clock = Clock()
+        tr = _tracker(clock)
+        indexer = Indexer(
+            config=IndexerConfig(
+                token_processor_config=TokenProcessorConfig(block_size=4),
+            ),
+            tokenization_pool=TokenizationPool(
+                TokenizersPoolConfig(
+                    workers=1, local_tokenizer_files=test_tokenizer_files
+                ),
+            ),
+            fleet_health=tr,
+        )
+        indexer.run()
+        try:
+            assert tr.index is indexer.kv_block_index  # auto-bound
+            prompt = "the quick brown fox jumps over the lazy dog " * 2
+            tokens = indexer.tokenizers_pool.tokenize(
+                None, prompt, TEST_MODEL_NAME
+            )
+            keys = indexer.token_processor.tokens_to_kv_block_keys(
+                None, tokens, TEST_MODEL_NAME
+            )
+            engine_keys = [
+                Key(TEST_MODEL_NAME, 50_000 + i) for i in range(len(keys))
+            ]
+            indexer.kv_block_index.add(
+                engine_keys, keys, [PodEntry("pod-z", "hbm")]
+            )
+            tr.observe_batch("pod-z", "t", 0, ts=0.0)
+            scores = indexer.get_pod_scores(prompt, TEST_MODEL_NAME, [])
+            assert scores.get("pod-z", 0) > 0
+            clock.t = 100.0  # silence -> stale -> excluded AND purged
+            assert indexer.get_pod_scores(prompt, TEST_MODEL_NAME, []) == {}
+            assert indexer.kv_block_index.lookup(keys, set()) == {}
+        finally:
+            indexer.shutdown()
+
+
+class TestFaultInjector:
+    def _plan(self, **faults):
+        return FaultPlan(seed=7, pods={"p": PodFaults(**faults)})
+
+    def test_unfaulted_pod_is_passthrough(self):
+        inj = FaultInjector(self._plan(), clock=lambda: 0.0)
+        sent = []
+        deliver = sent.append
+        assert inj.wrap("other", deliver) is deliver  # literally unwrapped
+
+    def test_crash_window_swallows_then_restores(self):
+        clock = Clock()
+        inj = FaultInjector(
+            self._plan(crash_at_s=1.0, restart_at_s=2.0), clock=clock
+        )
+        sent = []
+        d = inj.wrap("p", sent.append)
+        clock.t = 0.5
+        d("before")
+        clock.t = 1.5
+        d("during")
+        clock.t = 2.5
+        d("after")
+        assert sent == ["before", "after"]
+        assert inj.injected["crash_dropped"] == 1
+
+    def test_stall_window(self):
+        clock = Clock()
+        inj = FaultInjector(
+            self._plan(stall_from_s=1.0, stall_until_s=2.0), clock=clock
+        )
+        sent = []
+        d = inj.wrap("p", sent.append)
+        for t, m in ((0.5, "a"), (1.5, "b"), (2.1, "c")):
+            clock.t = t
+            d(m)
+        assert sent == ["a", "c"]
+        assert inj.injected["stall_dropped"] == 1
+
+    def test_drop_duplicate_reorder_deterministic(self):
+        inj = FaultInjector(
+            FaultPlan(seed=123, pods={"p": PodFaults(
+                drop_rate=0.2, duplicate_rate=0.2, reorder_rate=0.2
+            )}),
+            clock=lambda: 0.0,
+        )
+        sent = []
+        d = inj.wrap("p", sent.append)
+        for i in range(200):
+            d(i)
+        inj.flush()
+        counts = dict(inj.injected)
+        assert counts["dropped"] > 0
+        assert counts["duplicated"] > 0
+        assert counts["reordered"] > 0
+        # Conservation: every non-dropped message was delivered (dups extra).
+        assert len(sent) == 200 - counts["dropped"] + counts["duplicated"]
+        # Deterministic under the same seed.
+        inj2 = FaultInjector(
+            FaultPlan(seed=123, pods={"p": PodFaults(
+                drop_rate=0.2, duplicate_rate=0.2, reorder_rate=0.2
+            )}),
+            clock=lambda: 0.0,
+        )
+        sent2 = []
+        d2 = inj2.wrap("p", sent2.append)
+        for i in range(200):
+            d2(i)
+        inj2.flush()
+        assert sent2 == sent and dict(inj2.injected) == counts
+
+    def test_reorder_swaps_adjacent(self):
+        inj = FaultInjector(
+            FaultPlan(seed=0, pods={"p": PodFaults(reorder_rate=1.0)}),
+            clock=lambda: 0.0,
+        )
+        sent = []
+        d = inj.wrap("p", sent.append)
+        for i in range(4):
+            d(i)
+        assert sent == [1, 0, 3, 2]
+
+
+class TestSubscriberBackoff:
+    def test_capped_exponential_schedule(self):
+        from llm_d_kv_cache_manager_tpu.kvevents.zmq_subscriber import (
+            backoff_delay,
+        )
+
+        delays = [
+            backoff_delay(n, base=0.5, cap=8.0, jitter=0.0)
+            for n in range(1, 8)
+        ]
+        assert delays == [0.5, 1.0, 2.0, 4.0, 8.0, 8.0, 8.0]
+
+    def test_jitter_bounded(self):
+        from llm_d_kv_cache_manager_tpu.kvevents.zmq_subscriber import (
+            backoff_delay,
+        )
+
+        for _ in range(50):
+            d = backoff_delay(1, base=1.0, cap=8.0, jitter=0.25)
+            assert 1.0 <= d <= 1.25
+
+
+class TestRedisBackoffConfig:
+    def test_backoff_grows_and_resets(self):
+        server = FakeRedisServer()
+        index = RedisIndex(RedisIndexConfig(
+            url=server.url,
+            timeout_s=0.5,
+            reconnect_backoff_s=0.05,
+            reconnect_backoff_max_s=0.2,
+            reconnect_jitter=0.0,
+        ))
+        try:
+            with index._mu:
+                d1 = index._backoff_delay_locked()
+                d2 = index._backoff_delay_locked()
+                d3 = index._backoff_delay_locked()
+                d4 = index._backoff_delay_locked()
+            assert (d1, d2, d3) == (0.05, 0.1, 0.2)
+            assert d4 == 0.2  # capped
+            # Jitter stretches by at most the configured fraction.
+            index.config.reconnect_jitter = 0.5
+            with index._mu:
+                index._consecutive_failures = 0
+                d = index._backoff_delay_locked()
+            assert 0.05 <= d <= 0.075
+        finally:
+            index.close()
+            server.close()
