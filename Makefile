@@ -2,8 +2,8 @@
 # build/test/bench/lint/image-build/image-push + pre-commit install —
 # /root/reference/Makefile, /root/reference/hooks/pre-commit.sh).
 
-.PHONY: native test bench bench-micro bench-faults clean proto lint \
-	precommit-install image-build image-push
+.PHONY: native test bench bench-micro bench-read bench-faults clean proto \
+	lint precommit-install image-build image-push
 
 # Container image coordinates (override per environment/registry). The
 # release workflow (.github/workflows/ci-release.yaml) builds the same
@@ -20,6 +20,11 @@ image-build:
 image-push:
 	$(CONTAINER_TOOL) push $(IMG)
 
+# Builds the C hash core (native/fnvcbor.c → _kvtpu_native, installed into
+# the package dir) and the kv_connectors C++ shim. `pip install -e native/`
+# is an equivalent route for the hash core alone. Everything degrades
+# gracefully without it: hashing.py falls back to pure Python and
+# `native`-marked tests skip with a visible reason.
 native:
 	cd native && python setup.py build_ext
 	cd kv_connectors/cpp && $(MAKE)
@@ -48,6 +53,12 @@ bench: native
 #   python benchmarking/micro_bench.py
 bench-micro:
 	JAX_PLATFORMS=cpu python benchmarking/micro_bench.py --quick
+
+# Read-path derivation legs only (chunk_hash_cold / chunk_hash_warm /
+# read_path_cold / read_path_warm over a multi-turn ShareGPT-style replay).
+# Full mode (rewrites MICRO_BENCH.json): python benchmarking/micro_bench.py
+bench-read:
+	JAX_PLATFORMS=cpu python benchmarking/micro_bench.py --quick --legs read
 
 # Fault-injection fleet scenario (fleethealth/): pod crash/restart, event
 # stall, lossy/reordering streams over the synthetic chat workload.

@@ -25,9 +25,14 @@ Legs (all through public APIs):
   the aggregate read throughput ratio as speedup_x
 - mixed_rw: concurrent readers (lookup+score), direct add writers, and
   evictors over the same index, again for both backends
+- read_path_replay: multi-turn ShareGPT-style replay of the incremental
+  derivation path (kvblock/chain_memo.py) — chunk_hash_cold (from-scratch
+  derivation), chunk_hash_warm (chain memo + prefix-store boundary
+  states), their ratio, the memo-insert overhead on a truly cold request,
+  and the whole read path cold vs warm (get_pod_scores)
 
-Run: python benchmarking/micro_bench.py [--quick]
-Writes MICRO_BENCH.json (full mode) and prints it.
+Run: python benchmarking/micro_bench.py [--quick] [--legs all|read]
+Writes MICRO_BENCH.json (full mode, all legs) and prints it.
 """
 
 from __future__ import annotations
@@ -184,11 +189,181 @@ def _contention_leg(
     return out
 
 
+def _percentiles(samples):
+    samples = sorted(samples)
+    return {
+        "p50_us": round(samples[len(samples) // 2] * 1e6, 1),
+        "p90_us": round(samples[min(int(len(samples) * 0.9), len(samples) - 1)] * 1e6, 1),
+        "mean_us": round(statistics.mean(samples) * 1e6, 1),
+        "calls": len(samples),
+    }
+
+
+def _replay_leg(fn, requests, passes=1):
+    """Per-call latencies of `fn(i, request)` over `passes` replays."""
+    gc.collect()
+    samples = []
+    for _ in range(passes):
+        for i, req in enumerate(requests):
+            t0 = time.perf_counter()
+            fn(i, req)
+            samples.append(time.perf_counter() - t0)
+    return _percentiles(samples)
+
+
+def read_path_replay(quick: bool) -> dict:
+    """Multi-turn ShareGPT-style replay of the incremental read path.
+
+    "Cold derivation" = from-scratch hashing of every request (chain memo
+    off); "warm" = the shipped path, where the chain memo resumes each
+    follow-up turn at its first novel block via the prefix store's boundary
+    states. Same token lists, bit-identical keys — only the work moves.
+    """
+    from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.chain_memo import (
+        ChainMemo,
+        ChainMemoConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import PodEntry
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+        ChunkedTokenDatabase,
+        TokenProcessorConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+        TokenizationPool,
+        TokenizersPoolConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.workloads.sharegpt import (
+        ShareGPTConfig,
+        generate,
+    )
+
+    trace = generate(ShareGPTConfig(
+        n_sessions=6 if quick else 20, seed=1234, max_turns=6,
+    ))
+    requests = trace.requests()
+
+    report = {
+        "workload": "sharegpt",
+        "sessions": trace.config["n_sessions"],
+        "requests": len(requests),
+        "block_size": 16,
+    }
+
+    # -- derivation-only legs (chunk_hash_*) -------------------------------
+    pool = TokenizationPool(
+        TokenizersPoolConfig(workers=2, local_tokenizer_files={MODEL: FIXTURE})
+    )
+    pool.run()
+    try:
+        for req in requests:  # teach the prefix store every prompt
+            pool.tokenize(None, req.prompt, MODEL)
+        tokenized = [pool.tokenize_ex(None, r.prompt, MODEL) for r in requests]
+    finally:
+        pool.shutdown()
+    report["mean_prompt_tokens"] = round(
+        statistics.mean(len(t.tokens) for t in tokenized)
+    )
+
+    nomemo = ChunkedTokenDatabase(
+        TokenProcessorConfig(block_size=16, chain_memo=False)
+    )
+    report["chunk_hash_cold"] = _replay_leg(
+        lambda i, tp: nomemo.tokens_to_kv_block_keys(None, tp.tokens, MODEL),
+        tokenized, passes=2 if quick else 5,
+    )
+
+    # True-cold memo overhead: a fresh memo per call pays fingerprinting
+    # and insertion with zero reuse — the single-request regression bound.
+    def cold_first(i, tp):
+        db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=16))
+        db.tokens_to_kv_block_keys(
+            None, tp.tokens, MODEL, prefix_state=tp.prefix_state
+        )
+
+    report["chunk_hash_cold_memo_first"] = _replay_leg(
+        cold_first, tokenized, passes=1 if quick else 3
+    )
+    report["cold_memo_overhead_pct"] = round(
+        (report["chunk_hash_cold_memo_first"]["mean_us"]
+         / report["chunk_hash_cold"]["mean_us"] - 1.0) * 100, 1,
+    )
+
+    memo_db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=16))
+    for tp in tokenized:  # warm the memo exactly as a live replay would
+        memo_db.tokens_to_kv_block_keys(
+            None, tp.tokens, MODEL, prefix_state=tp.prefix_state
+        )
+    report["chunk_hash_warm"] = _replay_leg(
+        lambda i, tp: memo_db.tokens_to_kv_block_keys(
+            None, tp.tokens, MODEL, prefix_state=tp.prefix_state
+        ),
+        tokenized, passes=2 if quick else 5,
+    )
+    report["chunk_hash_speedup_x"] = round(
+        report["chunk_hash_cold"]["mean_us"]
+        / max(report["chunk_hash_warm"]["mean_us"], 0.1), 2,
+    )
+    report["chain_memo"] = memo_db.chain_memo.stats()
+
+    # -- whole read path (get_pod_scores) ----------------------------------
+    def build_indexer(warm: bool) -> Indexer:
+        return Indexer(
+            config=IndexerConfig(
+                token_processor_config=TokenProcessorConfig(
+                    block_size=16, chain_memo=warm,
+                ),
+            ),
+            tokenization_pool=TokenizationPool(
+                TokenizersPoolConfig(
+                    workers=2,
+                    local_tokenizer_files={MODEL: FIXTURE},
+                    # Cold arm: defeat the prefix store so every call pays
+                    # full tokenization + from-scratch derivation.
+                    min_prefix_overlap_ratio=0.8 if warm else 1.1,
+                ),
+            ),
+        )
+
+    pods = [PodEntry(f"pod-{i}", "hbm") for i in range(4)]
+    for arm, warm in (("read_path_cold", False), ("read_path_warm", True)):
+        indexer = build_indexer(warm)
+        indexer.run()
+        try:
+            for i, tp in enumerate(tokenized):  # populate the index
+                keys = nomemo.tokens_to_kv_block_keys(None, tp.tokens, MODEL)
+                if keys:
+                    indexer.kv_block_index.add(keys, keys, [pods[i % 4]])
+            if warm:  # one warming replay: store + memo learn the turns
+                for req in requests:
+                    indexer.get_pod_scores(req.prompt, MODEL, [])
+            report[arm] = _replay_leg(
+                lambda i, req: indexer.get_pod_scores(req.prompt, MODEL, []),
+                requests, passes=2 if quick else 3,
+            )
+        finally:
+            indexer.shutdown()
+    report["read_path_speedup_x"] = round(
+        report["read_path_cold"]["mean_us"]
+        / max(report["read_path_warm"]["mean_us"], 0.1), 2,
+    )
+    return report
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="CI smoke sizes")
+    ap.add_argument(
+        "--legs", choices=["all", "read"], default="all",
+        help="'read' runs only the read_path_replay legs (make bench-read)",
+    )
     args = ap.parse_args()
     iters = 30 if args.quick else 300
+
+    if args.legs == "read":
+        report = {"read_path_replay": read_path_replay(args.quick)}
+        print(json.dumps(report, indent=2))
+        return
 
     from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
     from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import InMemoryIndex
@@ -359,6 +534,9 @@ def main():
         )
     finally:
         indexer.shutdown()
+
+    # Incremental-derivation legs over a multi-turn ShareGPT-style replay.
+    report["read_path_replay"] = read_path_replay(args.quick)
 
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "MICRO_BENCH.json")

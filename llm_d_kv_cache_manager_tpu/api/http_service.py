@@ -34,6 +34,7 @@ from typing import Optional
 from aiohttp import web
 
 from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.chain_memo import ChainMemoConfig
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import IndexConfig
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
     TokenProcessorConfig,
@@ -63,6 +64,12 @@ def config_from_env() -> dict:
         # with vLLM --prefix-caching-hash-algo=sha256_cbor_64bit fleets).
         "hash_algo": os.environ.get("BLOCK_HASH_ALGO", "fnv64_cbor"),
         "block_size": int(os.environ.get("BLOCK_SIZE", "16")),
+        # Chain-state memo (incremental block-key derivation). CHAIN_MEMO=0
+        # pins the from-scratch path; keys are bit-identical either way.
+        "chain_memo": os.environ.get("CHAIN_MEMO", "1") == "1",
+        "chain_memo_capacity": int(
+            os.environ.get("CHAIN_MEMO_CAPACITY", "131072")
+        ),
         "http_port": int(os.environ.get("HTTP_PORT", "8080")),
         "hf_token": os.environ.get("HF_TOKEN"),
         "enable_hf": os.environ.get("ENABLE_HF_TOKENIZER", "") == "1",
@@ -109,6 +116,10 @@ class ScoringService:
                     block_size=env["block_size"],
                     hash_seed=env["hash_seed"],
                     hash_algo=env.get("hash_algo", "fnv64_cbor"),
+                    chain_memo=env.get("chain_memo", True),
+                    chain_memo_config=ChainMemoConfig(
+                        capacity=env.get("chain_memo_capacity", 131072),
+                    ),
                 ),
                 kv_block_index_config=index_config,
                 tokenizers_pool_config=TokenizersPoolConfig(
@@ -230,12 +241,16 @@ class ScoringService:
             "removals_lost": self.event_pool.removals_lost,
         }
         ready = bool(self._started and workers > 0 and sub_ready)
+        memo = self.indexer.token_processor.chain_memo
         return {
             "status": "ready" if ready else "unready",
             "started": self._started,
             "subscriber": sub_info,
             "event_pool": pool_info,
             "fleet": self.fleet_health.summary(),
+            # Read-path derivation cache effectiveness (observability only —
+            # never gates readiness: a cold memo is a correct memo).
+            "chain_memo": memo.stats() if memo is not None else None,
         }
 
     async def handle_readyz(self, request: web.Request) -> web.Response:

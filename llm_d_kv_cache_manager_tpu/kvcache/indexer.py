@@ -134,7 +134,9 @@ class Indexer:
             lora_id = None
 
         try:
-            tokens = self.tokenizers_pool.tokenize(render_request, prompt, model_name)
+            tokenized = self.tokenizers_pool.tokenize_ex(
+                render_request, prompt, model_name
+            )
         except PoolOverloadedError:
             # Degrade, don't fail: an empty score map routes the request by
             # the caller's fallback strategy, which beats queueing the read
@@ -145,8 +147,12 @@ class Indexer:
             )
             return {}
 
+        # The pool's prefix-store boundary state rides along so the chain
+        # memo can resume key derivation at the first novel block of a
+        # follow-up turn — same keys, none of the re-hashing.
         block_keys = self.token_processor.tokens_to_kv_block_keys(
-            None, tokens, model_name, lora_id=lora_id
+            None, tokenized.tokens, model_name, lora_id=lora_id,
+            prefix_state=tokenized.prefix_state,
         )
         if not block_keys:
             kvlog.trace(logger, "no block keys for prompt, returning empty scores")

@@ -12,8 +12,12 @@ The canonical CBOR subset implemented here covers the two payload shapes the
 scheme encodes — `[uint, [uint...], None]` (base) and
 `[uint, [uint...], [uint...]]` (with extra keys, e.g. a LoRA adapter id) —
 per RFC 8949 §4.2.1 (shortest-form integer encodings). A C fast path
-(native/) handles the common extra=None case when built; this file is the
-always-available pure-Python reference implementation for both shapes.
+(native/fnvcbor.c) batch-hashes both shapes in one Python↔C crossing with
+the GIL released when built; this file is the always-available pure-Python
+reference implementation, pinned against the C paths byte-for-byte by
+tests/test_hash_differential.py. This module is also the repo's single home
+for FNV: everything else (kvevents pod sharding, prefix-store state folds,
+chain-memo fingerprints) imports fnv32a/fnv64a/fold64 from here.
 """
 
 from __future__ import annotations
@@ -167,6 +171,49 @@ try:  # pragma: no cover - exercised only when the extension is built
 except ImportError:
     _native = None
 
+# A stale .so built before the batch API looks native but lacks the new
+# entry points; treat it as absent for the paths that need them.
+_native_batch = getattr(_native, "batch_prefix_hashes", None)
+_native_fps = getattr(_native, "token_fingerprints", None)
+
+
+def have_native() -> bool:
+    """True when the C hash core (with the batch API) is importable —
+    the `native` pytest marker and /readyz introspection key off this."""
+    return _native_batch is not None
+
+
+def fold64(h: int, v: int) -> int:
+    """One step of the 64-bit token fold used for chain-memo fingerprints:
+    FNV-1a's xor-multiply applied to a whole 64-bit value per step instead
+    of per byte. NOT the block-key hash — cache-key material only
+    (kvcache/kvblock/chain_memo.py), where accidental-collision resistance
+    is what matters, exactly like the prefix store's xxhash64 chunk keys."""
+    return ((h ^ (v & _MASK64)) * _FNV64_PRIME) & _MASK64
+
+
+def token_fingerprints(
+    fp0: int, tokens: Sequence[int], seg_tokens: int
+) -> List[int]:
+    """Chained fingerprints of `tokens` at every full `seg_tokens` boundary
+    (trailing partial segment dropped). The C extension and this pure-Python
+    loop are bit-identical (pinned by tests/test_hash_differential.py)."""
+    if seg_tokens <= 0:
+        raise ValueError("seg_tokens must be positive")
+    if _native_fps is not None:
+        try:
+            return list(_native_fps(fp0, tokens, seg_tokens))
+        except (TypeError, OverflowError):
+            pass  # exotic token types: fall through to the reference loop
+    n = (len(tokens) // seg_tokens) * seg_tokens
+    h = fp0
+    out: List[int] = []
+    for i in range(n):
+        h = ((h ^ (int(tokens[i]) & _MASK64)) * _FNV64_PRIME) & _MASK64
+        if (i + 1) % seg_tokens == 0:
+            out.append(h)
+    return out
+
 
 def prefix_hashes_fast(
     parent: int,
@@ -179,18 +226,28 @@ def prefix_hashes_fast(
 
     `algo` selects the chain hash: "fnv64_cbor" (reference parity, default)
     or "sha256_cbor_64bit" (vLLM `--prefix-caching-hash-algo` parity). The C
-    extension accelerates the common fnv64_cbor/extra=None path; pure Python
-    otherwise.
+    extension accelerates every fnv64_cbor shape (extra keys included) in a
+    single Python↔C crossing with the GIL released; pure Python otherwise.
     """
     n_full = len(tokens) // block_size
     if n_full == 0:
         return []
-    if algo == "fnv64_cbor" and _native is not None and extra is None:
-        # The C extension requires genuine Python ints; token ids often
-        # arrive as numpy/jax integer scalars from engine code.
-        return list(_native.prefix_hashes(
-            int(parent), [int(t) for t in tokens], block_size
-        ))
+    if algo == "fnv64_cbor":
+        if _native_batch is not None:
+            try:
+                return list(_native_batch(
+                    int(parent), tokens, block_size,
+                    None if extra is None else list(extra),
+                ))
+            except (TypeError, OverflowError):
+                # Tokens the C conversion rejects (e.g. floats, negatives):
+                # the pure-Python path defines the behavior.
+                pass
+        elif _native is not None and extra is None:
+            # Stale pre-batch extension: it requires genuine Python ints.
+            return list(_native.prefix_hashes(
+                int(parent), [int(t) for t in tokens], block_size
+            ))
     chunks = [tokens[i * block_size:(i + 1) * block_size] for i in range(n_full)]
     if algo == "fnv64_cbor":
         return prefix_hashes(parent, chunks, extra)

@@ -15,6 +15,11 @@ from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock import hashing
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.chain_memo import (
+    ChainMemo,
+    ChainMemoConfig,
+    PrefixState,
+)
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key
 
 DEFAULT_BLOCK_SIZE = 16  # vLLM default block size
@@ -36,6 +41,13 @@ class TokenProcessorConfig:
     # random NONE_HASH (os.urandom, all hash fns), so parity with it is
     # impossible and construction fails loudly instead of scoring zero.
     hash_algo: str = "fnv64_cbor"
+    # Chain-state memo (kvblock/chain_memo.py): incremental derivation —
+    # follow-up turns resume hashing at the first novel block instead of
+    # block 0, and the write plane derives each fleet-shared chain once.
+    # Produces bit-identical keys (it only moves WHERE hashing starts);
+    # disable to pin the from-scratch path.
+    chain_memo: bool = True
+    chain_memo_config: ChainMemoConfig = field(default_factory=ChainMemoConfig)
 
     @classmethod
     def default(cls) -> "TokenProcessorConfig":
@@ -57,6 +69,9 @@ class ChunkedTokenDatabase:
             raise ValueError(
                 f"unknown hash_algo: {self.config.hash_algo!r}"
             )
+        self.chain_memo: Optional[ChainMemo] = None
+        if self.config.chain_memo and self.config.chain_memo_config.enabled:
+            self.chain_memo = ChainMemo(self.config.chain_memo_config)
 
     @property
     def block_size(self) -> int:
@@ -72,6 +87,7 @@ class ChunkedTokenDatabase:
         tokens: Sequence[int],
         model_name: str,
         lora_id: Optional[int] = None,
+        prefix_state: Optional[PrefixState] = None,
     ) -> List[Key]:
         """Chain-hash full blocks of tokens into Keys; [] if no full block.
 
@@ -80,9 +96,19 @@ class ChunkedTokenDatabase:
         LoRA adapters occupy distinct index entries. The reference parses the
         event's LoraID but drops it (pool.go BlockStored handling; its LoRA
         parity test is a skipped TODO) — here it is first-class.
+
+        `prefix_state` is the tokenization pool's prefix-store boundary
+        fingerprint chain for THIS token list (pool.tokenize_ex). With the
+        chain memo enabled it makes warm multi-turn derivation O(boundaries)
+        instead of O(tokens); keys are bit-identical either way.
         """
         parent_hash = parent_key.chunk_hash if parent_key is not None else self._init_hash
         extra = None if lora_id is None else [int(lora_id)]
+        if self.chain_memo is not None:
+            return self.chain_memo.derive_keys(
+                model_name, parent_hash, tokens, self.config.block_size,
+                extra, self.config.hash_algo, prefix_state=prefix_state,
+            )
         hashes = hashing.prefix_hashes_fast(
             parent_hash, tokens, self.config.block_size, extra,
             algo=self.config.hash_algo,

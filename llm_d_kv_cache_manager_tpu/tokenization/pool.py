@@ -72,6 +72,22 @@ class TokenizersPoolConfig:
 
 
 @dataclass
+class TokenizedPrompt:
+    """A tokenization result plus its prefix-store boundary state.
+
+    `prefix_state` is the cumulative token-fingerprint chain of the covered
+    prefix-store chunks — ((fingerprint, n_tokens), ...) in prompt order,
+    () when the backing store doesn't support it (trie) or nothing was
+    covered. The chain-state memo (kvcache/kvblock/chain_memo.py) uses it to
+    resume block-key derivation at the first novel block; it is advisory
+    only and never changes the derived keys.
+    """
+
+    tokens: List[int]
+    prefix_state: tuple = ()
+
+
+@dataclass
 class _Task:
     render_request: Optional[object]
     prompt: str
@@ -178,6 +194,16 @@ class TokenizationPool:
         Raises PoolOverloadedError when no queue slot frees up within
         `enqueue_timeout_s`.
         """
+        return list(
+            self.tokenize_ex(render_request, prompt, model_name, timeout).tokens
+        )
+
+    def tokenize_ex(
+        self, render_request, prompt: str, model_name: str, timeout: Optional[float] = None
+    ) -> TokenizedPrompt:
+        """Blocking tokenization returning the prefix state alongside the
+        tokens (the Indexer's read path — see TokenizedPrompt). Same
+        overload semantics as `tokenize`."""
         if not self._started:
             self.run()
         fut: Future = Future()
@@ -211,9 +237,9 @@ class TokenizationPool:
             try:
                 if task is None:
                     return
-                tokens = self._process(task)
+                result = self._process(task)
                 if task.future is not None:
-                    task.future.set_result(tokens)
+                    task.future.set_result(result)
             except Exception as e:  # noqa: BLE001 - deliver errors to waiter
                 if task is not None and task.future is not None:
                     task.future.set_exception(e)
@@ -222,20 +248,31 @@ class TokenizationPool:
             finally:
                 self._queue.task_done()
 
-    def _process(self, task: _Task) -> List[int]:
+    def _process(self, task: _Task) -> TokenizedPrompt:
         prompt = task.prompt
         if task.render_request is not None:
             t0 = time.perf_counter()
             prompt = self.tokenizer.render_chat_template(task.render_request)
             metrics.observe_render(time.perf_counter() - t0)
 
-        tokens, ratio = self.prefix_store.find_longest_contained_tokens(prompt)
+        # Prefix-store shortcut, with boundary state when the store supports
+        # it (LRU store). The trie store only speaks the base contract.
+        find_with_state = getattr(
+            self.prefix_store, "find_longest_with_state", None
+        )
+        if find_with_state is not None:
+            tokens, ratio, state = find_with_state(prompt)
+        else:
+            tokens, ratio = self.prefix_store.find_longest_contained_tokens(prompt)
+            state = ()
         if ratio < self.config.min_prefix_overlap_ratio:
             t0 = time.perf_counter()
             result = self.tokenizer.encode(prompt, task.model_name)
             metrics.observe_tokenization(
                 time.perf_counter() - t0, len(result.tokens)
             )
-            self.prefix_store.add_tokenization(prompt, result.tokens, result.offsets)
-            tokens = result.tokens
-        return list(tokens)
+            state = self.prefix_store.add_tokenization(
+                prompt, result.tokens, result.offsets
+            ) or ()
+            tokens = list(result.tokens)
+        return TokenizedPrompt(tokens=tokens, prefix_state=tuple(state))

@@ -8,17 +8,30 @@ with prev_hash chained from 0; the value is the list of tokens whose [_, high)
 byte offset ends inside that chunk. Lookup re-derives the chain and early-stops
 at the first missing chunk, returning accumulated tokens and the byte-coverage
 ratio.
+
+Beyond the reference: each cached chunk also carries a 64-bit fingerprint of
+its token list (xxhash64 over the packed token values, computed once at add
+time), and both the add and lookup paths fold those into a cumulative
+`prefix_state` — `((fingerprint, cumulative_token_count), ...)` per covered
+chunk boundary. The chain-state memo (kvcache/kvblock/chain_memo.py) keys
+memoized block-hash chains off this state, so a warm multi-turn read path
+resumes key derivation at the first novel block without touching a single
+token. The fingerprint chain is a pure function of the exact token lists this
+store returns: re-tokenized or relearned chunks change it, so stale chain
+states can never be served — they just miss.
 """
 
 from __future__ import annotations
 
 import struct
 import threading
+from array import array
 from dataclasses import dataclass
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import xxhash
 
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.hashing import fold64
 from llm_d_kv_cache_manager_tpu.tokenization.prefixstore.indexer import (
     Offset,
     PrefixStore,
@@ -27,6 +40,11 @@ from llm_d_kv_cache_manager_tpu.utils.lru import LRUCache
 
 DEFAULT_BLOCK_SIZE = 256  # bytes of prompt text per chunk
 DEFAULT_MAX_CACHE_SIZE = 500_000
+
+# Basis of the cumulative token-fingerprint fold (arbitrary non-zero odd
+# constant, distinct from the FNV offset so text-chunk fp chains and block
+# hash chains can never land in each other's keyspace).
+_STATE_BASIS = 0xA076_1D64_78BD_642F
 
 _pack_u64 = struct.Struct("<Q").pack
 
@@ -41,19 +59,37 @@ def _chunk_hash(prev_hash: int, chunk: bytes) -> int:
     return xxhash.xxh64(_pack_u64(prev_hash) + chunk).intdigest()
 
 
+def _token_fp(tokens: Sequence[int]) -> Optional[int]:
+    """xxhash64 of the packed token values; None when the tokens don't fit
+    u64 packing (exotic ids) — state accumulation stops there."""
+    try:
+        return xxhash.xxh64(array("Q", tokens).tobytes()).intdigest()
+    except (OverflowError, TypeError, ValueError):
+        return None
+
+
 class LRUTokenStore(PrefixStore):
     def __init__(self, config: LRUStoreConfig | None = None):
         cfg = config or LRUStoreConfig()
         self.block_size = cfg.block_size
-        self._cache: LRUCache[int, List[int]] = LRUCache(cfg.cache_size)
+        # chunk text hash → (tokens ending in the chunk, token fingerprint)
+        self._cache: LRUCache[int, Tuple[List[int], Optional[int]]] = LRUCache(
+            cfg.cache_size
+        )
         self._mu = threading.Lock()
 
     def add_tokenization(
         self, prompt: str, tokens: Sequence[int], offsets: Sequence[Offset]
-    ) -> None:
+    ) -> Tuple[Tuple[int, int], ...]:
+        """Cache the tokenization chunk by chunk; returns the resulting
+        prefix state for the complete-chunk-covered prefix (see module
+        docstring) — callers that predate the chain memo can ignore it."""
         if not prompt or not tokens:
-            return
+            return ()
         prompt_bytes = prompt.encode("utf-8")
+        state: List[Tuple[int, int]] = []
+        state_fp = _STATE_BASIS
+        state_ok = True
         with self._mu:
             token_idx = 0
             prev_hash = 0
@@ -69,21 +105,46 @@ class LRUTokenStore(PrefixStore):
                     block_tokens.append(tokens[token_idx])
                     token_idx += 1
 
-                self._cache.add(block_hash, block_tokens)
+                tok_fp = _token_fp(block_tokens)
+                self._cache.add(block_hash, (block_tokens, tok_fp))
+                if state_ok and tok_fp is not None:
+                    state_fp = fold64(state_fp, tok_fp)
+                    state.append((state_fp, token_idx))
+                else:
+                    state_ok = False  # unfingerprintable chunk breaks the chain
+        return tuple(state)
 
     def find_longest_contained_tokens(self, prompt: str) -> Tuple[List[int], float]:
+        tokens, ratio, _ = self.find_longest_with_state(prompt)
+        return tokens, ratio
+
+    def find_longest_with_state(
+        self, prompt: str
+    ) -> Tuple[List[int], float, Tuple[Tuple[int, int], ...]]:
+        """Like find_longest_contained_tokens, plus the prefix state of the
+        covered chunks — the cumulative token-fingerprint chain the chain
+        memo keys block-hash chains off."""
         contained: List[int] = []
         prompt_bytes = prompt.encode("utf-8")
         prev_hash = 0
         overlap_ratio = 0.0
+        state: List[Tuple[int, int]] = []
+        state_fp = _STATE_BASIS
+        state_ok = True
         for start in range(0, len(prompt_bytes) - self.block_size + 1, self.block_size):
             end = start + self.block_size
             block_hash = _chunk_hash(prev_hash, prompt_bytes[start:end])
             prev_hash = block_hash
 
-            block_tokens = self._cache.get(block_hash)
-            if block_tokens is None:
+            entry = self._cache.get(block_hash)
+            if entry is None:
                 break  # early stop: prefix chain broke
+            block_tokens, tok_fp = entry
             contained.extend(block_tokens)
             overlap_ratio = end / len(prompt_bytes)
-        return contained, overlap_ratio
+            if state_ok and tok_fp is not None:
+                state_fp = fold64(state_fp, tok_fp)
+                state.append((state_fp, len(contained)))
+            else:
+                state_ok = False
+        return contained, overlap_ratio, tuple(state)

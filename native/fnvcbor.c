@@ -1,12 +1,25 @@
 /* Native hash core for the TPU KV-cache manager.
  *
  * Implements the chained block-key derivation --
- * FNV-64a(canonical_CBOR([parent_u64, [token_u32...], null])) -- as a CPython
- * extension. This is the read path's hot loop (every GetPodScores call hashes
- * prompt_len / block_size chunks) and the write plane's request-key
- * recomputation. Semantically identical to the pure-Python implementation in
- * llm_d_kv_cache_manager_tpu/kvcache/kvblock/hashing.py (the test oracle);
- * ~100x faster on long prompts.
+ * FNV-64a(canonical_CBOR([parent_u64, [token_u32...], extra|null])) -- as a
+ * CPython extension. This is the read path's hot loop (every GetPodScores
+ * call hashes prompt_len / block_size chunks) and the write plane's
+ * request-key recomputation. Semantically identical to the pure-Python
+ * implementation in llm_d_kv_cache_manager_tpu/kvcache/kvblock/hashing.py
+ * (the test oracle); ~100x faster on long prompts.
+ *
+ * Three generations of entry point:
+ *   prefix_hashes        legacy: extra=None only, pre-converted int tokens
+ *   batch_prefix_hashes  one crossing per request: extra-key (LoRA) chains,
+ *                        __index__-tolerant token conversion (numpy/jax
+ *                        scalars accepted directly -- no [int(t) ...] copy
+ *                        on the Python side), GIL released while hashing so
+ *                        read-path derivation overlaps kvevents digestion
+ *   chunk_hash           single-block link (differential-fuzz target)
+ *   token_fingerprints   chain-memo support: per-token 64-bit fold with a
+ *                        fingerprint emitted at each segment boundary; GIL
+ *                        released. NOT the block-key hash -- cache keys for
+ *                        kvcache/kvblock/chain_memo.py only.
  *
  * The reference gets the equivalent speed from Go + a Rust tokenizer core;
  * this build keeps Python as the control-plane language and drops to C for
@@ -131,6 +144,230 @@ static PyObject *prefix_hashes(PyObject *self, PyObject *args) {
     return result;
 }
 
+/* Token -> uint64, accepting anything with __index__ (plain ints, numpy and
+ * jax integer scalars) so callers never pay a Python-side [int(t) ...] copy.
+ * Returns -1 with an exception set on failure. */
+static int as_u64(PyObject *o, uint64_t *out) {
+    unsigned long long v = PyLong_AsUnsignedLongLong(o);
+    if (v == (unsigned long long)-1 && PyErr_Occurred()) {
+        if (!PyErr_ExceptionMatches(PyExc_TypeError)) return -1;
+        PyErr_Clear();
+        PyObject *ix = PyNumber_Index(o);
+        if (!ix) return -1;
+        v = PyLong_AsUnsignedLongLong(ix);
+        Py_DECREF(ix);
+        if (v == (unsigned long long)-1 && PyErr_Occurred()) return -1;
+    }
+    *out = v;
+    return 0;
+}
+
+/* Convert a Python sequence of token-likes into a fresh uint64_t array.
+ * On success *out_n holds the element count; caller PyMem_Free()s. */
+static uint64_t *tokens_to_array(PyObject *tokens_obj, Py_ssize_t *out_n) {
+    PyObject *seq = PySequence_Fast(tokens_obj, "tokens must be a sequence");
+    if (!seq) return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    uint64_t *arr = (uint64_t *)PyMem_Malloc(n ? n * sizeof(uint64_t) : 1);
+    if (!arr) {
+        Py_DECREF(seq);
+        PyErr_NoMemory();
+        return NULL;
+    }
+    PyObject **items = PySequence_Fast_ITEMS(seq);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        if (as_u64(items[i], &arr[i]) < 0) {
+            PyMem_Free(arr);
+            Py_DECREF(seq);
+            return NULL;
+        }
+    }
+    Py_DECREF(seq);
+    *out_n = n;
+    return arr;
+}
+
+/* Optional extra-key tuple (e.g. [lora_id]): NULL-able uint64 array. */
+static int extra_to_array(PyObject *extra_obj, uint64_t **out, Py_ssize_t *out_n) {
+    if (extra_obj == NULL || extra_obj == Py_None) {
+        *out = NULL;
+        *out_n = 0;
+        return 0;
+    }
+    *out = tokens_to_array(extra_obj, out_n);
+    return *out ? 0 : -1;
+}
+
+/* One chain link over a pre-converted block: FNV-64a of the canonical CBOR
+ * [parent, [tokens...], extra|null]. `buf` must hold the worst case. */
+static uint64_t hash_block(uint8_t *buf, uint64_t parent,
+                           const uint64_t *toks, Py_ssize_t n_toks,
+                           const uint64_t *extra, Py_ssize_t n_extra) {
+    size_t pos = 0;
+    buf[pos++] = 0x83; /* array(3) */
+    pos += cbor_head(buf + pos, 0, parent);
+    pos += cbor_head(buf + pos, 4, (uint64_t)n_toks);
+    for (Py_ssize_t i = 0; i < n_toks; i++)
+        pos += cbor_head(buf + pos, 0, toks[i]);
+    if (extra == NULL) {
+        buf[pos++] = 0xf6; /* null */
+    } else {
+        pos += cbor_head(buf + pos, 4, (uint64_t)n_extra);
+        for (Py_ssize_t i = 0; i < n_extra; i++)
+            pos += cbor_head(buf + pos, 0, extra[i]);
+    }
+    return fnv1a64(buf, pos, FNV64_OFFSET);
+}
+
+/* batch_prefix_hashes(parent, tokens, block_size, extra=None) -> list[int]
+ * Whole-request derivation in one crossing: chunk into full blocks, chain
+ * the CBOR+FNV-64a links (extra keys mixed into every block when given),
+ * GIL dropped for the hash loop. */
+static PyObject *batch_prefix_hashes(PyObject *self, PyObject *args) {
+    unsigned long long parent;
+    PyObject *tokens_obj;
+    PyObject *extra_obj = Py_None;
+    Py_ssize_t block_size;
+    if (!PyArg_ParseTuple(args, "KOn|O", &parent, &tokens_obj, &block_size,
+                          &extra_obj))
+        return NULL;
+    if (block_size <= 0) {
+        PyErr_SetString(PyExc_ValueError, "block_size must be positive");
+        return NULL;
+    }
+
+    Py_ssize_t n_tokens = 0, n_extra = 0;
+    uint64_t *toks = tokens_to_array(tokens_obj, &n_tokens);
+    if (!toks) return NULL;
+    uint64_t *extra = NULL;
+    if (extra_to_array(extra_obj, &extra, &n_extra) < 0) {
+        PyMem_Free(toks);
+        return NULL;
+    }
+
+    Py_ssize_t n_blocks = n_tokens / block_size;
+    size_t buf_cap = 20 + 9 * (size_t)block_size + 9 * (size_t)(n_extra + 1);
+    uint8_t *buf = (uint8_t *)PyMem_Malloc(buf_cap);
+    uint64_t *out = (uint64_t *)PyMem_Malloc(
+        n_blocks ? n_blocks * sizeof(uint64_t) : 1);
+    if (!buf || !out) {
+        PyMem_Free(toks);
+        PyMem_Free(extra);
+        PyMem_Free(buf);
+        PyMem_Free(out);
+        return PyErr_NoMemory();
+    }
+
+    uint64_t h = (uint64_t)parent;
+    Py_BEGIN_ALLOW_THREADS
+    for (Py_ssize_t b = 0; b < n_blocks; b++) {
+        h = hash_block(buf, h, toks + b * block_size, block_size,
+                       extra, n_extra);
+        out[b] = h;
+    }
+    Py_END_ALLOW_THREADS
+
+    PyObject *result = PyList_New(n_blocks);
+    if (result) {
+        for (Py_ssize_t b = 0; b < n_blocks; b++) {
+            PyObject *val = PyLong_FromUnsignedLongLong(out[b]);
+            if (!val) {
+                Py_CLEAR(result);
+                break;
+            }
+            PyList_SET_ITEM(result, b, val);
+        }
+    }
+    PyMem_Free(toks);
+    PyMem_Free(extra);
+    PyMem_Free(buf);
+    PyMem_Free(out);
+    return result;
+}
+
+/* chunk_hash(parent, tokens, extra=None) -> int
+ * Single chain link over the WHOLE token sequence (no chunking) -- the
+ * native twin of hashing.chunk_hash and the differential-fuzz anchor for
+ * the batch path. */
+static PyObject *chunk_hash_py(PyObject *self, PyObject *args) {
+    unsigned long long parent;
+    PyObject *tokens_obj;
+    PyObject *extra_obj = Py_None;
+    if (!PyArg_ParseTuple(args, "KO|O", &parent, &tokens_obj, &extra_obj))
+        return NULL;
+    Py_ssize_t n_tokens = 0, n_extra = 0;
+    uint64_t *toks = tokens_to_array(tokens_obj, &n_tokens);
+    if (!toks) return NULL;
+    uint64_t *extra = NULL;
+    if (extra_to_array(extra_obj, &extra, &n_extra) < 0) {
+        PyMem_Free(toks);
+        return NULL;
+    }
+    size_t buf_cap = 20 + 9 * (size_t)n_tokens + 9 * (size_t)(n_extra + 1);
+    uint8_t *buf = (uint8_t *)PyMem_Malloc(buf_cap);
+    if (!buf) {
+        PyMem_Free(toks);
+        PyMem_Free(extra);
+        return PyErr_NoMemory();
+    }
+    uint64_t h = hash_block(buf, (uint64_t)parent, toks, n_tokens,
+                            extra, n_extra);
+    PyMem_Free(toks);
+    PyMem_Free(extra);
+    PyMem_Free(buf);
+    return PyLong_FromUnsignedLongLong(h);
+}
+
+/* token_fingerprints(fp0, tokens, seg_tokens) -> list[int]
+ * Chain-memo fingerprints: fold fp = (fp ^ token) * FNV64_PRIME per token,
+ * emitting the running fingerprint after every full segment of `seg_tokens`
+ * tokens (trailing partial segment dropped). MUST stay bit-identical to
+ * hashing.token_fingerprints (the pure-Python reference). */
+static PyObject *token_fingerprints(PyObject *self, PyObject *args) {
+    unsigned long long fp0;
+    PyObject *tokens_obj;
+    Py_ssize_t seg_tokens;
+    if (!PyArg_ParseTuple(args, "KOn", &fp0, &tokens_obj, &seg_tokens))
+        return NULL;
+    if (seg_tokens <= 0) {
+        PyErr_SetString(PyExc_ValueError, "seg_tokens must be positive");
+        return NULL;
+    }
+    Py_ssize_t n_tokens = 0;
+    uint64_t *toks = tokens_to_array(tokens_obj, &n_tokens);
+    if (!toks) return NULL;
+    Py_ssize_t n_segs = n_tokens / seg_tokens;
+    uint64_t *out = (uint64_t *)PyMem_Malloc(
+        n_segs ? n_segs * sizeof(uint64_t) : 1);
+    if (!out) {
+        PyMem_Free(toks);
+        return PyErr_NoMemory();
+    }
+    uint64_t h = (uint64_t)fp0;
+    Py_BEGIN_ALLOW_THREADS
+    for (Py_ssize_t s = 0; s < n_segs; s++) {
+        const uint64_t *seg = toks + s * seg_tokens;
+        for (Py_ssize_t i = 0; i < seg_tokens; i++)
+            h = (h ^ seg[i]) * FNV64_PRIME;
+        out[s] = h;
+    }
+    Py_END_ALLOW_THREADS
+    PyObject *result = PyList_New(n_segs);
+    if (result) {
+        for (Py_ssize_t s = 0; s < n_segs; s++) {
+            PyObject *val = PyLong_FromUnsignedLongLong(out[s]);
+            if (!val) {
+                Py_CLEAR(result);
+                break;
+            }
+            PyList_SET_ITEM(result, s, val);
+        }
+    }
+    PyMem_Free(toks);
+    PyMem_Free(out);
+    return result;
+}
+
 /* fnv64a(data: bytes, h: int = offset) -> int */
 static PyObject *fnv64a_py(PyObject *self, PyObject *args) {
     Py_buffer view;
@@ -143,7 +380,15 @@ static PyObject *fnv64a_py(PyObject *self, PyObject *args) {
 
 static PyMethodDef methods[] = {
     {"prefix_hashes", prefix_hashes, METH_VARARGS,
-     "Chained CBOR+FNV-64a block hashes over full token blocks."},
+     "Chained CBOR+FNV-64a block hashes over full token blocks (legacy: "
+     "extra=None, pre-converted int tokens)."},
+    {"batch_prefix_hashes", batch_prefix_hashes, METH_VARARGS,
+     "Whole-request chained CBOR+FNV-64a block hashes in one crossing: "
+     "extra-key (LoRA) support, __index__ token conversion, GIL released."},
+    {"chunk_hash", chunk_hash_py, METH_VARARGS,
+     "Single CBOR+FNV-64a chain link over the whole token sequence."},
+    {"token_fingerprints", token_fingerprints, METH_VARARGS,
+     "Chain-memo segment fingerprints: per-token 64-bit FNV fold."},
     {"fnv64a", fnv64a_py, METH_VARARGS, "FNV-64a of a bytes-like object."},
     {NULL, NULL, 0, NULL},
 };

@@ -25,6 +25,23 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+
+def pytest_collection_modifyitems(config, items):
+    """Skip `native`-marked tests with a visible reason when the C hash
+    extension isn't built, instead of erroring or silently passing."""
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock import hashing
+
+    if hashing.have_native():
+        return
+    skip = pytest.mark.skip(
+        reason="native C extension (_kvtpu_native with batch API) not built "
+        "— run `make native` or `pip install -e native/`"
+    )
+    for item in items:
+        if "native" in item.keywords:
+            item.add_marker(skip)
+
+
 FIXTURES_DIR = os.path.join(os.path.dirname(__file__), "fixtures")
 TEST_MODEL_NAME = "test-model"
 TEST_TOKENIZER_JSON = os.path.join(FIXTURES_DIR, "test-model", "tokenizer.json")

@@ -1,0 +1,309 @@
+"""Property tests for the chain-state memo (kvblock/chain_memo.py).
+
+The memo's contract is absolute: derivation through it is bit-identical to
+from-scratch derivation (hashing.prefix_hashes_fast) for ANY sequence of
+calls — extensions, truncations, divergent branches, block-straddling
+edits, interleaved identities — and eviction only ever costs cold
+recomputation, never wrong keys. Both hash algorithms and LoRA extra-key
+chains are covered (extra keys change every block hash, so memo entries
+must be keyed by the extra tuple too).
+"""
+
+import random
+import threading
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import hashing
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.chain_memo import (
+    ChainMemo,
+    ChainMemoConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPool,
+    TokenizersPoolConfig,
+)
+
+ALGOS = ["fnv64_cbor", "sha256_cbor_64bit"]
+EXTRAS = [None, [7], [3, 5]]
+
+
+def _truth(parent, tokens, bs, extra, algo):
+    return hashing.prefix_hashes_fast(parent, tokens, bs, extra, algo=algo)
+
+
+def _derive(memo, parent, tokens, bs, extra, algo, prefix_state=None):
+    """Hash chain through the memo's Key-space API (model fixed)."""
+    keys = memo.derive_keys(
+        "m", parent, tokens, bs, extra, algo, prefix_state=prefix_state
+    )
+    assert all(k.model_name == "m" for k in keys)
+    return [k.chunk_hash for k in keys]
+
+
+def _mutate(rng, tokens, bs):
+    """One randomized multi-turn-style edit of a token stream."""
+    kind = rng.randrange(5)
+    out = list(tokens)
+    if kind == 0:  # append a turn (any length, straddles block boundaries)
+        out += [rng.randrange(2**17) for _ in range(rng.randrange(1, 3 * bs))]
+    elif kind == 1:  # truncate anywhere (mid-block included)
+        out = out[: rng.randrange(len(out) + 1)]
+    elif kind == 2 and out:  # divergent branch mid-stream
+        cut = rng.randrange(len(out))
+        out = out[:cut] + [rng.randrange(2**17) for _ in range(rng.randrange(1, 2 * bs))]
+    elif kind == 3 and out:  # point edit inside an existing block
+        out[rng.randrange(len(out))] ^= 1
+    else:  # block-boundary-straddling splice
+        at = (rng.randrange(max(len(out) // bs, 1)) * bs) or bs
+        at = min(at, len(out))
+        out = out[: max(at - rng.randrange(bs), 0)] + out[at:]
+    return out
+
+
+class TestSegmentMemoProperties:
+    @pytest.mark.parametrize("algo", ALGOS)
+    @pytest.mark.parametrize("extra", EXTRAS, ids=["base", "lora", "lora2"])
+    def test_randomized_edit_walk_bit_identical(self, algo, extra):
+        rng = random.Random(hash((algo, str(extra))) & 0xFFFF)
+        bs = 16
+        memo = ChainMemo(ChainMemoConfig(capacity=4096, segment_blocks=4))
+        seed = "42"
+        root = (
+            hashing.init_hash(seed) if algo == "fnv64_cbor"
+            else hashing.sha256_cbor_init_hash(seed)
+        )
+        tokens = [rng.randrange(2**17) for _ in range(rng.randrange(2, 200))]
+        for _ in range(60):
+            got = _derive(memo, root, tokens, bs, extra, algo)
+            assert got == _truth(root, tokens, bs, extra, algo)
+            tokens = _mutate(rng, tokens, bs)
+
+    def test_identities_never_alias(self):
+        """Same tokens under different (algo, extra, parent, block_size)
+        must produce each identity's own from-scratch chain even when all
+        of them share one memo."""
+        rng = random.Random(5)
+        memo = ChainMemo(ChainMemoConfig(capacity=4096, segment_blocks=2))
+        tokens = [rng.randrange(2**17) for _ in range(128)]
+        idents = [
+            (algo, extra, parent, bs)
+            for algo in ALGOS
+            for extra in EXTRAS
+            for parent in (hashing.init_hash(""), hashing.init_hash("42"))
+            for bs in (8, 16)
+        ]
+        for _ in range(3):  # repeat: later rounds hit what earlier seeded
+            for algo, extra, parent, bs in idents:
+                assert _derive(memo, parent, tokens, bs, extra, algo) == _truth(
+                    parent, tokens, bs, extra, algo
+                )
+
+    def test_eviction_only_ever_recomputes(self):
+        rng = random.Random(9)
+        # Capacity 2: nearly everything is evicted between calls.
+        memo = ChainMemo(ChainMemoConfig(capacity=2, segment_blocks=2))
+        root = hashing.init_hash("")
+        streams = [
+            [rng.randrange(2**17) for _ in range(rng.randrange(1, 150))]
+            for _ in range(12)
+        ]
+        for _ in range(40):
+            s = rng.choice(streams)
+            assert _derive(memo, root, s, 16, None, "fnv64_cbor") == _truth(
+                root, s, 16, None, "fnv64_cbor"
+            )
+
+    def test_parent_chain_continuation(self):
+        """Write-plane shape: event chains that continue a parent key."""
+        rng = random.Random(21)
+        memo = ChainMemo(ChainMemoConfig(capacity=1024, segment_blocks=1))
+        root = hashing.init_hash("42")
+        tokens = [rng.randrange(2**17) for _ in range(96)]
+        full = _derive(memo, root, tokens, 16, None, "fnv64_cbor")
+        head = _derive(memo, root, tokens[:32], 16, None, "fnv64_cbor")
+        cont = _derive(memo, head[-1], tokens[32:], 16, None, "fnv64_cbor")
+        assert head + cont == full == _truth(root, tokens, 16, None, "fnv64_cbor")
+
+    def test_concurrent_derivations_stay_correct(self):
+        rng = random.Random(13)
+        memo = ChainMemo(ChainMemoConfig(capacity=256, segment_blocks=2))
+        root = hashing.init_hash("")
+        streams = [
+            [rng.randrange(2**17) for _ in range(rng.randrange(16, 200))]
+            for _ in range(8)
+        ]
+        truths = [_truth(root, s, 16, None, "fnv64_cbor") for s in streams]
+        errors = []
+
+        def worker(seed):
+            r = random.Random(seed)
+            for _ in range(30):
+                i = r.randrange(len(streams))
+                if _derive(memo, root, streams[i], 16, None, "fnv64_cbor") != truths[i]:
+                    errors.append(i)
+
+        threads = [threading.Thread(target=worker, args=(t,)) for t in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+
+
+class TestBoundaryStateProperties:
+    def _state_for(self, tokens, every):
+        """A well-formed prefix state: boundaries every `every` tokens,
+        fingerprints a pure function of the exact token prefix (the
+        invariant the prefix store's chain provides)."""
+        fp = 0xABCDEF
+        out = []
+        for i, t in enumerate(tokens):
+            fp = hashing.fold64(fp, t)
+            if (i + 1) % every == 0:
+                out.append((fp, i + 1))
+        return tuple(out)
+
+    @pytest.mark.parametrize("algo", ALGOS)
+    @pytest.mark.parametrize("extra", EXTRAS, ids=["base", "lora", "lora2"])
+    def test_boundary_path_bit_identical(self, algo, extra):
+        rng = random.Random(4)
+        memo = ChainMemo(ChainMemoConfig(capacity=4096))
+        root = (
+            hashing.init_hash("42") if algo == "fnv64_cbor"
+            else hashing.sha256_cbor_init_hash("42")
+        )
+        tokens = [rng.randrange(2**17) for _ in range(23 * 9)]
+        state = self._state_for(tokens, 23)  # boundaries unaligned to blocks
+        for trim in (len(state), 5, 2, 0):  # progressively colder states
+            got = _derive(memo, 
+                root, tokens, 16, extra, algo, prefix_state=state[:trim]
+            )
+            assert got == _truth(root, tokens, 16, extra, algo)
+
+    def test_shared_prefix_across_extended_state(self):
+        rng = random.Random(8)
+        memo = ChainMemo(ChainMemoConfig(capacity=4096))
+        root = hashing.init_hash("")
+        tokens = [rng.randrange(2**17) for _ in range(100)]
+        state = self._state_for(tokens, 20)
+        assert _derive(memo, root, tokens, 16, None, "fnv64_cbor", prefix_state=state) \
+            == _truth(root, tokens, 16, None, "fnv64_cbor")
+        # A follow-up turn: longer tokens, state extends the same chain.
+        ext = tokens + [rng.randrange(2**17) for _ in range(60)]
+        ext_state = self._state_for(ext, 20)
+        assert ext_state[: len(state)] == state  # genuine shared prefix
+        assert _derive(memo, root, ext, 16, None, "fnv64_cbor", prefix_state=ext_state) \
+            == _truth(root, ext, 16, None, "fnv64_cbor")
+        stats = memo.stats()
+        assert stats["hits"] >= 1 and stats["blocks_reused"] > 0
+
+    def test_boundary_eviction_recomputes(self):
+        rng = random.Random(17)
+        memo = ChainMemo(ChainMemoConfig(capacity=2))
+        root = hashing.init_hash("")
+        for _ in range(20):
+            tokens = [rng.randrange(2**17) for _ in range(rng.randrange(20, 120))]
+            state = self._state_for(tokens, 15)
+            assert _derive(memo, root, tokens, 16, None, "fnv64_cbor", prefix_state=state) \
+                == _truth(root, tokens, 16, None, "fnv64_cbor")
+
+
+class TestEndToEndThroughPool:
+    """The shipped composition: prefix store boundary states flowing from
+    TokenizationPool.tokenize_ex into ChunkedTokenDatabase — keys must be
+    bit-identical to a memo-less processor on the same returned tokens,
+    across multi-turn extensions, divergent branches and store eviction."""
+
+    FIXTURE = "tests/fixtures/test-model/tokenizer.json"
+    MODEL = "test-model"
+
+    def _pool(self):
+        return TokenizationPool(
+            TokenizersPoolConfig(
+                workers=1, local_tokenizer_files={self.MODEL: self.FIXTURE}
+            )
+        )
+
+    def _run_prompts(self, prompts, lora_id=None):
+        pool = self._pool()
+        memo_db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=16))
+        plain_db = ChunkedTokenDatabase(
+            TokenProcessorConfig(block_size=16, chain_memo=False)
+        )
+        try:
+            for prompt in prompts:
+                tp = pool.tokenize_ex(None, prompt, self.MODEL)
+                got = memo_db.tokens_to_kv_block_keys(
+                    None, tp.tokens, self.MODEL, lora_id=lora_id,
+                    prefix_state=tp.prefix_state,
+                )
+                want = plain_db.tokens_to_kv_block_keys(
+                    None, tp.tokens, self.MODEL, lora_id=lora_id
+                )
+                assert got == want, prompt[:60]
+            return memo_db
+        finally:
+            pool.shutdown()
+
+    def test_multi_turn_extension(self):
+        base = "a conversation about kv cache routing " * 30
+        prompts = [base]
+        for turn in range(5):
+            base = base + f" [turn {turn}] " + "more words every turn " * 12
+            prompts.append(base)
+        db = self._run_prompts(prompts)
+        assert db.chain_memo.stats()["hits"] >= 1
+
+    def test_divergent_branch_and_lora(self):
+        base = "shared system prompt for every branch " * 25
+        prompts = [
+            base + " branch one goes this way " * 10,
+            base + " branch two goes another way " * 10,
+            base,  # truncation back to the shared prefix
+        ]
+        self._run_prompts(prompts)
+        self._run_prompts(prompts, lora_id=7)
+
+    def test_store_relearn_never_serves_stale_keys(self):
+        rng = random.Random(2)
+        pool = self._pool()
+        memo_db = ChunkedTokenDatabase(TokenProcessorConfig(block_size=16))
+        plain_db = ChunkedTokenDatabase(
+            TokenProcessorConfig(block_size=16, chain_memo=False)
+        )
+        words = ["alpha", "beta", "gamma", "delta", "routing", "cache"]
+        try:
+            for _ in range(12):
+                prompt = " ".join(
+                    rng.choice(words) for _ in range(rng.randrange(60, 400))
+                )
+                tp = pool.tokenize_ex(None, prompt, self.MODEL)
+                got = memo_db.tokens_to_kv_block_keys(
+                    None, tp.tokens, self.MODEL, prefix_state=tp.prefix_state
+                )
+                assert got == plain_db.tokens_to_kv_block_keys(
+                    None, tp.tokens, self.MODEL
+                )
+        finally:
+            pool.shutdown()
+
+
+class TestConfigValidation:
+    def test_bad_capacity_rejected(self):
+        with pytest.raises(ValueError):
+            ChainMemo(ChainMemoConfig(capacity=0))
+
+    def test_bad_segment_blocks_rejected(self):
+        with pytest.raises(ValueError):
+            ChainMemo(ChainMemoConfig(segment_blocks=0))
+
+    def test_memo_disabled_via_processor_config(self):
+        db = ChunkedTokenDatabase(TokenProcessorConfig(chain_memo=False))
+        assert db.chain_memo is None
+        keys = db.tokens_to_kv_block_keys(None, list(range(32)), "m")
+        assert len(keys) == 2

@@ -1,0 +1,170 @@
+"""Differential fuzz for the hash core: C batch ≡ C single-block ≡ Python.
+
+The chained block-key scheme has four implementations that must agree
+byte-for-byte on every key in the chain:
+
+  1. pure-Python chunk-by-chunk (hashing.chunk_hash / prefix_hashes) — the
+     always-available reference,
+  2. the C single-block link (_kvtpu_native.chunk_hash),
+  3. the C batch path (_kvtpu_native.batch_prefix_hashes) — the shipped
+     read-path fast lane (one crossing per request, GIL released),
+  4. the dispatching wrapper (hashing.prefix_hashes_fast) under both
+     hash algorithms.
+
+Any drift between them silently breaks engine hash parity (scores become
+0 against a real fleet), so this fuzz is a tier-1 keystone. The C legs
+skip with a visible reason when the extension isn't built (`native`
+marker); the pure-Python cross-checks always run.
+"""
+
+import random
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import hashing
+
+# Token values straddling every canonical-CBOR integer width boundary.
+CBOR_EDGES = [
+    0, 1, 23, 24, 25, 255, 256, 65535, 65536,
+    2**32 - 1, 2**32, 2**32 + 1, 2**63, 2**64 - 1,
+]
+
+EXTRA_SHAPES = [None, [0], [7], [2**40], [1, 2, 3], list(range(8))]
+BLOCK_SIZES = [1, 3, 16, 64]
+ALGOS = ["fnv64_cbor", "sha256_cbor_64bit"]
+
+
+def _random_stream(rng, n):
+    draw = rng.random
+    out = []
+    for _ in range(n):
+        r = draw()
+        if r < 0.2:
+            out.append(rng.choice(CBOR_EDGES))
+        elif r < 0.9:
+            out.append(rng.randrange(2**17))  # realistic vocab ids
+        else:
+            out.append(rng.randrange(2**64))
+    return out
+
+
+def _python_chunked(parent, tokens, block_size, extra, algo):
+    """Chunk-by-chunk derivation through the single-link functions."""
+    link = (
+        hashing.chunk_hash if algo == "fnv64_cbor"
+        else hashing.sha256_cbor_chunk_hash
+    )
+    h = parent
+    out = []
+    for i in range(len(tokens) // block_size):
+        h = link(h, tokens[i * block_size:(i + 1) * block_size], extra)
+        out.append(h)
+    return out
+
+
+class TestPurePythonDifferential:
+    def test_fast_wrapper_matches_chunked_reference(self):
+        rng = random.Random(1234)
+        for trial in range(30):
+            algo = ALGOS[trial % 2]
+            bs = rng.choice(BLOCK_SIZES)
+            extra = rng.choice(EXTRA_SHAPES)
+            tokens = _random_stream(rng, rng.randrange(0, 6 * bs + 5))
+            parent = rng.randrange(2**64)
+            assert hashing.prefix_hashes_fast(
+                parent, tokens, bs, extra, algo=algo
+            ) == _python_chunked(parent, tokens, bs, extra, algo)
+
+    def test_seeded_roots_differ_by_algo(self):
+        assert hashing.init_hash("42") != hashing.sha256_cbor_init_hash("42")
+
+    def test_fingerprints_pure_python_fold(self):
+        # The documented fold, hand-rolled, against the wrapper.
+        rng = random.Random(7)
+        tokens = _random_stream(rng, 101)
+        fp0 = rng.randrange(2**64)
+        h = fp0
+        want = []
+        for i, t in enumerate(tokens[:96]):
+            h = hashing.fold64(h, t)
+            if (i + 1) % 32 == 0:
+                want.append(h)
+        assert hashing.token_fingerprints(fp0, tokens, 32) == want
+
+
+@pytest.mark.native
+class TestNativeDifferential:
+    """C batch ≡ C single-block ≡ pure Python, on randomized streams ×
+    both hash algos × extra-key (LoRA) shapes."""
+
+    def test_batch_vs_single_vs_python(self):
+        native = hashing._native
+        rng = random.Random(99)
+        for trial in range(40):
+            bs = rng.choice(BLOCK_SIZES)
+            extra = rng.choice(EXTRA_SHAPES)
+            tokens = _random_stream(rng, rng.randrange(0, 8 * bs + 7))
+            parent = rng.randrange(2**64)
+
+            py = _python_chunked(parent, tokens, bs, extra, "fnv64_cbor")
+            batch = list(native.batch_prefix_hashes(parent, tokens, bs, extra))
+            single = []
+            h = parent
+            for i in range(len(tokens) // bs):
+                h = native.chunk_hash(h, tokens[i * bs:(i + 1) * bs], extra)
+                single.append(h)
+            assert batch == py, f"trial {trial}: batch != python"
+            assert single == py, f"trial {trial}: single != python"
+            assert hashing.prefix_hashes_fast(
+                parent, tokens, bs, extra, algo="fnv64_cbor"
+            ) == py
+
+    def test_cbor_edge_tokens_every_position(self):
+        native = hashing._native
+        for bs in (1, 2, len(CBOR_EDGES)):
+            py = _python_chunked(5, CBOR_EDGES, bs, None, "fnv64_cbor")
+            assert list(native.batch_prefix_hashes(5, CBOR_EDGES, bs)) == py
+            assert list(
+                native.batch_prefix_hashes(5, CBOR_EDGES, bs, [2**64 - 1])
+            ) == _python_chunked(5, CBOR_EDGES, bs, [2**64 - 1], "fnv64_cbor")
+
+    def test_legacy_prefix_hashes_agrees_with_batch(self):
+        native = hashing._native
+        rng = random.Random(3)
+        tokens = [rng.randrange(2**31) for _ in range(130)]
+        assert list(native.prefix_hashes(17, tokens, 16)) == list(
+            native.batch_prefix_hashes(17, tokens, 16)
+        )
+
+    def test_fingerprints_c_vs_python_fold(self):
+        native = hashing._native
+        rng = random.Random(11)
+        for _ in range(20):
+            tokens = _random_stream(rng, rng.randrange(0, 300))
+            fp0 = rng.randrange(2**64)
+            seg = rng.choice([1, 8, 32, 128])
+            c = list(native.token_fingerprints(fp0, tokens, seg))
+            h = fp0
+            py = []
+            for i in range((len(tokens) // seg) * seg):
+                h = hashing.fold64(h, tokens[i])
+                if (i + 1) % seg == 0:
+                    py.append(h)
+            assert c == py
+
+    def test_rejects_what_python_rejects(self):
+        native = hashing._native
+        with pytest.raises(TypeError):
+            native.batch_prefix_hashes(0, [1.5, 2.5], 1)
+        with pytest.raises((OverflowError, ValueError)):
+            native.batch_prefix_hashes(0, [-1], 1)
+        with pytest.raises(ValueError):
+            native.batch_prefix_hashes(0, [1], 0)
+
+    def test_numpy_scalars_accepted_directly(self):
+        np = pytest.importorskip("numpy")
+        native = hashing._native
+        tokens = [np.uint32(i * 7919) for i in range(64)]
+        assert list(native.batch_prefix_hashes(3, tokens, 16)) == (
+            _python_chunked(3, [int(t) for t in tokens], 16, None, "fnv64_cbor")
+        )

@@ -83,22 +83,13 @@ class TestChaining:
         assert len(fast) == 6  # partial tail block dropped
 
 
+@pytest.mark.native
 class TestNativeExtensionParity:
+    # Skipped (visibly) by conftest's `native` marker handling when the
+    # extension isn't built; deeper cross-checks live in
+    # tests/test_hash_differential.py.
     def test_native_matches_pure_python(self):
         native = hashing._native
-        if native is None:
-            import subprocess, sys, os
-
-            subprocess.run(
-                [sys.executable, "setup.py", "build_ext"],
-                cwd=os.path.join(os.path.dirname(__file__), "..", "native"),
-                check=True, capture_output=True,
-            )
-            import importlib
-
-            importlib.reload(hashing)
-            native = hashing._native
-        assert native is not None, "native hash core failed to build"
         import random
 
         rng = random.Random(0)
@@ -113,12 +104,11 @@ class TestNativeExtensionParity:
                 assert list(native.prefix_hashes(root, tokens, block_size)) == (
                     hashing.prefix_hashes(root, chunks)
                 )
+                assert list(
+                    native.batch_prefix_hashes(root, tokens, block_size)
+                ) == hashing.prefix_hashes(root, chunks)
 
     def test_native_fnv_vector(self):
-        if hashing._native is None:
-            import pytest
-
-            pytest.skip("native extension not built")
         assert hashing._native.fnv64a(b"foobar") == 0x85944171F73967E8
 
 
