@@ -2,8 +2,8 @@
 # build/test/bench/lint/image-build/image-push + pre-commit install —
 # /root/reference/Makefile, /root/reference/hooks/pre-commit.sh).
 
-.PHONY: native test bench bench-micro bench-read bench-faults clean proto \
-	lint precommit-install image-build image-push
+.PHONY: native kvtransfer test bench bench-micro bench-read bench-faults \
+	bench-transfer clean proto lint precommit-install image-build image-push
 
 # Container image coordinates (override per environment/registry). The
 # release workflow (.github/workflows/ci-release.yaml) builds the same
@@ -27,6 +27,12 @@ image-push:
 # `native`-marked tests skip with a visible reason.
 native:
 	cd native && python setup.py build_ext
+	cd kv_connectors/cpp && $(MAKE)
+
+# The kv_connectors C++ transfer engine alone (libkvtransfer.so): the block
+# server + pooled multi-block DCN client. `transfer`-marked tests skip with
+# a visible reason until this has run.
+kvtransfer:
 	cd kv_connectors/cpp && $(MAKE)
 
 test: native
@@ -65,6 +71,16 @@ bench-read:
 # Headless; rewrites benchmarking/FLEET_BENCH_FAULTS.json.
 bench-faults:
 	JAX_PLATFORMS=cpu python bench.py --faults
+
+# Transfer-plane legs (CI-smoke sizes, printed only): async-offload
+# dispatch vs sync stage, batched-vs-serial multi-block DCN fetch, inflight
+# depth sweep, route-driven prefetch A/B. Full mode (merges the
+# transfer_plane sections into DEVICE_BENCH.json / FLEET_DEVICE_BENCH.json):
+#   python benchmarking/device_bench.py --transfer
+#   python benchmarking/fleet_device_bench.py --transfer
+bench-transfer: kvtransfer
+	JAX_PLATFORMS=cpu python benchmarking/device_bench.py --quick --transfer
+	JAX_PLATFORMS=cpu python benchmarking/fleet_device_bench.py --quick --transfer
 
 proto:
 	protoc --python_out=. llm_d_kv_cache_manager_tpu/api/indexer.proto

@@ -456,6 +456,219 @@ def _run_fleet_trace(strategy, model_config, trace, n_pods, n_pages,
     }
 
 
+def bench_fleet_transfer(quick=False) -> dict:
+    """Route-driven prefetch A/B through the FULL stack (PR-5 tentpole #3):
+    pod A computes and stages a set of prefixes; every request is then
+    routed at a COLD pod B — the overflow/rebalance case where the chosen
+    pod must onboard the chain over DCN. The read path
+    (`Indexer.get_pod_scores_ex`) already knows exactly which blocks B
+    misses; the A/B is whether that tail is prefetched into B's ready
+    buffer while the request sits in queue (prefetch arm) or fetched on
+    the TTFT critical path at allocation time (cold arm). Identical
+    compute, identical bytes moved — the delta is WHERE the DCN leg lands.
+
+    Device compute is the toy CPU config: the leg measures the transfer
+    plane's placement of network time, not model math, and is labeled with
+    its backend."""
+    import jax
+
+    from llm_d_kv_cache_manager_tpu.engine.engine import (
+        EnginePod,
+        EnginePodConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.engine.tiering import (
+        IndexBackedPeerResolver,
+    )
+    from llm_d_kv_cache_manager_tpu.kv_connectors import connector as conn_mod
+    from llm_d_kv_cache_manager_tpu.kv_connectors.prefetch import (
+        RoutePrefetcher,
+    )
+    from llm_d_kv_cache_manager_tpu.kvcache.indexer import (
+        Indexer,
+        IndexerConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+        TokenProcessorConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.kvevents.pool import (
+        EventPool,
+        EventPoolConfig,
+        Message,
+    )
+    from llm_d_kv_cache_manager_tpu.models import llama
+
+    if not conn_mod.native_available():
+        return {"skipped": "libkvtransfer.so not built"}
+    import jax.numpy as jnp
+
+    if quick:
+        cfg = llama.LlamaConfig(
+            vocab_size=512, d_model=64, n_layers=2, n_q_heads=4,
+            n_kv_heads=2, head_dim=16, d_ff=128, dtype=jnp.float32,
+        )
+    else:
+        # Mid-size KV geometry (~128KB/block) so the DCN leg moves real
+        # bytes: ~2.3MB per 18-block chain — enough for the cold arm's
+        # critical-path fetch to be visible against the prefill compute,
+        # while the whole leg stays CPU-feasible.
+        cfg = llama.LlamaConfig(
+            vocab_size=512, d_model=256, n_layers=4, n_q_heads=8,
+            n_kv_heads=4, head_dim=64, d_ff=512, dtype=jnp.float32,
+        )
+    n_prompts = 2 if quick else 8
+    blocks_per_prompt = 4 if quick else 20
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+    indexer = Indexer(
+        config=IndexerConfig(
+            token_processor_config=TokenProcessorConfig(block_size=PAGE_SIZE),
+        ),
+        tokenization_pool=_tok_pool(),
+    )
+    indexer.run()
+    pool = EventPool(
+        EventPoolConfig(concurrency=1),
+        indexer.kv_block_index, indexer.token_processor,
+    )
+    pool.start(with_subscriber=False)
+
+    def sink_for(pod_id):
+        def sink(batch):
+            pool.add_task(Message(
+                topic=f"kv@{pod_id}@{MODEL}", payload=batch.to_msgpack(),
+                seq=0, pod_identifier=pod_id, model_name=MODEL,
+            ))
+        return sink
+
+    def make_pod(pod_id):
+        return EnginePod(
+            EnginePodConfig(
+                pod_id=pod_id, model_name=MODEL,
+                n_pages=n_prompts * blocks_per_prompt + 16,
+                page_size=PAGE_SIZE,
+                max_pages_per_seq=blocks_per_prompt + 4,
+                device_tier="hbm", with_model=True, model_config=cfg,
+                enable_host_tier=True, transfer_cost_model=None,
+            ),
+            event_sink=sink_for(pod_id),
+            params=params,
+        )
+
+    rng = random.Random(17)
+    prompts = []
+    for _ in range(n_prompts):
+        # Sized so tokenization lands on full-page boundaries isn't
+        # required — whatever full pages exist are the measured chain.
+        prompts.append(_text(rng, blocks_per_prompt * PAGE_SIZE // 2))
+
+    pod_a = make_pod("pod-a")
+    tok = indexer.tokenizers_pool
+    try:
+        token_lists = [tok.tokenize(None, p, MODEL) for p in prompts]
+        for tokens in token_lists:
+            state, _ = pod_a.prefill(tokens)
+            pod_a.export_sequence(state)
+        pool.drain()
+
+        def run_arm(prefetch: bool, pod_id: str):
+            pod_b = make_pod(pod_id)
+            pods = {"pod-a": pod_a, pod_id: pod_b}
+            rp = RoutePrefetcher(
+                lambda pid, hashes: pods[pid].prefetch_hashes(hashes)
+            )
+            walls, waits, match_lens = [], [], []
+            try:
+                pod_b.set_peer_resolver(IndexBackedPeerResolver(
+                    indexer.kv_block_index, MODEL,
+                    {"pod-a": pod_a.transfer_address}, pod_id,
+                ))
+                for prompt, tokens in zip(prompts, token_lists):
+                    ex = indexer.get_pod_scores_ex(prompt, MODEL, [])
+                    match_lens.append(ex.match_blocks.get("pod-a", 0))
+                    t_wait = 0.0
+                    if prefetch:
+                        # The router hands B its missing tail the moment it
+                        # picks B; the fetch rides the request's queue wait.
+                        base = pod_b.tier_store.stats["prefetched"]
+                        rp.submit_route(pod_id, ex)
+                        n_chain = len(ex.missing_tail(pod_id))
+                        t0 = time.perf_counter()
+                        for _ in range(1000):
+                            done = pod_b.tier_store.stats["prefetched"] - base
+                            if done >= n_chain:
+                                break
+                            time.sleep(0.002)
+                        t_wait = time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                    state, cached = pod_b.prefill(tokens)
+                    walls.append(time.perf_counter() - t0)
+                    waits.append(t_wait)
+                    assert cached >= (len(tokens) // PAGE_SIZE) * PAGE_SIZE
+                stats = dict(pod_b.tier_store.stats)
+                client = pod_b.connector.client.stats
+            finally:
+                rp.close()
+                pod_b.close()
+            return walls, waits, stats, client, match_lens
+
+        # Warm arm (compiles prefill buckets into the process-global cache)
+        # then the measured arms, so neither measured arm pays compiles.
+        run_arm(False, "pod-warm")
+        cold_walls, _, cold_stats, cold_client, match_lens = run_arm(
+            False, "pod-cold"
+        )
+        warm_walls, waits, pf_stats, pf_client, _ = run_arm(
+            True, "pod-prefetch"
+        )
+    finally:
+        pod_a.close()
+        pool.shutdown()
+        indexer.shutdown()
+
+    chain = (len(token_lists[0]) // PAGE_SIZE)
+    out = {
+        "backend": jax.default_backend(),
+        "n_prompts": n_prompts,
+        "chain_blocks": chain,
+        "mean_match_blocks_pod_a": round(
+            sum(match_lens) / max(len(match_lens), 1), 1
+        ),
+        "ttft_p50_cold_onboard_s": round(_pctl(cold_walls, 0.5), 4),
+        "ttft_p50_route_prefetch_s": round(_pctl(warm_walls, 0.5), 4),
+        "ttft_mean_cold_onboard_s": round(
+            sum(cold_walls) / len(cold_walls), 4
+        ),
+        "ttft_mean_route_prefetch_s": round(
+            sum(warm_walls) / len(warm_walls), 4
+        ),
+        "route_prefetch_ttft_speedup": round(
+            _pctl(cold_walls, 0.5) / max(_pctl(warm_walls, 0.5), 1e-9), 2
+        ),
+        "prefetch_wait_p50_s": round(_pctl(waits, 0.5), 4),
+        "cold_arm": {
+            "onboards": cold_stats["onboards"],
+            "ready_hits": cold_stats["ready_hits"],
+            "batched_fetches": cold_stats["batched_fetches"],
+            "dcn_round_trips": cold_client["batch_fetches"],
+            "dcn_blocks_fetched": cold_client["blocks_fetched"],
+        },
+        "prefetch_arm": {
+            "onboards": pf_stats["onboards"],
+            "ready_hits": pf_stats["ready_hits"],
+            "prefetched": pf_stats["prefetched"],
+        },
+        "note": (
+            "identical compute and identical bytes in both arms; the cold "
+            "arm pays the DCN fetch inside prefill (allocation-path "
+            "load_chain), the prefetch arm pays it during the queue wait "
+            "(prefetch_wait) and prefill consumes the ready buffer. "
+            "Loopback DCN; toy model — the leg measures transfer-time "
+            "placement, not model math."
+        ),
+    }
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -469,6 +682,12 @@ def main():
         "--trace", default=None, metavar="PATH",
         help="replay a recorded JSONL workload trace (sharegpt mode only) — "
              "the same file bench.py --trace accepts",
+    )
+    ap.add_argument(
+        "--transfer", action="store_true",
+        help="run ONLY the transfer-plane fleet leg (route-driven prefetch "
+             "A/B) and merge the transfer_plane section into the existing "
+             "FLEET_DEVICE_BENCH.json (with --quick: print only)",
     )
     args = ap.parse_args()
 
@@ -484,6 +703,19 @@ def main():
     import jax.numpy as jnp
 
     from llm_d_kv_cache_manager_tpu.models import llama
+
+    if args.transfer:
+        section = bench_fleet_transfer(quick=args.quick)
+        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "FLEET_DEVICE_BENCH.json")
+        if not args.quick and os.path.exists(out):
+            with open(out) as f:
+                artifact = json.load(f)
+            artifact["transfer_plane"] = section
+            with open(out, "w") as f:
+                json.dump(artifact, f, indent=2)
+        print(json.dumps(section, indent=2))
+        return
 
     on_tpu = jax.default_backend() == "tpu"
     if args.quick:

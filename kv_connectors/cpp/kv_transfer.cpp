@@ -9,22 +9,39 @@
 // llm_d_kv_cache_manager_tpu/kv_connectors/connector.py); this engine covers
 // the cross-slice / cross-pod hop where ICI does not reach.
 //
-// Wire protocol (all little-endian):
-//   request:  u32 magic 'KVTB', u64 block_hash
-//   response: u32 magic, u8 status (0=ok, 1=missing), u64 length, payload
+// Wire protocol (all little-endian). Connections are KEEP-ALIVE: a client
+// may issue any number of requests (of either kind) on one connection.
+//
+//   single-block request:  u32 magic 'KVTB', u64 block_hash
+//   single-block response: u32 magic, u8 status (0=ok, 1=missing),
+//                          u64 length, payload
+//
+//   multi-block request:   u32 magic 'KVTM', u32 count, count x u64 hashes
+//   multi-block response:  u32 magic, then per block in request order:
+//                          u8 status, u64 length, payload
+//
+// The multi-block form is the DCN leg's unit of transfer: one round trip
+// moves a whole chain instead of N, and the server assembles the response
+// with scatter-gather writev (headers + payload buffers, zero re-copy).
 //
 // Exposed as a C ABI for ctypes (no pybind11 in this image).
 
 #include <arpa/inet.h>
+#include <algorithm>
+#include <cerrno>
+#include <climits>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <fcntl.h>
 #include <mutex>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <set>
 #include <string>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <thread>
 #include <unistd.h>
 #include <unordered_map>
@@ -32,7 +49,12 @@
 
 namespace {
 
-constexpr uint32_t kMagic = 0x4B565442;  // 'KVTB'
+constexpr uint32_t kMagic = 0x4B565442;       // 'KVTB' (single block)
+constexpr uint32_t kMagicMulti = 0x4B56544D;  // 'KVTM' (multi block)
+// Per-request block-count bound: a corrupt/hostile count must not drive a
+// multi-GB allocation. 1<<16 blocks x 4MB pages is already ~256GB of
+// payload — far beyond one request's plausible chain.
+constexpr uint32_t kMaxBlocksPerRequest = 1u << 16;
 
 struct BlockStore {
   std::mutex mu;
@@ -52,6 +74,15 @@ struct Server {
   int conn_count = 0;
   bool stopping = false;
 };
+
+// Multi-block responses stream whole chains (MBs); the kernel's default
+// loopback buffers (~208KB) throttle that into a wakeup ping-pong between
+// writer and reader. 4MB buffers let a chain-sized burst land in one flow.
+void set_big_buffers(int fd) {
+  int sz = 4 << 20;
+  ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &sz, sizeof(sz));
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &sz, sizeof(sz));
+}
 
 bool read_exact(int fd, void* buf, size_t n) {
   auto* p = static_cast<uint8_t*>(buf);
@@ -75,11 +106,78 @@ bool write_exact(int fd, const void* buf, size_t n) {
   return true;
 }
 
+// Scatter-gather write of the whole iovec array, resuming across partial
+// writes and IOV_MAX-bounded segments.
+bool writev_all(int fd, std::vector<iovec>& iov) {
+  size_t idx = 0;
+  while (idx < iov.size()) {
+    size_t cnt = std::min(iov.size() - idx, static_cast<size_t>(IOV_MAX));
+    ssize_t sent = ::writev(fd, iov.data() + idx, static_cast<int>(cnt));
+    if (sent <= 0) return false;
+    size_t remaining = static_cast<size_t>(sent);
+    while (remaining > 0 && idx < iov.size()) {
+      if (remaining >= iov[idx].iov_len) {
+        remaining -= iov[idx].iov_len;
+        idx++;
+      } else {
+        iov[idx].iov_base = static_cast<uint8_t*>(iov[idx].iov_base) + remaining;
+        iov[idx].iov_len -= remaining;
+        remaining = 0;
+      }
+    }
+  }
+  return true;
+}
+
+// One multi-block request: count + hashes in, headers + payloads out via a
+// single scatter-gather writev (header bytes packed per block; payload
+// buffers referenced in place — no reassembly copy).
+bool serve_multi(Server* server, int fd) {
+  uint32_t count = 0;
+  if (!read_exact(fd, &count, 4) || count == 0 ||
+      count > kMaxBlocksPerRequest)
+    return false;
+  std::vector<uint64_t> hashes(count);
+  if (!read_exact(fd, hashes.data(), 8ull * count)) return false;
+
+  std::vector<std::vector<uint8_t>> payloads(count);
+  std::vector<uint8_t> headers(9ull * count);  // u8 status + u64 length
+  {
+    std::lock_guard<std::mutex> lock(server->store.mu);
+    for (uint32_t i = 0; i < count; i++) {
+      auto it = server->store.blocks.find(hashes[i]);
+      uint8_t status = 1;
+      uint64_t length = 0;
+      if (it != server->store.blocks.end()) {
+        payloads[i] = it->second;  // copy out under lock
+        status = 0;
+        length = payloads[i].size();
+      }
+      headers[9ull * i] = status;
+      std::memcpy(&headers[9ull * i + 1], &length, 8);
+    }
+  }
+  std::vector<iovec> iov;
+  iov.reserve(1 + 2ull * count);
+  iov.push_back({const_cast<uint32_t*>(&kMagicMulti), 4});
+  for (uint32_t i = 0; i < count; i++) {
+    iov.push_back({&headers[9ull * i], 9});
+    if (!payloads[i].empty())
+      iov.push_back({payloads[i].data(), payloads[i].size()});
+  }
+  return writev_all(fd, iov);
+}
+
 void serve_conn(Server* server, int fd) {
   for (;;) {
     uint32_t magic = 0;
+    if (!read_exact(fd, &magic, 4)) break;
+    if (magic == kMagicMulti) {
+      if (!serve_multi(server, fd)) break;
+      continue;
+    }
+    if (magic != kMagic) break;
     uint64_t hash = 0;
-    if (!read_exact(fd, &magic, 4) || magic != kMagic) break;
     if (!read_exact(fd, &hash, 8)) break;
 
     std::vector<uint8_t> payload;
@@ -117,6 +215,7 @@ void accept_loop(Server* server) {
     if (fd < 0) return;  // listen socket closed -> shutdown
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    set_big_buffers(fd);
     {
       std::lock_guard<std::mutex> lock(server->conn_mu);
       if (server->stopping) {
@@ -128,6 +227,29 @@ void accept_loop(Server* server) {
     }
     std::thread(serve_conn, server, fd).detach();
   }
+}
+
+// Apply a receive/send timeout to a connected socket. timeout_ms <= 0
+// leaves the socket blocking without bound (the legacy behavior).
+void set_io_timeout(int fd, int timeout_ms) {
+  if (timeout_ms <= 0) return;
+  timeval tv{};
+  tv.tv_sec = timeout_ms / 1000;
+  tv.tv_usec = (timeout_ms % 1000) * 1000;
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+}
+
+// Read and discard n payload bytes (an oversized block inside an otherwise
+// healthy multi-block response) so the connection stays usable.
+bool drain_exact(int fd, uint64_t n) {
+  uint8_t scratch[4096];
+  while (n > 0) {
+    size_t chunk = n < sizeof(scratch) ? static_cast<size_t>(n) : sizeof(scratch);
+    if (!read_exact(fd, scratch, chunk)) return false;
+    n -= chunk;
+  }
+  return true;
 }
 
 }  // namespace
@@ -208,38 +330,119 @@ void kvt_server_stop(void* handle) {
   delete server;
 }
 
-// Fetches a block from a remote pod. Returns payload length (>= 0, empty
-// blocks included), -2 if the block is missing remotely, or -1 on transport
-// error. `out` must hold `cap` bytes.
-int64_t kvt_fetch(const char* host, int port, uint64_t hash, uint8_t* out,
-                  uint64_t cap) {
+// Opens a keep-alive connection to a pod's transfer server. Bounded
+// non-blocking connect (`timeout_ms`; <= 0 means unbounded). Returns the
+// fd (>= 0) or -1 on failure. The fd is blocking afterwards; every
+// kvt_fetch_* call applies its own IO timeout.
+int kvt_connect(const char* host, int port, int timeout_ms) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -1;
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(static_cast<uint16_t>(port));
-  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
-      ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
     ::close(fd);
     return -1;
   }
+  int flags = ::fcntl(fd, F_GETFL, 0);
+  if (timeout_ms > 0) ::fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+  int rc = ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+  if (rc < 0 && timeout_ms > 0 && errno == EINPROGRESS) {
+    pollfd pfd{fd, POLLOUT, 0};
+    if (::poll(&pfd, 1, timeout_ms) != 1) {
+      ::close(fd);
+      return -1;  // connect timed out (the dead-peer hang this bounds)
+    }
+    int err = 0;
+    socklen_t len = sizeof(err);
+    ::getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len);
+    rc = err == 0 ? 0 : -1;
+  }
+  if (rc < 0) {
+    ::close(fd);
+    return -1;
+  }
+  if (timeout_ms > 0) ::fcntl(fd, F_SETFL, flags);
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  set_big_buffers(fd);
+  return fd;
+}
 
-  int64_t result = -1;
+void kvt_close(int fd) {
+  if (fd >= 0) ::close(fd);
+}
+
+// Single-block fetch on an open connection. Returns payload length (>= 0,
+// empty blocks included), -2 if the block is missing remotely, or -1 on
+// transport error/timeout (the caller should close and reconnect).
+int64_t kvt_fetch_conn(int fd, uint64_t hash, uint8_t* out, uint64_t cap,
+                       int timeout_ms) {
+  if (fd < 0) return -1;
+  set_io_timeout(fd, timeout_ms);
   uint32_t magic = kMagic;
   uint8_t status = 1;
   uint64_t length = 0;
-  if (write_exact(fd, &magic, 4) && write_exact(fd, &hash, 8) &&
-      read_exact(fd, &magic, 4) && magic == kMagic &&
-      read_exact(fd, &status, 1) && read_exact(fd, &length, 8)) {
+  if (!write_exact(fd, &magic, 4) || !write_exact(fd, &hash, 8) ||
+      !read_exact(fd, &magic, 4) || magic != kMagic ||
+      !read_exact(fd, &status, 1) || !read_exact(fd, &length, 8))
+    return -1;
+  if (status != 0) return -2;  // missing (distinct from present-but-empty)
+  if (length > cap) return -1;
+  if (length > 0 && !read_exact(fd, out, length)) return -1;
+  return static_cast<int64_t>(length);
+}
+
+// Multi-block fetch on an open connection: ONE round trip for `n` blocks.
+// Payload i lands at out + i*cap_per_block; out_lens[i] is the payload
+// length (>= 0), -2 when missing remotely, or -3 when the block exceeded
+// cap_per_block (its bytes are drained so the connection stays usable).
+// Returns 0 on success, -1 on transport error/timeout (out_lens contents
+// are then undefined and the connection must be reconnected).
+int kvt_fetch_many(int fd, uint64_t n, const uint64_t* hashes, uint8_t* out,
+                   uint64_t cap_per_block, int64_t* out_lens,
+                   int timeout_ms) {
+  if (fd < 0 || n == 0 || n > kMaxBlocksPerRequest) return -1;
+  set_io_timeout(fd, timeout_ms);
+  uint32_t magic = kMagicMulti;
+  uint32_t count = static_cast<uint32_t>(n);
+  std::vector<iovec> req{
+      {&magic, 4},
+      {&count, 4},
+      {const_cast<uint64_t*>(hashes), 8ull * n},
+  };
+  if (!writev_all(fd, req)) return -1;
+  if (!read_exact(fd, &magic, 4) || magic != kMagicMulti) return -1;
+  for (uint64_t i = 0; i < n; i++) {
+    uint8_t status = 1;
+    uint64_t length = 0;
+    if (!read_exact(fd, &status, 1) || !read_exact(fd, &length, 8)) return -1;
     if (status != 0) {
-      result = -2;  // missing (distinct from a present-but-empty block)
-    } else if (length <= cap) {
-      if (length == 0 || read_exact(fd, out, length))
-        result = static_cast<int64_t>(length);
+      out_lens[i] = -2;
+      continue;
     }
+    if (length > cap_per_block) {
+      if (!drain_exact(fd, length)) return -1;
+      out_lens[i] = -3;
+      continue;
+    }
+    if (length > 0 && !read_exact(fd, out + i * cap_per_block, length))
+      return -1;
+    out_lens[i] = static_cast<int64_t>(length);
   }
+  return 0;
+}
+
+// Fetches a block from a remote pod over a throwaway connection. Returns
+// payload length (>= 0, empty blocks included), -2 if the block is missing
+// remotely, or -1 on transport error. `out` must hold `cap` bytes.
+// Unbounded (no timeout) — kept for ABI compatibility; new callers should
+// use kvt_connect + kvt_fetch_conn / kvt_fetch_many.
+int64_t kvt_fetch(const char* host, int port, uint64_t hash, uint8_t* out,
+                  uint64_t cap) {
+  int fd = kvt_connect(host, port, 0);
+  if (fd < 0) return -1;
+  int64_t result = kvt_fetch_conn(fd, hash, out, cap, 0);
   ::close(fd);
   return result;
 }

@@ -98,11 +98,14 @@ class OutOfPagesError(RuntimeError):
 #    checks only (no bytes moved).
 #  ChainLoader([(hash, token_ids, parent)], take_pages) -> [page_ids] —
 #    fetch a chain prefix's payloads FIRST, then call take_pages(k) for
-#    exactly the pages the fetched payloads need, land them in one insert
-#    dispatch, and return the landed page ids (aligned with the block
-#    prefix). Fetch-before-take means a stale plan (dead peer, desynced
-#    host store) cannot evict LRU-cached HBM pages for a restore that
-#    lands nothing.
+#    exactly the pages the fetched payloads need, land them in insert
+#    dispatches, and return the landed page ids (aligned with the block
+#    prefix). take_pages may be called once per landing wave — the
+#    pipelined loader (tiering.load_chain) lands long chains in waves so
+#    each H2D insert overlaps the next network receive; every call still
+#    covers only already-fetched payloads. Fetch-before-take means a stale
+#    plan (dead peer, desynced host store) cannot evict LRU-cached HBM
+#    pages for a restore that lands nothing.
 ReclaimHook = Callable[[int, List[int], Optional[int], int, Optional[int]], None]
 PageLoader = Callable[[int, List[int], Optional[int], int], bool]
 ReclaimManyHook = Callable[[List[tuple]], None]

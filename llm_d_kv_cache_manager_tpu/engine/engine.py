@@ -247,6 +247,19 @@ class EnginePodConfig:
     # until the background admit lands); blocks past the budget fall back
     # to the synchronous reclaim-time stage.
     async_stage_capacity_pages: int = 128
+    # Transfer-plane pipelining (engine/tiering.py + kv_connectors): pages
+    # per extract wave in the double-buffered stager, blocks per H2D
+    # insert wave during chain onboard (each wave overlaps the next
+    # network receive), and blocks per multi-block DCN round trip.
+    stage_wave_pages: int = 16
+    onboard_wave_blocks: int = 8
+    fetch_batch_blocks: int = 32
+    # DCN client bounds: a dead peer costs at most
+    # connect/fetch timeout x (retries+1) per chain, then degrades to a
+    # cache miss (counted in the transfer_failures metric).
+    transfer_connect_timeout_ms: int = 2000
+    transfer_fetch_timeout_ms: int = 5000
+    transfer_fetch_retries: int = 1
 
 
 class EnginePod:
@@ -278,7 +291,13 @@ class EnginePod:
             )
 
             self.connector = KVConnector(
-                KVConnectorConfig(port=config.transfer_port),
+                KVConnectorConfig(
+                    port=config.transfer_port,
+                    connect_timeout_ms=config.transfer_connect_timeout_ms,
+                    fetch_timeout_ms=config.transfer_fetch_timeout_ms,
+                    fetch_retries=config.transfer_fetch_retries,
+                    fetch_batch_size=config.fetch_batch_blocks,
+                ),
                 event_sink=self._emit,
             )
             codec = (
@@ -298,6 +317,9 @@ class EnginePod:
                 cost_model=cost_model,
                 prefetch_capacity_blocks=config.prefetch_capacity_blocks,
                 async_stage_capacity_pages=config.async_stage_capacity_pages,
+                stage_wave_pages=config.stage_wave_pages,
+                onboard_wave_blocks=config.onboard_wave_blocks,
+                fetch_batch_blocks=config.fetch_batch_blocks,
             )
 
         self.block_manager = BlockManager(
@@ -678,10 +700,18 @@ class EnginePod:
         keys = self.block_manager.token_db.tokens_to_kv_block_keys(
             None, [int(t) for t in tokens], "", lora_id=lora_id
         )
+        return self.prefetch_hashes([k.chunk_hash for k in keys])
+
+    def prefetch_hashes(self, chunk_hashes: List[int]) -> int:
+        """Route-driven prefetch entry point: the router already derived
+        this prompt's chain and knows which tail this pod misses
+        (Indexer.get_pod_scores_ex → PodScores.missing_tail), so no
+        re-derivation happens here — just an HBM-residency filter and the
+        background fetch queue. Returns the number of fetches queued."""
+        if self.tier_store is None:
+            return 0
         missing = [
-            k.chunk_hash
-            for k in keys
-            if not self.block_manager.is_cached(k.chunk_hash)
+            h for h in chunk_hashes if not self.block_manager.is_cached(h)
         ]
         return self.tier_store.prefetch(missing)
 

@@ -118,11 +118,21 @@ class TieredKVStore:
         cost_model: Optional[TransferCostModel] = None,
         prefetch_capacity_blocks: int = 64,
         async_stage_capacity_pages: int = 128,
+        stage_wave_pages: int = 16,
+        onboard_wave_blocks: int = 8,
+        fetch_batch_blocks: int = 32,
     ):
         self.connector = connector
         self.codec = codec
         self.capacity_blocks = capacity_blocks
         self.peer_resolver = peer_resolver
+        # Transfer-plane pipelining bounds: pages per extract wave in the
+        # double-buffered stager (_stage_many), blocks per H2D insert wave
+        # in load_chain (each wave's scatter overlaps the next network
+        # receive), and blocks per multi-block DCN round trip.
+        self.stage_wave_pages = max(1, stage_wave_pages)
+        self.onboard_wave_blocks = max(1, onboard_wave_blocks)
+        self.fetch_batch_blocks = max(1, fetch_batch_blocks)
         # Transfer-vs-recompute gate (engine/costs.py). None admits every
         # restorable block — the pre-gate behavior, which is right for
         # accounting-only pods (zero payload bytes) and mechanics tests;
@@ -150,6 +160,7 @@ class TieredKVStore:
         self.stats: Dict[str, int] = {
             "offloads": 0, "restores": 0, "onboards": 0, "host_evictions": 0,
             "gated_blocks": 0, "prefetched": 0, "ready_hits": 0,
+            "stage_waves": 0, "batched_fetches": 0,
         }
 
     # -- BlockManager hook: reclaim → offload ------------------------------
@@ -241,20 +252,53 @@ class TieredKVStore:
         return None
 
     def load_chain(self, blocks: List[tuple], take_pages) -> List[int]:
-        """Materialize a chain prefix: fetch every payload (host store or
-        peer) FIRST, then call `take_pages(k)` for exactly the pages the
-        fetched payloads need, and land them in ONE insert_many dispatch.
-        `blocks`: (chunk_hash, token_ids, parent_hash) in chain order.
-        Returns the landed page ids (aligned with the block prefix) —
-        fetches stop at the first miss so the hash chain never gets a
-        hole, and fetch-before-take means a stale plan cannot evict
-        HBM-cached pages for a restore that lands nothing."""
-        fetched: List[tuple] = []  # (payload, source)
-        cost_sources: List[str] = []  # what each landed block actually cost
+        """Materialize a chain prefix, pipelined: payloads are fetched in
+        chain order (prefetched ready buffer, then local host store, then
+        peers over DCN — consecutive same-peer blocks ride ONE multi-block
+        round trip instead of one per block) and land in waves of
+        `onboard_wave_blocks`: each wave calls `take_pages(k)` for exactly
+        the pages its fetched payloads need and dispatches one insert. The
+        jitted scatter is asynchronous, so a wave's H2D onboard overlaps
+        the next wave's network receive. `blocks`: (chunk_hash, token_ids,
+        parent_hash) in chain order. Returns the landed page ids (aligned
+        with the block prefix) — fetches stop at the first miss so the
+        hash chain never gets a hole, and fetch-before-take means a stale
+        plan cannot evict HBM-cached pages for a restore that lands
+        nothing."""
+        landed: List[int] = []
+        buffer: List[tuple] = []  # fetched, not yet landed: (payload, stat)
+        cost_sources: List[str] = []  # what each fetched block actually cost
         max_size = max(self.codec.page_nbytes, 1)
-        for chunk_hash, _tokens, _parent in blocks:
+        wave = self.onboard_wave_blocks
+        exhausted = False
+
+        def land_wave() -> None:
+            """Take pages for the buffered payloads and dispatch ONE insert.
+            A short take (pool exhausted) lands what fits and stops the
+            chain — nothing more could land anyway."""
+            nonlocal buffer, exhausted
+            if not buffer or exhausted:
+                buffer = []
+                return
+            page_ids = take_pages(len(buffer))
+            use = buffer[: len(page_ids)]
+            if use:
+                self.codec.insert_many(
+                    [(pid, p) for pid, (p, _) in zip(page_ids, use)]
+                )
+                for _, stat in use:
+                    self.stats[stat] += 1
+                landed.extend(page_ids[: len(use)])
+            if len(use) < len(buffer):
+                exhausted = True
+            buffer = []
+
+        i = 0
+        n = len(blocks)
+        while i < n and not exhausted:
+            chunk_hash = blocks[i][0]
             payload = None
-            source = None
+            stat = None
             with self._mu:
                 ready = self._ready.pop(chunk_hash, None)
                 staged = chunk_hash in self._staged
@@ -262,7 +306,7 @@ class TieredKVStore:
                 # Prefetched: the fetch already happened off the critical
                 # path; classify by where the prefetcher got it so the
                 # restore/onboard stats stay truthful.
-                payload, source = ready[0], (
+                payload, stat = ready[0], (
                     "restores" if ready[1] == STAGED else "onboards"
                 )
                 cost_sources.append(READY)
@@ -277,34 +321,85 @@ class TieredKVStore:
                     break
                 payload = self.connector.fetch_staged(chunk_hash, max_size)
                 if payload is not None:
-                    source = "restores"
+                    stat = "restores"
                     cost_sources.append(STAGED)
-            if payload is None and self.peer_resolver is not None:
-                addr = self.peer_resolver(chunk_hash)
-                if addr is not None:
-                    if not self._live_fetch_admissible(cost_sources, PEER):
-                        break
-                    payload = self.connector.onboard_payload(
-                        addr[0], addr[1], chunk_hash, max_size
-                    )
-                    if payload is not None:
-                        source = "onboards"
-                        cost_sources.append(PEER)
-            if payload is None:
+            if payload is not None:
+                buffer.append((payload, stat))
+                i += 1
+                if len(buffer) >= wave:
+                    land_wave()
+                continue
+
+            # Peer (DCN) leg. Batch the run of consecutive chain blocks
+            # that miss the local tiers and resolve to the SAME peer into
+            # one multi-block round trip — the serial protocol paid one
+            # RTT per block per chain.
+            if self.peer_resolver is None:
                 break
-            fetched.append((payload, source))
-        if not fetched:
-            return []
-        page_ids = take_pages(len(fetched))
-        fetched = fetched[: len(page_ids)]
-        if not fetched:
-            return []
-        self.codec.insert_many(
-            [(pid, payload) for pid, (payload, _) in zip(page_ids, fetched)]
-        )
-        for _, source in fetched:
-            self.stats[source] += 1
-        return list(page_ids[: len(fetched)])
+            addr = self.peer_resolver(chunk_hash)
+            if addr is None:
+                break
+            run = [chunk_hash]
+            j = i + 1
+            while j < n and len(run) < self.fetch_batch_blocks:
+                h = blocks[j][0]
+                with self._mu:
+                    local = h in self._ready or h in self._staged
+                if local or self.peer_resolver(h) != addr:
+                    break
+                run.append(h)
+                j += 1
+            if self.cost_model is not None:
+                # Same cumulative arithmetic as the per-block gate, applied
+                # to the whole run at once: admit only the prefix the
+                # economics accept at PEER cost.
+                admitted = self.cost_model.admit_prefix(
+                    cost_sources + [PEER] * len(run), 1
+                ) - len(cost_sources)
+                if admitted <= 0:
+                    break
+                run = run[:admitted]
+            payloads = self._fetch_peer_many(addr, run, max_size)
+            miss = False
+            for payload in payloads:
+                if payload is None:
+                    miss = True
+                    break
+                buffer.append((payload, "onboards"))
+                cost_sources.append(PEER)
+                i += 1
+                if len(buffer) >= wave and not exhausted:
+                    land_wave()
+            if miss:
+                break
+        land_wave()
+        return landed
+
+    def _fetch_peer_many(
+        self, addr: Tuple[str, int], hashes: List[int], max_size: int,
+    ) -> List[Optional[bytes]]:
+        """One multi-block DCN round trip when the connector supports it
+        (KVConnector.onboard_payloads); per-block fetches otherwise (fake
+        connectors in tests, stale .so builds)."""
+        batched = getattr(self.connector, "onboard_payloads", None)
+        if batched is not None and len(hashes) > 1:
+            self.stats["batched_fetches"] += 1
+            return batched(addr[0], addr[1], hashes, max_size)
+        out: List[Optional[bytes]] = []
+        for h in hashes:
+            payload = self.connector.onboard_payload(addr[0], addr[1], h, max_size)
+            out.append(payload)
+            if payload is None:
+                break  # chain cut: later blocks can't land anyway
+        return out
+
+    def _fetch_staged_many(
+        self, hashes: List[int], max_size: int,
+    ) -> List[Optional[bytes]]:
+        batched = getattr(self.connector, "fetch_staged_many", None)
+        if batched is not None and len(hashes) > 1:
+            return batched(hashes, max_size)
+        return [self.connector.fetch_staged(h, max_size) for h in hashes]
 
     # -- async prefetch ----------------------------------------------------
 
@@ -364,44 +459,56 @@ class TieredKVStore:
             batch = self._prefetch_q.get()
             if batch is None:
                 return
-            for h in batch:
-                try:
-                    # On close, drain without fetching: pending batches
-                    # must not hold the connector open through slow-peer
-                    # timeouts after the pod is being torn down.
-                    if not self._closed:
-                        self._prefetch_one(h)
-                except Exception as e:  # noqa: BLE001 - best-effort warming
-                    logger.debug("prefetch failed for %x: %s", h, e)
-                finally:
-                    with self._mu:
+            try:
+                # On close, drain without fetching: pending batches must
+                # not hold the connector open through slow-peer timeouts
+                # after the pod is being torn down.
+                if not self._closed:
+                    self._prefetch_batch(batch)
+            except Exception as e:  # noqa: BLE001 - best-effort warming
+                logger.debug("prefetch batch failed: %s", e)
+            finally:
+                with self._mu:
+                    for h in batch:
                         self._inflight.discard(h)
 
-    def _prefetch_one(self, chunk_hash: int) -> None:
+    def _prefetch_batch(self, batch: List[int]) -> None:
+        """Warm a whole submit's worth of blocks with batched fetches: one
+        loopback round trip for the host-staged run, one multi-block DCN
+        round trip per peer (instead of one connection + RTT per block)."""
         max_size = max(self.codec.page_nbytes, 1)
         with self._mu:
-            if chunk_hash in self._ready:
-                return
-            staged = chunk_hash in self._staged
-        payload = None
-        source = None
-        if staged:
-            payload = self.connector.fetch_staged(chunk_hash, max_size)
-            source = STAGED
-        if payload is None and self.peer_resolver is not None:
-            addr = self.peer_resolver(chunk_hash)
-            if addr is not None:
-                payload = self.connector.onboard_payload(
-                    addr[0], addr[1], chunk_hash, max_size
-                )
-                source = PEER
-        if payload is None:
+            todo = [h for h in batch if h not in self._ready]
+            staged_set = {h for h in todo if h in self._staged}
+        staged_run = [h for h in todo if h in staged_set]
+        peer_runs: "OrderedDict[Tuple[str, int], List[int]]" = OrderedDict()
+        if self.peer_resolver is not None:
+            for h in todo:
+                if h in staged_set:
+                    continue
+                addr = self.peer_resolver(h)
+                if addr is not None:
+                    peer_runs.setdefault(addr, []).append(h)
+        fetched: List[tuple] = []  # (hash, payload, source) in chain order
+        if staged_run:
+            for h, payload in zip(
+                staged_run, self._fetch_staged_many(staged_run, max_size)
+            ):
+                if payload is not None:
+                    fetched.append((h, payload, STAGED))
+        for addr, run in peer_runs.items():
+            for h, payload in zip(run, self._fetch_peer_many(addr, run, max_size)):
+                if payload is not None:
+                    fetched.append((h, payload, PEER))
+        if not fetched:
             return
         with self._mu:
-            self._ready[chunk_hash] = (payload, source)
+            for h, payload, source in fetched:
+                if h not in self._ready:
+                    self._ready[h] = (payload, source)
             while len(self._ready) > self._ready_cap:
                 self._ready.popitem(last=False)  # payload copies; no event
-        self.stats["prefetched"] += 1
+        self.stats["prefetched"] += len(fetched)
 
     def close(self) -> None:
         """Stop the prefetcher and stager (idempotent; safe when they never
@@ -424,9 +531,16 @@ class TieredKVStore:
     # -- internals ---------------------------------------------------------
 
     def _stage_many(self, blocks: List[tuple]) -> int:
-        """Stage blocks not already host-resident; ONE extract dispatch for
-        all of them. `blocks`: (hash, token_ids, parent, page_id, lora_id).
-        Returns how many of `blocks` are host-resident afterwards.
+        """Stage blocks not already host-resident. `blocks`: (hash,
+        token_ids, parent, page_id, lora_id). Returns how many of `blocks`
+        are host-resident afterwards.
+
+        Waves up to `stage_wave_pages` pay ONE extract dispatch. Bigger
+        reclaim waves run double-buffered dispatch-then-drain: wave i+1's
+        gather + D2H copy is dispatched BEFORE wave i's payloads are
+        admitted, so the device→host DMA overlaps the admit's
+        serialization + loopback TCP put + event emission instead of
+        serializing behind it.
 
         Blocks with an in-flight eager snapshot (stage_async) are claimed
         and admitted inline — their content was captured at snapshot time
@@ -465,8 +579,36 @@ class TieredKVStore:
                     fresh.append(block)
         if not fresh:
             return n_resident
-        payloads = self.codec.extract_many([b[3] for b in fresh])
-        return n_resident + self._admit_payloads(fresh, payloads)
+        wave = self.stage_wave_pages
+        if len(fresh) <= wave:
+            payloads = self.codec.extract_many([b[3] for b in fresh])
+            return n_resident + self._admit_payloads(fresh, payloads)
+        # Dispatch-then-drain double buffering: at most one un-drained wave
+        # in flight beyond the one being dispatched, so pending gather
+        # outputs stay bounded at 2 waves of pages.
+        pending: List[tuple] = []
+        for start in range(0, len(fresh), wave):
+            w = fresh[start:start + wave]
+            try:
+                resolve = self.codec.extract_many_async([b[3] for b in w])
+            except Exception as e:  # noqa: BLE001 - wave is best-effort
+                logger.debug("stage wave dispatch failed: %s", e)
+                continue
+            pending.append((w, resolve))
+            self.stats["stage_waves"] += 1
+            if len(pending) >= 2:
+                n_resident += self._drain_stage_wave(*pending.pop(0))
+        for w, resolve in pending:
+            n_resident += self._drain_stage_wave(w, resolve)
+        return n_resident
+
+    def _drain_stage_wave(self, blocks: List[tuple], resolve) -> int:
+        try:
+            payloads = resolve()
+        except Exception as e:  # noqa: BLE001 - wave is best-effort
+            logger.debug("stage wave resolve failed: %s", e)
+            return 0
+        return self._admit_payloads(blocks, payloads)
 
     def _admit_payloads(self, blocks: List[tuple], payloads: List[bytes]) -> int:
         """Admit extracted payloads to the host store (capacity-evicting).

@@ -63,6 +63,27 @@ class IndexerConfig:
     )
 
 
+@dataclass
+class PodScores:
+    """Read-path result carrying the routing signal AND the transfer-plane
+    signal. `scores` is what `get_pod_scores` always returned (post
+    fleet-health filtering). `match_blocks` is each pod's matched-prefix
+    length in blocks (pre-filter — a demoted pod's cache state is still
+    real), and `block_hashes` is the prompt's chain in order, so the exact
+    set of blocks any pod will MISS is `block_hashes[match_blocks[pod]:]` —
+    known at routing time, and the input the route-driven prefetcher feeds
+    the chosen pod before the engine faults on it."""
+
+    scores: Dict[str, float] = field(default_factory=dict)
+    match_blocks: Dict[str, int] = field(default_factory=dict)
+    block_hashes: List[int] = field(default_factory=list)
+
+    def missing_tail(self, pod_identifier: str) -> List[int]:
+        """Chain hashes the pod does not hold as a leading prefix — what a
+        router should hand the pod's prefetch queue when choosing it."""
+        return self.block_hashes[self.match_blocks.get(pod_identifier, 0):]
+
+
 class Indexer:
     """KV-cache-aware pod scorer over a fleet of vLLM-TPU pods."""
 
@@ -125,6 +146,26 @@ class Indexer:
         {pod_identifier: score}; pods without hits are absent. `lora_id`
         scopes the lookup to blocks cached under that adapter.
         """
+        return self.get_pod_scores_ex(
+            prompt, model_name, pod_identifiers,
+            render_request=render_request, lora_id=lora_id,
+        ).scores
+
+    def get_pod_scores_ex(
+        self,
+        prompt: str,
+        model_name: str,
+        pod_identifiers: Sequence[str],
+        render_request=None,
+        lora_id=None,
+    ) -> PodScores:
+        """`get_pod_scores` plus the transfer-plane signal: per-pod matched
+        prefix lengths and the prompt's block-hash chain. The scores dict
+        is bit-identical to `get_pod_scores` (same derivation, same scorer
+        arithmetic, same fleet-health filtering); the extra fields let the
+        router drive the data plane's prefetch queue with the exact blocks
+        the chosen pod will miss, instead of discarding what the scorer
+        already computed."""
         # Same validation as the event-ingest side (kvevents/pool.py): an
         # invalid adapter id degrades to the base keyspace rather than
         # hashing into a keyspace no event can ever populate.
@@ -145,7 +186,7 @@ class Indexer:
                 "tokenization pool overloaded; returning empty scores for model %s",
                 model_name,
             )
-            return {}
+            return PodScores()
 
         # The pool's prefix-store boundary state rides along so the chain
         # memo can resume key derivation at the first novel block of a
@@ -156,10 +197,10 @@ class Indexer:
         )
         if not block_keys:
             kvlog.trace(logger, "no block keys for prompt, returning empty scores")
-            return {}
+            return PodScores()
 
         key_to_pods = self.kv_block_index.lookup(block_keys, set(pod_identifiers))
-        scores = self.scorer.score(block_keys, key_to_pods)
+        scores, match_blocks = self.scorer.score_ex(block_keys, key_to_pods)
         if self.fleet_health is not None:
             # Degraded-mode scoring: suspect pods demoted, stale pods
             # excluded. An emptied map is the explicit no-cache-signal
@@ -167,4 +208,8 @@ class Indexer:
             # instead of routing to phantom placements.
             scores = self.fleet_health.filter_scores(scores)
         kvlog.trace(logger, "pod scores: %s", scores)
-        return scores
+        return PodScores(
+            scores=scores,
+            match_blocks=match_blocks,
+            block_hashes=[k.chunk_hash for k in block_keys],
+        )

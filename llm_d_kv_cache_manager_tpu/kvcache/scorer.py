@@ -12,7 +12,7 @@ consecutive cached prefix.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from llm_d_kv_cache_manager_tpu.kvcache.backend import (
     KVCacheBackendConfig,
@@ -80,6 +80,38 @@ class LongestPrefixScorer:
         # Pods that dropped out keep the score accumulated so far; pods that
         # never held block 0 were never admitted to `scores`.
         return scores
+
+    def score_ex(
+        self,
+        keys: Sequence[Key],
+        key_to_pods: Dict[Key, List[PodEntry]],
+    ) -> Tuple[Dict[str, float], Dict[str, int]]:
+        """(scores, match_blocks): `scores` is bit-identical to `score()`
+        (same maxes over the same floats, same addition order);
+        `match_blocks[pod]` is the pod's matched-prefix LENGTH in blocks —
+        how many consecutive leading keys it holds. The scorer walks that
+        prefix anyway to accumulate the score; keeping the count is what
+        lets the router hand the data plane the exact tail of the chain
+        the chosen pod will miss (the route-driven prefetch), instead of
+        throwing the information away after ranking."""
+        if not keys:
+            return {}, {}
+
+        weights = self.medium_weights
+        scores = _pod_max_weights(key_to_pods.get(keys[0], []), weights)
+        active = set(scores)
+        match = dict.fromkeys(active, 1)
+
+        for key in keys[1:]:
+            if not active:
+                break
+            here = _pod_max_weights(key_to_pods.get(key, []), weights)
+            active &= here.keys()
+            for pod in active:
+                scores[pod] += here[pod]
+                match[pod] += 1
+
+        return scores, match
 
 
 def new_kv_block_scorer(config: Optional[KVBlockScorerConfig] = None) -> LongestPrefixScorer:

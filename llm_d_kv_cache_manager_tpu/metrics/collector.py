@@ -53,6 +53,11 @@ event_stream_anomalies: Optional[Counter] = None
 # Redis backend connection lifecycle (kvblock/redis_index.py):
 # down -> backoff -> up, made operator-visible instead of silently retried.
 redis_state_transitions: Optional[Counter] = None
+# Transfer plane (kv_connectors/): a DCN fetch that exhausted its bounded
+# timeout/retry budget (the blocks degrade to cache misses), and blocks
+# queued by the route-driven prefetcher (kv_connectors/prefetch.py).
+transfer_failures: Optional[Counter] = None
+route_prefetch_blocks: Optional[Counter] = None
 
 _registered = False
 _register_lock = threading.Lock()
@@ -68,6 +73,7 @@ def register_metrics(registry=None) -> None:
     global events_dropped, tokenization_rejected
     global pod_state_transitions, stale_entries_purged
     global event_stream_anomalies, redis_state_transitions
+    global transfer_failures, route_prefetch_blocks
 
     with _register_lock:
         if _registered:
@@ -169,6 +175,17 @@ def register_metrics(registry=None) -> None:
             labelnames=("state",),
             registry=reg,
         )
+        transfer_failures = Counter(
+            "kvcache_transfer_failures_total",
+            "KV-block transfers that exhausted their bounded timeout/retry "
+            "budget (the blocks degraded to cache misses)",
+            registry=reg,
+        )
+        route_prefetch_blocks = Counter(
+            "kvcache_route_prefetch_blocks_total",
+            "KV blocks queued for prefetch by the route-driven prefetcher",
+            registry=reg,
+        )
         _registered = True
 
 
@@ -225,6 +242,16 @@ def count_stream_anomaly(kind: str) -> None:
 def count_redis_transition(state: str) -> None:
     if redis_state_transitions is not None:
         redis_state_transitions.labels(state=state).inc()
+
+
+def count_transfer_failure(n: int = 1) -> None:
+    if transfer_failures is not None and n:
+        transfer_failures.inc(n)
+
+
+def count_route_prefetch(n: int) -> None:
+    if route_prefetch_blocks is not None and n:
+        route_prefetch_blocks.inc(n)
 
 
 def start_metrics_logging(interval_s: float = 60.0) -> None:

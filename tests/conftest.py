@@ -27,19 +27,30 @@ import pytest  # noqa: E402
 
 
 def pytest_collection_modifyitems(config, items):
-    """Skip `native`-marked tests with a visible reason when the C hash
-    extension isn't built, instead of erroring or silently passing."""
+    """Skip `native`/`transfer`-marked tests with a visible reason when the
+    corresponding native component isn't built, instead of erroring or
+    silently passing."""
     from llm_d_kv_cache_manager_tpu.kvcache.kvblock import hashing
 
-    if hashing.have_native():
-        return
-    skip = pytest.mark.skip(
-        reason="native C extension (_kvtpu_native with batch API) not built "
-        "— run `make native` or `pip install -e native/`"
-    )
-    for item in items:
-        if "native" in item.keywords:
-            item.add_marker(skip)
+    if not hashing.have_native():
+        skip = pytest.mark.skip(
+            reason="native C extension (_kvtpu_native with batch API) not "
+            "built — run `make native` or `pip install -e native/`"
+        )
+        for item in items:
+            if "native" in item.keywords:
+                item.add_marker(skip)
+
+    from llm_d_kv_cache_manager_tpu.kv_connectors import connector
+
+    if not connector.native_available():
+        skip = pytest.mark.skip(
+            reason="kv transfer engine (libkvtransfer.so) not built — run "
+            "`make kvtransfer`"
+        )
+        for item in items:
+            if "transfer" in item.keywords:
+                item.add_marker(skip)
 
 
 FIXTURES_DIR = os.path.join(os.path.dirname(__file__), "fixtures")

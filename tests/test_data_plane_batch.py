@@ -22,9 +22,6 @@ from llm_d_kv_cache_manager_tpu.engine.engine import (
     EnginePodConfig,
     _DevicePageCodec,
 )
-from llm_d_kv_cache_manager_tpu.kv_connectors.connector import native_available
-
-
 def _model_pod(quantized=False, **over):
     from llm_d_kv_cache_manager_tpu.models import llama
 
@@ -262,7 +259,7 @@ class TestChainRestore:
         assert bm.num_free_pages == free_before  # nothing leaked
 
 
-@pytest.mark.skipif(not native_available(), reason="libkvtransfer.so not built")
+@pytest.mark.transfer
 class TestTieredBatchIntegration:
     def test_onboard_chain_lands_in_one_insert_dispatch(self):
         """Pod B onboards pod A's 3-block prefix through ONE codec

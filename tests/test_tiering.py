@@ -21,7 +21,6 @@ import pytest
 from llm_d_kv_cache_manager_tpu.engine.costs import ALWAYS_TRANSFER
 from llm_d_kv_cache_manager_tpu.engine.engine import EnginePod, EnginePodConfig
 from llm_d_kv_cache_manager_tpu.engine.tiering import IndexBackedPeerResolver
-from llm_d_kv_cache_manager_tpu.kv_connectors.connector import native_available
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import InMemoryIndex
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
     ChunkedTokenDatabase,
@@ -30,9 +29,7 @@ from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
 from llm_d_kv_cache_manager_tpu.kvevents.events import BlockRemoved, BlockStored
 from llm_d_kv_cache_manager_tpu.kvevents.pool import EventPool, EventPoolConfig, Message
 
-pytestmark = pytest.mark.skipif(
-    not native_available(), reason="libkvtransfer.so not built"
-)
+pytestmark = pytest.mark.transfer  # conftest auto-skips when lib absent
 
 
 def _events(batches, cls, medium=None):

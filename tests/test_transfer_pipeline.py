@@ -1,0 +1,527 @@
+"""Pipelined transfer-plane tests: the PR-5 data-plane paths.
+
+Covers the end-to-end pipelining this round adds on top of the raw
+connector (tests/test_kv_connectors.py):
+
+- double-buffered staging (`_stage_many` dispatch-then-drain waves),
+- batched + waved chain onboard (`load_chain` multi-block DCN fetches,
+  per-wave H2D inserts, byte-identical to the serial path),
+- route-driven prefetch (scorer match lengths → Indexer.get_pod_scores_ex
+  → RoutePrefetcher → TieredKVStore ready buffer),
+- prefetcher idempotence when the engine races it,
+- bounded timeout/retry against a killed transfer server.
+
+Pure-host pieces (scorer, indexer threading, fake-codec tiering) run
+everywhere; `transfer`-marked tests need libkvtransfer.so and are
+auto-skipped with a visible reason when it is absent.
+"""
+
+import threading
+import time
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.engine.costs import ALWAYS_TRANSFER, STAGED
+from llm_d_kv_cache_manager_tpu.engine.tiering import PageCodec, TieredKVStore
+from llm_d_kv_cache_manager_tpu.kv_connectors.prefetch import RoutePrefetcher
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.scorer import new_kv_block_scorer
+
+
+# -- fakes --------------------------------------------------------------------
+
+
+class FakeConnector:
+    """Dict-backed host store + a scripted peer; records batching shape."""
+
+    def __init__(self, peer_blocks=None):
+        self.store = {}
+        self.peer_blocks = peer_blocks or {}
+        self.calls = []  # ("staged"|"staged_many"|"peer"|"peer_many", arg)
+
+    def stage(self, block_hash, payload, token_ids, block_size,
+              parent_hash=None, lora_id=None):
+        self.store[block_hash] = payload
+
+    def drop(self, block_hash):
+        self.store.pop(block_hash, None)
+
+    def fetch_staged(self, block_hash, max_size):
+        self.calls.append(("staged", block_hash))
+        return self.store.get(block_hash)
+
+    def fetch_staged_many(self, block_hashes, max_size):
+        self.calls.append(("staged_many", list(block_hashes)))
+        return [self.store.get(h) for h in block_hashes]
+
+    def onboard_payload(self, host, port, block_hash, max_size):
+        self.calls.append(("peer", block_hash))
+        return self.peer_blocks.get(block_hash)
+
+    def onboard_payloads(self, host, port, block_hashes, max_size):
+        self.calls.append(("peer_many", list(block_hashes)))
+        return [self.peer_blocks.get(h) for h in block_hashes]
+
+
+class CountingCodec(PageCodec):
+    """Payload = page id as bytes; counts dispatch shapes."""
+
+    page_nbytes = 8
+
+    def __init__(self):
+        self.extract_calls = []
+        self.async_calls = []
+        self.insert_calls = []
+
+    @staticmethod
+    def payload(page_id: int) -> bytes:
+        return page_id.to_bytes(8, "little")
+
+    def extract_many(self, page_ids):
+        self.extract_calls.append(len(page_ids))
+        return [self.payload(i) for i in page_ids]
+
+    def extract_many_async(self, page_ids):
+        ids = list(page_ids)
+        self.async_calls.append(len(ids))
+        return lambda: [self.payload(i) for i in ids]
+
+    def insert_many(self, items):
+        self.insert_calls.append([(pid, p) for pid, p in items])
+
+
+def _block(i):
+    return (1000 + i, [i], None, i, None)
+
+
+# -- double-buffered staging --------------------------------------------------
+
+
+class TestStageWaves:
+    def test_small_wave_stays_one_extract_dispatch(self):
+        codec = CountingCodec()
+        store = TieredKVStore(FakeConnector(), codec, stage_wave_pages=16)
+        assert store._stage_many([_block(i) for i in range(5)]) == 5
+        assert codec.extract_calls == [5] and codec.async_calls == []
+        store.close()
+
+    def test_big_wave_double_buffers_and_stages_everything(self):
+        """A reclaim wave beyond stage_wave_pages splits into async waves
+        (dispatch-then-drain); every block lands with the exact payload the
+        one-shot extract would have produced."""
+        codec = CountingCodec()
+        conn = FakeConnector()
+        store = TieredKVStore(conn, codec, stage_wave_pages=4)
+        blocks = [_block(i) for i in range(11)]
+        assert store._stage_many(blocks) == 11
+        assert codec.extract_calls == []  # no synchronous one-shot
+        assert codec.async_calls == [4, 4, 3]  # the wave ladder
+        assert store.stats["stage_waves"] == 3
+        for i in range(11):
+            assert conn.store[1000 + i] == CountingCodec.payload(i)
+        # Re-staging is a pure membership hit — no new dispatches.
+        assert store._stage_many(blocks) == 11
+        assert codec.async_calls == [4, 4, 3]
+        store.close()
+
+
+# -- batched + waved chain onboard -------------------------------------------
+
+
+class TestPipelinedLoadChain:
+    def test_peer_run_fetches_in_one_batch(self):
+        peer = {1000 + i: CountingCodec.payload(i) for i in range(6)}
+        codec = CountingCodec()
+        conn = FakeConnector(peer_blocks=peer)
+        store = TieredKVStore(
+            conn, codec, peer_resolver=lambda h: ("p", 1),
+            onboard_wave_blocks=8, fetch_batch_blocks=32,
+        )
+        blocks = [(1000 + i, [i], None) for i in range(6)]
+        landed = store.load_chain(blocks, lambda k: list(range(k)))
+        assert landed == [0, 1, 2, 3, 4, 5]
+        # ONE multi-block round trip, not six.
+        assert conn.calls == [("peer_many", [1000 + i for i in range(6)])]
+        assert store.stats["onboards"] == 6
+        assert store.stats["batched_fetches"] == 1
+        # Byte-for-byte identical landing to the serial per-block protocol.
+        assert codec.insert_calls == [
+            [(i, CountingCodec.payload(i)) for i in range(6)]
+        ]
+        store.close()
+
+    def test_long_chain_lands_in_waves_overlapping_fetches(self):
+        peer = {1000 + i: CountingCodec.payload(i) for i in range(10)}
+        codec = CountingCodec()
+        conn = FakeConnector(peer_blocks=peer)
+        store = TieredKVStore(
+            conn, codec, peer_resolver=lambda h: ("p", 1),
+            onboard_wave_blocks=4, fetch_batch_blocks=32,
+        )
+        blocks = [(1000 + i, [i], None) for i in range(10)]
+        taken = []
+
+        def take_pages(k):
+            got = list(range(len(taken), len(taken) + k))
+            taken.extend(got)
+            return got
+
+        landed = store.load_chain(blocks, take_pages)
+        assert landed == list(range(10))
+        # Waves of onboard_wave_blocks: each insert covers only
+        # already-fetched payloads (fetch-before-take per wave).
+        assert [len(c) for c in codec.insert_calls] == [4, 4, 2]
+        flat = [item for call in codec.insert_calls for item in call]
+        assert flat == [(i, CountingCodec.payload(i)) for i in range(10)]
+        store.close()
+
+    def test_chain_stops_at_first_missing_block_in_batch(self):
+        peer = {1000: CountingCodec.payload(0), 1001: CountingCodec.payload(1),
+                1003: CountingCodec.payload(3)}  # 1002 missing
+        codec = CountingCodec()
+        store = TieredKVStore(
+            FakeConnector(peer_blocks=peer), codec,
+            peer_resolver=lambda h: ("p", 1),
+        )
+        blocks = [(1000 + i, [i], None) for i in range(4)]
+        landed = store.load_chain(blocks, lambda k: list(range(k)))
+        assert landed == [0, 1]  # the hole cuts the chain
+        assert store.stats["onboards"] == 2
+        store.close()
+
+    def test_mixed_sources_interleave_correctly(self):
+        """ready → staged → peer-batch in chain order, stats truthful."""
+        codec = CountingCodec()
+        peer = {1002: b"p2", 1003: b"p3"}
+        conn = FakeConnector(peer_blocks=peer)
+        store = TieredKVStore(
+            conn, codec, peer_resolver=lambda h: ("p", 1),
+        )
+        conn.store[1001] = b"s1"  # host-staged
+        with store._mu:
+            store._staged[1001] = None
+            store._ready[1000] = (b"r0", STAGED)  # prefetched
+        blocks = [(1000 + i, [i], None) for i in range(4)]
+        landed = store.load_chain(blocks, lambda k: list(range(k)))
+        assert landed == [0, 1, 2, 3]
+        assert codec.insert_calls == [
+            [(0, b"r0"), (1, b"s1"), (2, b"p2"), (3, b"p3")]
+        ]
+        assert store.stats["ready_hits"] == 1
+        assert store.stats["restores"] == 2  # ready(STAGED) + staged
+        assert store.stats["onboards"] == 2
+        # The peer leg batched the 2-block run.
+        assert ("peer_many", [1002, 1003]) in conn.calls
+        store.close()
+
+
+# -- prefetcher ---------------------------------------------------------------
+
+
+class TestBatchedPrefetch:
+    def test_prefetch_uses_batched_fetches(self):
+        conn = FakeConnector(
+            peer_blocks={1005: b"p5", 1006: b"p6"}
+        )
+        for i in range(3):
+            conn.store[1000 + i] = b"s%d" % i
+        store = TieredKVStore(
+            conn, CountingCodec(), peer_resolver=lambda h: ("p", 1),
+        )
+        with store._mu:
+            store._staged.update({1000 + i: None for i in range(3)})
+        queued = store.prefetch([1000, 1001, 1002, 1005, 1006])
+        assert queued == 5
+        for _ in range(200):
+            if store.stats["prefetched"] == 5:
+                break
+            time.sleep(0.01)
+        assert store.stats["prefetched"] == 5
+        kinds = [kind for kind, _ in conn.calls]
+        assert "staged_many" in kinds and "peer_many" in kinds
+        assert ("staged", 1000) not in conn.calls  # no per-block loopback
+        store.close()
+
+    def test_prefetch_idempotent_when_engine_races_it(self):
+        """The engine's load_chain and the background prefetcher race for
+        the same blocks: whatever interleaving happens, each block lands at
+        most once per load_chain and the payload bytes are always the
+        store's bytes."""
+        n = 24
+        conn = FakeConnector()
+        codec = CountingCodec()
+        for i in range(n):
+            conn.store[1000 + i] = CountingCodec.payload(i)
+        store = TieredKVStore(conn, codec, cost_model=ALWAYS_TRANSFER)
+        with store._mu:
+            store._staged.update({1000 + i: None for i in range(n)})
+        blocks = [(1000 + i, [i], None) for i in range(n)]
+        stop = threading.Event()
+
+        def spam_prefetch():
+            while not stop.is_set():
+                store.prefetch([1000 + i for i in range(n)])
+                time.sleep(0.001)
+
+        t = threading.Thread(target=spam_prefetch, daemon=True)
+        t.start()
+        try:
+            for _ in range(20):
+                taken = []
+
+                def take_pages(k):
+                    got = list(range(len(taken), len(taken) + k))
+                    taken.extend(got)
+                    return got
+
+                landed = store.load_chain(blocks, take_pages)
+                assert landed == list(range(n))
+                flat = [x for call in codec.insert_calls for x in call]
+                assert flat == [
+                    (i, CountingCodec.payload(i)) for i in range(n)
+                ], "raced landing corrupted payload/order"
+                codec.insert_calls.clear()
+        finally:
+            stop.set()
+            t.join(timeout=5)
+            store.close()
+
+
+# -- route-driven prefetch ----------------------------------------------------
+
+
+class TestRouteSignal:
+    def _keyspace(self):
+        keys = [Key("m", h) for h in (11, 12, 13, 14)]
+        key_to_pods = {
+            keys[0]: [PodEntry("a", "hbm"), PodEntry("b", "hbm")],
+            keys[1]: [PodEntry("a", "hbm"), PodEntry("b", "host")],
+            keys[2]: [PodEntry("a", "hbm")],
+            keys[3]: [],
+        }
+        return keys, key_to_pods
+
+    def test_score_ex_matches_score_and_counts_match_blocks(self):
+        scorer = new_kv_block_scorer()
+        keys, key_to_pods = self._keyspace()
+        scores, match = scorer.score_ex(keys, key_to_pods)
+        assert scores == scorer.score(keys, key_to_pods)  # bit-identical
+        assert match == {"a": 3, "b": 2}
+
+    def test_score_ex_empty(self):
+        scorer = new_kv_block_scorer()
+        assert scorer.score_ex([], {}) == ({}, {})
+
+    def test_route_prefetcher_executes_submitted_tails(self):
+        got = []
+        rp = RoutePrefetcher(lambda pod, hashes: got.append((pod, hashes)) or len(hashes))
+        assert rp.submit("pod-1", [5, 6, 7])
+        assert not rp.submit("pod-1", [])  # empty tail: nothing to do
+        rp.drain()
+        assert got == [("pod-1", [5, 6, 7])]
+        assert rp.stats["executed"] == 1
+        assert rp.stats["blocks_queued"] == 3
+        rp.close()
+
+    def test_route_prefetcher_bounded_queue_drops_not_blocks(self):
+        gate = threading.Event()
+
+        def slow(pod, hashes):
+            gate.wait(5.0)
+            return 0
+
+        rp = RoutePrefetcher(slow, queue_bound=2)
+        t0 = time.time()
+        results = [rp.submit("p", [i]) for i in range(8)]
+        assert time.time() - t0 < 1.0  # submission never blocked routing
+        assert results.count(False) >= 5  # overflow dropped, counted
+        assert rp.stats["dropped"] >= 5
+        gate.set()
+        rp.close()
+
+    def test_prefetch_fn_errors_do_not_kill_worker(self):
+        calls = []
+
+        def flaky(pod, hashes):
+            calls.append(pod)
+            if len(calls) == 1:
+                raise RuntimeError("pod unreachable")
+            return len(hashes)
+
+        rp = RoutePrefetcher(flaky)
+        rp.submit("p1", [1])
+        rp.submit("p2", [2])
+        rp.drain()
+        assert calls == ["p1", "p2"]
+        assert rp.stats["executed"] == 1  # the failed one isn't counted
+        rp.close()
+
+
+@pytest.mark.transfer
+class TestRouteDrivenPrefetchEndToEnd:
+    def test_router_tail_lands_in_ready_buffer_before_fault(self, test_tokenizer_files):
+        """Full loop: pod A computes a prefix and stages it; the indexer
+        scores a prompt, the router picks cold pod B, the route prefetcher
+        submits B's missing tail, and B's prefill then consumes every block
+        from the READY buffer (ready_hits == chain length) — the DCN
+        fetches happened off the critical path."""
+        from llm_d_kv_cache_manager_tpu.engine.engine import (
+            EnginePod,
+            EnginePodConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.engine.tiering import (
+            IndexBackedPeerResolver,
+        )
+        from llm_d_kv_cache_manager_tpu.kvcache.indexer import (
+            Indexer,
+            IndexerConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+            TokenProcessorConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.kvevents.pool import (
+            EventPool,
+            EventPoolConfig,
+            Message,
+        )
+        from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+            TokenizationPool,
+            TokenizersPoolConfig,
+        )
+
+        model = "test-model"
+        page_size = 4
+        tok_pool = TokenizationPool(TokenizersPoolConfig(
+            workers=1, local_tokenizer_files=test_tokenizer_files,
+        ))
+        indexer = Indexer(
+            IndexerConfig(token_processor_config=TokenProcessorConfig(
+                block_size=page_size,
+            )),
+            tokenization_pool=tok_pool,
+        )
+        indexer.run()
+        pool = EventPool(
+            EventPoolConfig(concurrency=1), indexer.kv_block_index,
+            indexer.token_processor,
+        )
+        pool.start(with_subscriber=False)
+
+        def sink_for(pod_id):
+            def sink(batch):
+                pool.add_task(Message(
+                    topic=f"kv@{pod_id}@{model}", payload=batch.to_msgpack(),
+                    seq=0, pod_identifier=pod_id, model_name=model,
+                ))
+            return sink
+
+        def pod(pod_id):
+            return EnginePod(
+                EnginePodConfig(
+                    pod_id=pod_id, model_name=model, n_pages=16,
+                    page_size=page_size, device_tier="hbm",
+                    enable_host_tier=True, transfer_cost_model=None,
+                ),
+                event_sink=sink_for(pod_id),
+            )
+
+        pod_a, pod_b = pod("pod-a"), pod("pod-b")
+        pods = {"pod-a": pod_a, "pod-b": pod_b}
+        rp = RoutePrefetcher(
+            lambda pid, hashes: pods[pid].prefetch_hashes(hashes)
+        )
+        try:
+            prompt = "the quick brown fox jumps over the lazy dog again and again"
+            tokens = tok_pool.tokenize(None, prompt, model)
+            state_a, _ = pod_a.prefill(tokens)
+            assert pod_a.export_sequence(state_a) >= 2
+            pool.drain()
+
+            pod_b.set_peer_resolver(IndexBackedPeerResolver(
+                indexer.kv_block_index, model,
+                {"pod-a": pod_a.transfer_address}, "pod-b",
+            ))
+
+            ex = indexer.get_pod_scores_ex(prompt, model, [])
+            assert ex.scores and "pod-a" in ex.scores
+            assert ex.scores == indexer.get_pod_scores(prompt, model, [])
+            n_chain = len(ex.block_hashes)
+            assert ex.match_blocks["pod-a"] == n_chain
+            # Router chooses COLD pod B: its whole chain is the tail.
+            tail = ex.missing_tail("pod-b")
+            assert tail == ex.block_hashes
+            assert rp.submit_route("pod-b", ex)
+            rp.drain()
+            for _ in range(300):
+                if pod_b.tier_store.stats["prefetched"] >= n_chain:
+                    break
+                time.sleep(0.01)
+            assert pod_b.tier_store.stats["prefetched"] >= n_chain
+
+            state_b, cached = pod_b.prefill(tokens)
+            assert cached == n_chain * page_size
+            # Every block came off the ready buffer — zero critical-path
+            # DCN fetches.
+            assert pod_b.tier_store.stats["ready_hits"] == n_chain
+        finally:
+            rp.close()
+            pod_a.close()
+            pod_b.close()
+            pool.shutdown()
+            indexer.shutdown()
+
+
+# -- bounded failure ----------------------------------------------------------
+
+
+@pytest.mark.transfer
+class TestTimeoutUnderKilledServer:
+    def test_fetch_after_server_death_returns_none_bounded(self):
+        from llm_d_kv_cache_manager_tpu.kv_connectors.connector import (
+            BlockTransferServer,
+            TransferClient,
+            TransferClientConfig,
+        )
+
+        server = BlockTransferServer()
+        port = server.port
+        client = TransferClient(TransferClientConfig(
+            connect_timeout_ms=400, io_timeout_ms=400, retries=1,
+        ))
+        assert client.fetch_one("127.0.0.1", port, 1, 64) is None  # miss
+        server.put(1, b"alive")
+        assert client.fetch_one("127.0.0.1", port, 1, 64) == b"alive"
+        server.close()  # kill the peer with the keep-alive conn open
+        t0 = time.time()
+        got = client.fetch_many("127.0.0.1", port, [1, 2, 3], 64)
+        dt = time.time() - t0
+        assert got == [None, None, None]
+        assert dt < 5.0  # bounded: reconnect attempts time out fast
+        assert client.stats["failures"] >= 1
+        client.close()
+
+    def test_load_chain_degrades_on_dead_peer(self):
+        """A dead peer mid-chain cuts the restore instead of wedging the
+        allocation path; the engine recomputes the tail."""
+        from llm_d_kv_cache_manager_tpu.kv_connectors.connector import (
+            KVConnector,
+            KVConnectorConfig,
+        )
+
+        conn = KVConnector(KVConnectorConfig(
+            connect_timeout_ms=300, fetch_timeout_ms=300, fetch_retries=0,
+        ))
+        codec = CountingCodec()
+        store = TieredKVStore(
+            conn, codec, peer_resolver=lambda h: ("127.0.0.1", 1),  # dead
+        )
+        try:
+            t0 = time.time()
+            landed = store.load_chain(
+                [(1, [0], None), (2, [1], None)], lambda k: list(range(k))
+            )
+            assert landed == [] and time.time() - t0 < 5.0
+            assert store.stats["onboards"] == 0
+        finally:
+            store.close()
+            conn.close()
