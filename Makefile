@@ -2,8 +2,9 @@
 # build/test/bench/lint/image-build/image-push + pre-commit install —
 # /root/reference/Makefile, /root/reference/hooks/pre-commit.sh).
 
-.PHONY: native kvtransfer test bench bench-micro bench-read bench-faults \
-	bench-transfer clean proto lint precommit-install image-build image-push
+.PHONY: native kvtransfer test bench bench-micro bench-read bench-obs \
+	bench-faults bench-transfer clean proto lint precommit-install \
+	image-build image-push
 
 # Container image coordinates (override per environment/registry). The
 # release workflow (.github/workflows/ci-release.yaml) builds the same
@@ -65,6 +66,13 @@ bench-micro:
 # Full mode (rewrites MICRO_BENCH.json): python benchmarking/micro_bench.py
 bench-read:
 	JAX_PLATFORMS=cpu python benchmarking/micro_bench.py --quick --legs read
+
+# Tracing-spine legs only (obs/): enabled-tracing overhead on the warm
+# read path (A/B/A trials) + per-stage attribution of the read/write/
+# transfer planes from flight-recorder traces. Full mode (rewrites
+# MICRO_BENCH.json): python benchmarking/micro_bench.py
+bench-obs:
+	JAX_PLATFORMS=cpu python benchmarking/micro_bench.py --quick --legs obs
 
 # Fault-injection fleet scenario (fleethealth/): pod crash/restart, event
 # stall, lossy/reordering streams over the synthetic chat workload.

@@ -749,6 +749,84 @@ def micro_section() -> str:
     return "\n".join(out)
 
 
+def obs_section() -> str:
+    """Tracing-spine legs from MICRO_BENCH.json: per-stage attribution of
+    the three planes + the enabled-tracing overhead on the warm read
+    path (ISSUE 6 acceptance: <5% p50)."""
+    path = os.path.join(HERE, "MICRO_BENCH.json")
+    if not os.path.exists(path):
+        return (
+            "_Not yet recorded — run `python benchmarking/micro_bench.py`._"
+        )
+    d = _load(path)
+    ov = d.get("obs_overhead")
+    attr = d.get("stage_attribution")
+    if not ov or not attr:
+        return (
+            "_Tracing legs not in the committed MICRO_BENCH.json — rerun "
+            "`python benchmarking/micro_bench.py`._"
+        )
+    out = [
+        f"Enabled-tracing tax on the warm `get_pod_scores` path: "
+        f"**{ov['overhead_pct']:+.1f}% p50** "
+        f"(+{ov['paired_delta_p50_us']} µs on "
+        f"{ov['read_path_p50_disabled_us']} µs, target <"
+        f"{ov['target_pct']:.0f}%; min over "
+        f"{len(ov['trial_deltas_us'])} trials of the median paired "
+        f"delta across {ov['pairs_per_trial']} alternating disabled/"
+        "enabled call pairs — per-call pairing cancels the machine "
+        "drift that dominates sequential arms, and interference only "
+        "inflates a paired delta, so the min is the highest-fidelity "
+        "estimate). Disabled mode is a shared no-op context "
+        "manager — the classic legs above run untraced and are directly "
+        "comparable with pre-obs rounds. Per-stage Prometheus "
+        "histograms (`kvcache_stage_latency_seconds`) observe every "
+        f"{ov['histogram_stride']}th trace (`ObsConfig.histogram_stride`).",
+    ]
+    for plane, title, caption in (
+        ("read", "Read plane (`Indexer.get_pod_scores`)", None),
+        (
+            "write",
+            "Write plane (`kvevents.EventPool`, every batch traced)",
+            "`write.queue_wait` runs from the enqueue stamp, so it can "
+            "exceed the digest window under a burst — that gap IS the "
+            "backlog signal (`kvcache_event_apply_delay_seconds` is the "
+            "per-batch metric form).",
+        ),
+        (
+            "transfer",
+            "Transfer plane (`TieredKVStore` orchestration, in-process "
+            "fake connector)",
+            "Orchestration cost only — DCN wire time is measured by "
+            "`device_bench.py --transfer` (§ device benchmarks).",
+        ),
+    ):
+        rows = attr.get(plane) or {}
+        if not rows:
+            continue
+        out += [
+            "",
+            f"{title}:",
+            "",
+            "| Stage | p50 (µs) | p90 (µs) | calls | share |",
+            "|---|---:|---:|---:|---:|",
+        ]
+        for name, r in rows.items():
+            out.append(
+                f"| `{name}` | {r['p50_us']} | {r['p90_us']} "
+                f"| {r['calls']} | {r['share_pct']}% |"
+            )
+        if caption:
+            out += ["", f"_{caption}_"]
+    out += [
+        "",
+        "_Share = stage time / summed trace windows; nested stages "
+        "overlap their parents, so shares need not sum to 100. Source: "
+        "`MICRO_BENCH.json` (`stage_attribution`, `obs_overhead`)._",
+    ]
+    return "\n".join(out)
+
+
 def regenerate(text: str) -> str:
     for name, body in (
         ("fleet", fleet_section()),
@@ -756,6 +834,7 @@ def regenerate(text: str) -> str:
         ("fleet-device", fleet_device_section()),
         ("device", device_section()),
         ("micro", micro_section()),
+        ("obs", obs_section()),
     ):
         pattern = re.compile(
             rf"(<!-- BEGIN GENERATED: {name} -->).*?(<!-- END GENERATED: {name} -->)",

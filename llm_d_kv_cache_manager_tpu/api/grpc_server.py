@@ -4,11 +4,19 @@ Parity target: the reference's gRPC service wrapper
 (/root/reference/examples/kv_cache_index_service/server/server.go:70-96) over
 api/indexer.proto. Message classes are protoc-generated (indexer_pb2); the
 service is wired with grpcio generic handlers (no grpc_tools codegen needed
-in this environment), exposing `kvtpu.api.v1.IndexerService/GetPodScores`.
+in this environment), exposing `kvtpu.api.v1.IndexerService/GetPodScores`
+plus the score-explain counterpart `ExplainScores`.
+
+`ExplainScores` reuses `GetPodScoresRequest` on the wire and returns the
+explain report as UTF-8 JSON bytes: this environment has no protoc to
+regenerate indexer_pb2 with new message types, and generic handlers make
+the serializer explicit anyway — the JSON body is the same document
+`GET /debug/score_explain` serves, so the two surfaces cannot drift.
 """
 
 from __future__ import annotations
 
+import json
 from concurrent import futures
 from typing import Dict
 
@@ -21,6 +29,7 @@ logger = kvlog.get_logger("api.grpc")
 
 SERVICE_NAME = "kvtpu.api.v1.IndexerService"
 METHOD_GET_POD_SCORES = "GetPodScores"
+METHOD_EXPLAIN_SCORES = "ExplainScores"
 
 
 def _make_handler(indexer):
@@ -43,12 +52,32 @@ def _make_handler(indexer):
             response.scores.append(pb.PodScore(pod_identifier=pod, score=score))
         return response
 
+    def explain_scores(
+        request: pb.GetPodScoresRequest, context: grpc.ServicerContext
+    ) -> dict:
+        try:
+            return indexer.explain_scores(
+                request.prompt,
+                request.model_name,
+                list(request.pod_identifiers),
+                lora_id=request.lora_id if request.HasField("lora_id") else None,
+            )
+        except Exception as e:  # noqa: BLE001 - surface as gRPC status
+            logger.warning("ExplainScores failed: %s", e)
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+            return {}
+
     rpc_handlers = {
         METHOD_GET_POD_SCORES: grpc.unary_unary_rpc_method_handler(
             get_pod_scores,
             request_deserializer=pb.GetPodScoresRequest.FromString,
             response_serializer=pb.GetPodScoresResponse.SerializeToString,
-        )
+        ),
+        METHOD_EXPLAIN_SCORES: grpc.unary_unary_rpc_method_handler(
+            explain_scores,
+            request_deserializer=pb.GetPodScoresRequest.FromString,
+            response_serializer=lambda d: json.dumps(d).encode("utf-8"),
+        ),
     }
     return grpc.method_handlers_generic_handler(SERVICE_NAME, rpc_handlers)
 
@@ -79,6 +108,11 @@ class IndexerGrpcClient:
             request_serializer=pb.GetPodScoresRequest.SerializeToString,
             response_deserializer=pb.GetPodScoresResponse.FromString,
         )
+        self._explain_call = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/{METHOD_EXPLAIN_SCORES}",
+            request_serializer=pb.GetPodScoresRequest.SerializeToString,
+            response_deserializer=lambda b: json.loads(b.decode("utf-8")),
+        )
 
     def get_pod_scores(
         self, prompt: str, model_name: str, pod_identifiers=(), lora_id=None
@@ -92,6 +126,21 @@ class IndexerGrpcClient:
             request.lora_id = lora_id
         response = self._call(request, timeout=self._timeout)
         return {s.pod_identifier: s.score for s in response.scores}
+
+    def explain_scores(
+        self, prompt: str, model_name: str, pod_identifiers=(), lora_id=None
+    ) -> dict:
+        """Score-explain counterpart: the same JSON report
+        `GET /debug/score_explain` serves (scores bit-identical to
+        `get_pod_scores`)."""
+        request = pb.GetPodScoresRequest(
+            prompt=prompt,
+            model_name=model_name,
+            pod_identifiers=list(pod_identifiers),
+        )
+        if lora_id is not None:
+            request.lora_id = lora_id
+        return self._explain_call(request, timeout=self._timeout)
 
     def close(self) -> None:
         self._channel.close()

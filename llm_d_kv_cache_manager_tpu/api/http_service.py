@@ -12,14 +12,27 @@ the ZMQ KVEvents write plane, and Prometheus metrics behind HTTP:
   GET  /health                  liveness (the process is up, nothing more)
   GET  /readyz                  readiness: event-plane state (subscriber
                                 thread + consecutive bind failures, shard
-                                queue depths, drop counters) and the
-                                per-pod fleet-health summary; 503 while
-                                the event plane cannot make progress
+                                queue depths, drop counters), the per-pod
+                                fleet-health summary, and the flight
+                                recorder's own health (`obs` section);
+                                503 while the event plane cannot make
+                                progress
+  GET  /debug/traces            flight recorder dump: recent complete
+                                traces + the slow-outlier reservoir
+                                (?n=<count> caps the recent list)
+  GET  /debug/score_explain     score with the decision evidence attached
+                                (per-pod matched prefix, fleet-health
+                                adjustment, chain-memo family, chosen
+                                pod); scores bit-identical to the scoring
+                                endpoints. Query params prompt/model/
+                                pods/lora_id, or POST the same JSON body
+                                as /score_completions.
 
 Env config mirrors the reference's variable set (online/main.go:41-58):
 ZMQ_ENDPOINT, ZMQ_TOPIC, POOL_CONCURRENCY, PYTHONHASHSEED (hash seed!),
 BLOCK_SIZE, BLOCK_HASH_ALGO, HTTP_PORT, HF_TOKEN, LOCAL_TOKENIZER_DIR,
-plus the fleet-health windows SUSPECT_AFTER_S / STALE_AFTER_S.
+the fleet-health windows SUSPECT_AFTER_S / STALE_AFTER_S, and the tracing
+spine knobs KVTPU_TRACE / KVTPU_TRACE_RING / KVTPU_TRACE_SLOW_MS.
 
 Run: python -m llm_d_kv_cache_manager_tpu.api.http_service
 """
@@ -33,6 +46,7 @@ from typing import Optional
 
 from aiohttp import web
 
+from llm_d_kv_cache_manager_tpu import obs
 from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.chain_memo import ChainMemoConfig
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import IndexConfig
@@ -83,6 +97,10 @@ def config_from_env() -> dict:
         # beyond these demotes / excludes-and-purges a pod.
         "suspect_after_s": float(os.environ.get("SUSPECT_AFTER_S", "30")),
         "stale_after_s": float(os.environ.get("STALE_AFTER_S", "120")),
+        # Tracing spine (obs/): per-request spans + flight recorder.
+        "trace_enabled": os.environ.get("KVTPU_TRACE", "1") == "1",
+        "trace_ring": int(os.environ.get("KVTPU_TRACE_RING", "256")),
+        "trace_slow_ms": float(os.environ.get("KVTPU_TRACE_SLOW_MS", "10")),
     }
 
 
@@ -92,6 +110,15 @@ class ScoringService:
     def __init__(self, env: Optional[dict] = None, indexer: Optional[Indexer] = None):
         env = env or config_from_env()
         self.env = env
+        # Tracing spine knobs (obs/). Only reconfigure when the env spells
+        # them out — embedded/test construction respects whatever the
+        # process already configured.
+        if "trace_enabled" in env:
+            obs.configure(obs.ObsConfig(
+                enabled=bool(env.get("trace_enabled", True)),
+                ring_capacity=int(env.get("trace_ring", 256)),
+                slow_threshold_s=float(env.get("trace_slow_ms", 10)) / 1e3,
+            ))
         self.templating = ChatTemplatingProcessor()
         self.fleet_health = FleetHealthTracker(FleetHealthConfig(
             suspect_after_s=float(env.get("suspect_after_s", 30.0)),
@@ -206,6 +233,62 @@ class ScoringService:
             {"podScores": scores, "templated_messages": rendered}
         )
 
+    async def handle_debug_traces(self, request: web.Request) -> web.Response:
+        """Flight-recorder dump: recent complete traces + slow outliers."""
+        n = None
+        if "n" in request.query:
+            try:
+                n = max(0, int(request.query["n"]))
+            except ValueError:
+                return web.json_response(
+                    {"error": "n must be an integer"}, status=400
+                )
+        snapshot = await asyncio.to_thread(obs.get_recorder().snapshot, n)
+        return web.json_response(snapshot)
+
+    async def handle_score_explain(self, request: web.Request) -> web.Response:
+        """Scores with the decision evidence attached. Same pipeline as
+        /score_completions (bit-identical scores); GET query params or the
+        same JSON body as the scoring endpoint."""
+        if request.method == "POST":
+            try:
+                body = await request.json()
+                prompt = body["prompt"]
+                model = body["model"]
+            except (json.JSONDecodeError, KeyError, TypeError) as e:
+                return web.json_response(
+                    {"error": f"invalid request: {e}"}, status=400
+                )
+            pods = body.get("pods", [])
+            lora_id = body.get("lora_id")
+        else:
+            prompt = request.query.get("prompt")
+            model = request.query.get("model")
+            if prompt is None or model is None:
+                return web.json_response(
+                    {"error": "prompt and model query params are required"},
+                    status=400,
+                )
+            pods = [
+                p for p in request.query.get("pods", "").split(",") if p
+            ]
+            lora_id = request.query.get("lora_id")
+            if lora_id is not None:
+                try:
+                    lora_id = int(lora_id)
+                except ValueError:
+                    return web.json_response(
+                        {"error": "lora_id must be an integer"}, status=400
+                    )
+        try:
+            explain = await asyncio.to_thread(
+                self.indexer.explain_scores, prompt, model, pods,
+                lora_id=lora_id,
+            )
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"error": str(e)}, status=500)
+        return web.json_response(explain)
+
     async def handle_metrics(self, request: web.Request) -> web.Response:
         from prometheus_client import REGISTRY, generate_latest
 
@@ -251,6 +334,10 @@ class ScoringService:
             # Read-path derivation cache effectiveness (observability only —
             # never gates readiness: a cold memo is a correct memo).
             "chain_memo": memo.stats() if memo is not None else None,
+            # Flight-recorder health (ring occupancy, dropped traces,
+            # slowest recent stage): degraded observability is itself
+            # observable, but never gates readiness.
+            "obs": obs.get_recorder().stats(),
         }
 
     async def handle_readyz(self, request: web.Request) -> web.Response:
@@ -267,6 +354,9 @@ class ScoringService:
         app.router.add_get("/metrics", self.handle_metrics)
         app.router.add_get("/health", self.handle_health)
         app.router.add_get("/readyz", self.handle_readyz)
+        app.router.add_get("/debug/traces", self.handle_debug_traces)
+        app.router.add_get("/debug/score_explain", self.handle_score_explain)
+        app.router.add_post("/debug/score_explain", self.handle_score_explain)
         return app
 
 

@@ -31,6 +31,7 @@ import threading
 from collections import OrderedDict
 from typing import TYPE_CHECKING, Callable, Dict, List, Mapping, Optional, Tuple
 
+from llm_d_kv_cache_manager_tpu import obs
 from llm_d_kv_cache_manager_tpu.engine.costs import (
     PEER,
     READY,
@@ -264,7 +265,15 @@ class TieredKVStore:
         with the block prefix) — fetches stop at the first miss so the
         hash chain never gets a hole, and fetch-before-take means a stale
         plan cannot evict HBM-cached pages for a restore that lands
-        nothing."""
+        nothing.
+
+        A root trace in the flight recorder (`transfer.load_chain`; a
+        nested stage when the caller is already traced), with the
+        staged/peer fetches and onboard waves as child spans."""
+        with obs.request("transfer.load_chain", {"blocks": len(blocks)}):
+            return self._load_chain_impl(blocks, take_pages)
+
+    def _load_chain_impl(self, blocks: List[tuple], take_pages) -> List[int]:
         landed: List[int] = []
         buffer: List[tuple] = []  # fetched, not yet landed: (payload, stat)
         cost_sources: List[str] = []  # what each fetched block actually cost
@@ -280,15 +289,16 @@ class TieredKVStore:
             if not buffer or exhausted:
                 buffer = []
                 return
-            page_ids = take_pages(len(buffer))
-            use = buffer[: len(page_ids)]
-            if use:
-                self.codec.insert_many(
-                    [(pid, p) for pid, (p, _) in zip(page_ids, use)]
-                )
-                for _, stat in use:
-                    self.stats[stat] += 1
-                landed.extend(page_ids[: len(use)])
+            with obs.stage("transfer.onboard_wave"):
+                page_ids = take_pages(len(buffer))
+                use = buffer[: len(page_ids)]
+                if use:
+                    self.codec.insert_many(
+                        [(pid, p) for pid, (p, _) in zip(page_ids, use)]
+                    )
+                    for _, stat in use:
+                        self.stats[stat] += 1
+                    landed.extend(page_ids[: len(use)])
             if len(use) < len(buffer):
                 exhausted = True
             buffer = []
@@ -381,25 +391,29 @@ class TieredKVStore:
         """One multi-block DCN round trip when the connector supports it
         (KVConnector.onboard_payloads); per-block fetches otherwise (fake
         connectors in tests, stale .so builds)."""
-        batched = getattr(self.connector, "onboard_payloads", None)
-        if batched is not None and len(hashes) > 1:
-            self.stats["batched_fetches"] += 1
-            return batched(addr[0], addr[1], hashes, max_size)
-        out: List[Optional[bytes]] = []
-        for h in hashes:
-            payload = self.connector.onboard_payload(addr[0], addr[1], h, max_size)
-            out.append(payload)
-            if payload is None:
-                break  # chain cut: later blocks can't land anyway
-        return out
+        with obs.stage("transfer.peer_fetch"):
+            batched = getattr(self.connector, "onboard_payloads", None)
+            if batched is not None and len(hashes) > 1:
+                self.stats["batched_fetches"] += 1
+                return batched(addr[0], addr[1], hashes, max_size)
+            out: List[Optional[bytes]] = []
+            for h in hashes:
+                payload = self.connector.onboard_payload(
+                    addr[0], addr[1], h, max_size
+                )
+                out.append(payload)
+                if payload is None:
+                    break  # chain cut: later blocks can't land anyway
+            return out
 
     def _fetch_staged_many(
         self, hashes: List[int], max_size: int,
     ) -> List[Optional[bytes]]:
-        batched = getattr(self.connector, "fetch_staged_many", None)
-        if batched is not None and len(hashes) > 1:
-            return batched(hashes, max_size)
-        return [self.connector.fetch_staged(h, max_size) for h in hashes]
+        with obs.stage("transfer.staged_fetch"):
+            batched = getattr(self.connector, "fetch_staged_many", None)
+            if batched is not None and len(hashes) > 1:
+                return batched(hashes, max_size)
+            return [self.connector.fetch_staged(h, max_size) for h in hashes]
 
     # -- async prefetch ----------------------------------------------------
 
@@ -476,6 +490,12 @@ class TieredKVStore:
         """Warm a whole submit's worth of blocks with batched fetches: one
         loopback round trip for the host-staged run, one multi-block DCN
         round trip per peer (instead of one connection + RTT per block)."""
+        # A root trace of its own: this runs on the background prefetcher
+        # thread, where no request trace is ever active.
+        with obs.request("transfer.prefetch_batch", {"blocks": len(batch)}):
+            self._prefetch_batch_impl(batch)
+
+    def _prefetch_batch_impl(self, batch: List[int]) -> None:
         max_size = max(self.codec.page_nbytes, 1)
         with self._mu:
             todo = [h for h in batch if h not in self._ready]
@@ -545,7 +565,14 @@ class TieredKVStore:
         Blocks with an in-flight eager snapshot (stage_async) are claimed
         and admitted inline — their content was captured at snapshot time
         and the host copy has been overlapping since, so this path pays
-        only the residual sync instead of a fresh extract."""
+        only the residual sync instead of a fresh extract.
+
+        A root trace in the flight recorder (`transfer.stage`), with
+        extract dispatch/drain and host-store admits as child spans."""
+        with obs.request("transfer.stage", {"blocks": len(blocks)}):
+            return self._stage_many_impl(blocks)
+
+    def _stage_many_impl(self, blocks: List[tuple]) -> int:
         fresh = []
         n_resident = 0
         pending_blocks = []
@@ -581,7 +608,8 @@ class TieredKVStore:
             return n_resident
         wave = self.stage_wave_pages
         if len(fresh) <= wave:
-            payloads = self.codec.extract_many([b[3] for b in fresh])
+            with obs.stage("transfer.stage_extract"):
+                payloads = self.codec.extract_many([b[3] for b in fresh])
             return n_resident + self._admit_payloads(fresh, payloads)
         # Dispatch-then-drain double buffering: at most one un-drained wave
         # in flight beyond the one being dispatched, so pending gather
@@ -590,7 +618,8 @@ class TieredKVStore:
         for start in range(0, len(fresh), wave):
             w = fresh[start:start + wave]
             try:
-                resolve = self.codec.extract_many_async([b[3] for b in w])
+                with obs.stage("transfer.stage_extract"):
+                    resolve = self.codec.extract_many_async([b[3] for b in w])
             except Exception as e:  # noqa: BLE001 - wave is best-effort
                 logger.debug("stage wave dispatch failed: %s", e)
                 continue
@@ -604,7 +633,8 @@ class TieredKVStore:
 
     def _drain_stage_wave(self, blocks: List[tuple], resolve) -> int:
         try:
-            payloads = resolve()
+            with obs.stage("transfer.stage_drain"):
+                payloads = resolve()
         except Exception as e:  # noqa: BLE001 - wave is best-effort
             logger.debug("stage wave resolve failed: %s", e)
             return 0
@@ -613,6 +643,12 @@ class TieredKVStore:
     def _admit_payloads(self, blocks: List[tuple], payloads: List[bytes]) -> int:
         """Admit extracted payloads to the host store (capacity-evicting).
         Returns how many landed."""
+        with obs.stage("transfer.stage_admit"):
+            return self._admit_payloads_impl(blocks, payloads)
+
+    def _admit_payloads_impl(
+        self, blocks: List[tuple], payloads: List[bytes]
+    ) -> int:
         n_resident = 0
         for (chunk_hash, token_ids, parent_hash, _pid, lora_id), payload in zip(
             blocks, payloads

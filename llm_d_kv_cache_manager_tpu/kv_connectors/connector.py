@@ -41,6 +41,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from llm_d_kv_cache_manager_tpu import obs
 from llm_d_kv_cache_manager_tpu.kvevents.events import (
     BlockRemoved,
     BlockStored,
@@ -281,7 +282,7 @@ class TransferClient:
         cap = max(max_size, 1)
         buf = (ctypes.c_uint8 * cap)()
         conn = self._conn(host, port)
-        with conn.lock:
+        with obs.stage("transfer.dcn_fetch"), conn.lock:
             for attempt in range(self.config.retries + 1):
                 if attempt:
                     self.stats["reconnects"] += 1
@@ -329,7 +330,7 @@ class TransferClient:
         buf = (ctypes.c_uint8 * (n * cap))()
         lens = (ctypes.c_int64 * n)()
         conn = self._conn(host, port)
-        with conn.lock:
+        with obs.stage("transfer.dcn_fetch"), conn.lock:
             for attempt in range(self.config.retries + 1):
                 if attempt:
                     self.stats["reconnects"] += 1
@@ -487,25 +488,28 @@ class KVConnector:
         in enqueue order, so later device writes cannot corrupt it. Past
         `max_inflight_offloads`, the oldest entry is drained first (bounded
         memory, still pipelined)."""
-        for page in (k_page, v_page):
-            try:
-                # On the CPU backend there is no DMA engine to overlap:
-                # copy_to_host_async degenerates to a synchronous memcpy,
-                # which would move the whole copy ONTO the dispatch path —
-                # the opposite of the point. Skip the hint there; the
-                # drain's device_get pays the same memcpy off the critical
-                # path instead.
-                if next(iter(page.devices())).platform != "cpu":
-                    page.copy_to_host_async()
-            except Exception:  # noqa: BLE001 - a hint; device_get still works
-                pass
-        entry = (block_hash, k_page, v_page, list(token_ids), block_size,
-                 parent_hash, lora_id)
-        drain_oldest = []
-        with self._offload_mu:
-            self._offloads.append(entry)
-            while len(self._offloads) > max(1, self.config.max_inflight_offloads):
-                drain_oldest.append(self._offloads.popleft())
+        with obs.stage("transfer.offload_dispatch"):
+            for page in (k_page, v_page):
+                try:
+                    # On the CPU backend there is no DMA engine to overlap:
+                    # copy_to_host_async degenerates to a synchronous
+                    # memcpy, which would move the whole copy ONTO the
+                    # dispatch path — the opposite of the point. Skip the
+                    # hint there; the drain's device_get pays the same
+                    # memcpy off the critical path instead.
+                    if next(iter(page.devices())).platform != "cpu":
+                        page.copy_to_host_async()
+                except Exception:  # noqa: BLE001 - hint; device_get works
+                    pass
+            entry = (block_hash, k_page, v_page, list(token_ids), block_size,
+                     parent_hash, lora_id)
+            drain_oldest = []
+            with self._offload_mu:
+                self._offloads.append(entry)
+                while len(self._offloads) > max(
+                    1, self.config.max_inflight_offloads
+                ):
+                    drain_oldest.append(self._offloads.popleft())
         for old in drain_oldest:
             self._resolve_offload(old)
 
@@ -531,11 +535,12 @@ class KVConnector:
     def _resolve_offload(self, entry) -> None:
         import jax
 
-        block_hash, k_page, v_page, token_ids, block_size, parent, lora = entry
-        k_np = np.asarray(jax.device_get(k_page))
-        v_np = np.asarray(jax.device_get(v_page))
-        self.stage(block_hash, k_np.tobytes() + v_np.tobytes(), token_ids,
-                   block_size, parent, lora)
+        with obs.stage("transfer.offload_drain"):
+            block_hash, k_page, v_page, token_ids, block_size, parent, lora = entry
+            k_np = np.asarray(jax.device_get(k_page))
+            v_np = np.asarray(jax.device_get(v_page))
+            self.stage(block_hash, k_np.tobytes() + v_np.tobytes(), token_ids,
+                       block_size, parent, lora)
 
     def restore(self, block_hash: int, like_k, like_v) -> Optional[Tuple]:
         """Bring a host-staged block back as (k_page, v_page) arrays shaped

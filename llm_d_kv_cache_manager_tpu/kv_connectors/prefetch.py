@@ -22,6 +22,7 @@ import queue
 import threading
 from typing import Callable, Dict, List, Optional
 
+from llm_d_kv_cache_manager_tpu import obs
 from llm_d_kv_cache_manager_tpu.metrics import collector as metrics
 from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
 
@@ -85,7 +86,10 @@ class RoutePrefetcher:
             pod_identifier, block_hashes = item
             try:
                 if not self._closed:
-                    n = self.prefetch_fn(pod_identifier, block_hashes)
+                    # A root trace: the prefetch worker thread never has a
+                    # request trace active.
+                    with obs.request("transfer.route_prefetch"):
+                        n = self.prefetch_fn(pod_identifier, block_hashes)
                     self.stats["executed"] += 1
                     self.stats["blocks_queued"] += int(n or 0)
                     metrics.count_route_prefetch(int(n or 0))

@@ -20,6 +20,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
+from llm_d_kv_cache_manager_tpu import obs
 from llm_d_kv_cache_manager_tpu.kvcache.backend import (
     KVCacheBackendConfig,
     default_kv_cache_backend_configs,
@@ -158,6 +159,7 @@ class Indexer:
         pod_identifiers: Sequence[str],
         render_request=None,
         lora_id=None,
+        _explain: Optional[dict] = None,
     ) -> PodScores:
         """`get_pod_scores` plus the transfer-plane signal: per-pod matched
         prefix lengths and the prompt's block-hash chain. The scores dict
@@ -165,7 +167,31 @@ class Indexer:
         arithmetic, same fleet-health filtering); the extra fields let the
         router drive the data plane's prefetch queue with the exact blocks
         the chosen pod will miss, instead of discarding what the scorer
-        already computed."""
+        already computed.
+
+        `_explain` (score-explain plumbing — `explain_scores` is the public
+        face): when a dict is passed, the intermediate stages deposit their
+        evidence into it. Explain therefore runs THIS code path, not a
+        parallel reimplementation, which is what makes its scores
+        bit-identical by construction."""
+        # No meta dict on the hot path — the model rides in the explain
+        # report; a per-request dict alloc is measurable at this depth.
+        with obs.request("read.get_pod_scores"):
+            return self._get_pod_scores_ex(
+                prompt, model_name, pod_identifiers,
+                render_request=render_request, lora_id=lora_id,
+                _explain=_explain,
+            )
+
+    def _get_pod_scores_ex(
+        self,
+        prompt: str,
+        model_name: str,
+        pod_identifiers: Sequence[str],
+        render_request=None,
+        lora_id=None,
+        _explain: Optional[dict] = None,
+    ) -> PodScores:
         # Same validation as the event-ingest side (kvevents/pool.py): an
         # invalid adapter id degrades to the base keyspace rather than
         # hashing into a keyspace no event can ever populate.
@@ -175,9 +201,10 @@ class Indexer:
             lora_id = None
 
         try:
-            tokenized = self.tokenizers_pool.tokenize_ex(
-                render_request, prompt, model_name
-            )
+            with obs.stage("read.tokenize", nested=True):
+                tokenized = self.tokenizers_pool.tokenize_ex(
+                    render_request, prompt, model_name
+                )
         except PoolOverloadedError:
             # Degrade, don't fail: an empty score map routes the request by
             # the caller's fallback strategy, which beats queueing the read
@@ -186,30 +213,114 @@ class Indexer:
                 "tokenization pool overloaded; returning empty scores for model %s",
                 model_name,
             )
+            if _explain is not None:
+                _explain["degraded"] = "tokenization_overloaded"
             return PodScores()
 
         # The pool's prefix-store boundary state rides along so the chain
         # memo can resume key derivation at the first novel block of a
         # follow-up turn — same keys, none of the re-hashing.
-        block_keys = self.token_processor.tokens_to_kv_block_keys(
-            None, tokenized.tokens, model_name, lora_id=lora_id,
-            prefix_state=tokenized.prefix_state,
-        )
+        with obs.stage("read.derive"):
+            block_keys = self.token_processor.tokens_to_kv_block_keys(
+                None, tokenized.tokens, model_name, lora_id=lora_id,
+                prefix_state=tokenized.prefix_state,
+            )
+        if _explain is not None:
+            memo = self.token_processor.chain_memo
+            _explain["tokens"] = len(tokenized.tokens)
+            _explain["blocks"] = len(block_keys)
+            _explain["lora_id"] = lora_id
+            _explain["chain_memo"] = (
+                {"family": memo.last_family(), "stats": memo.stats()}
+                if memo is not None
+                else None
+            )
         if not block_keys:
             kvlog.trace(logger, "no block keys for prompt, returning empty scores")
+            if _explain is not None:
+                _explain.setdefault("degraded", "no_block_keys")
             return PodScores()
 
-        key_to_pods = self.kv_block_index.lookup(block_keys, set(pod_identifiers))
-        scores, match_blocks = self.scorer.score_ex(block_keys, key_to_pods)
-        if self.fleet_health is not None:
-            # Degraded-mode scoring: suspect pods demoted, stale pods
-            # excluded. An emptied map is the explicit no-cache-signal
-            # answer — the caller's load/round-robin fallback takes over
-            # instead of routing to phantom placements.
-            scores = self.fleet_health.filter_scores(scores)
+        with obs.stage("read.lookup"):
+            key_to_pods = self.kv_block_index.lookup(
+                block_keys, set(pod_identifiers)
+            )
+        with obs.stage("read.score"):
+            scores, match_blocks = self.scorer.score_ex(block_keys, key_to_pods)
+            if _explain is not None:
+                _explain["raw_scores"] = dict(scores)
+            if self.fleet_health is not None:
+                # Degraded-mode scoring: suspect pods demoted, stale pods
+                # excluded. An emptied map is the explicit no-cache-signal
+                # answer — the caller's load/round-robin fallback takes over
+                # instead of routing to phantom placements.
+                scores = self.fleet_health.filter_scores(scores)
         kvlog.trace(logger, "pod scores: %s", scores)
         return PodScores(
             scores=scores,
             match_blocks=match_blocks,
             block_hashes=[k.chunk_hash for k in block_keys],
         )
+
+    def explain_scores(
+        self,
+        prompt: str,
+        model_name: str,
+        pod_identifiers: Sequence[str],
+        render_request=None,
+        lora_id=None,
+    ) -> dict:
+        """Score with the decision evidence attached (`/debug/score_explain`).
+
+        Runs the exact `get_pod_scores_ex` pipeline (scores bit-identical to
+        `get_pod_scores` — pinned by tests/test_obs.py) and reports, per
+        pod: the raw scorer output, the matched-prefix length in blocks,
+        the fleet-health state and the adjustment it caused (suspect pods
+        demoted ×suspect_demotion_factor, stale pods excluded), plus which
+        chain-memo entry family served the derivation and the chosen pod
+        under the deterministic best-score/lexicographic tie-break."""
+        detail: dict = {}
+        result = self.get_pod_scores_ex(
+            prompt, model_name, pod_identifiers,
+            render_request=render_request, lora_id=lora_id, _explain=detail,
+        )
+        raw = detail.pop("raw_scores", {})
+        final = result.scores
+        pods = {}
+        for pod in sorted(raw):
+            health = (
+                self.fleet_health.state_of(pod)
+                if self.fleet_health is not None
+                else "healthy"
+            )
+            raw_score = raw[pod]
+            if pod not in final:
+                adjustment = "excluded"
+            elif final[pod] != raw_score:
+                adjustment = "demoted"
+            else:
+                adjustment = "none"
+            pods[pod] = {
+                "raw_score": raw_score,
+                "score": final.get(pod),
+                "match_blocks": result.match_blocks.get(pod, 0),
+                "matched_ratio": round(
+                    result.match_blocks.get(pod, 0)
+                    / max(len(result.block_hashes), 1),
+                    4,
+                ),
+                "health": health,
+                "adjustment": adjustment,
+            }
+        chosen = None
+        if final:
+            best = max(final.values())
+            chosen = min(p for p, s in final.items() if s == best)
+        return {
+            "model": model_name,
+            "prompt_chars": len(prompt),
+            "scores": final,
+            "chosen": chosen,
+            "pods": pods,
+            **detail,
+        }

@@ -123,6 +123,11 @@ class ChainMemo:
         self._misses = 0
         self._blocks_reused = 0
         self._blocks_hashed = 0
+        # Which entry family served this thread's most recent derivation
+        # ("request" / "boundary" / "segment" / "cold") — thread-local, so
+        # the score-explain path (obs/) can attribute its own derivation
+        # without racing concurrent callers.
+        self._last = threading.local()
 
     # -- identity ----------------------------------------------------------
 
@@ -175,6 +180,13 @@ class ChainMemo:
             self._blocks_reused += reused
             self._blocks_hashed += hashed
 
+    def last_family(self) -> Optional[str]:
+        """Entry family that served this thread's last `derive_keys` call:
+        "request" (whole-key-tuple probe), "boundary" (prefix-store
+        boundary chain), "segment" (token-domain segments), or "cold" (no
+        memoized prefix — full derivation). None before any call."""
+        return getattr(self._last, "family", None)
+
     # -- derivation --------------------------------------------------------
 
     def derive_keys(
@@ -192,6 +204,7 @@ class ChainMemo:
         (hashing.prefix_hashes_fast) by construction — the memo only ever
         changes WHERE hashing starts, never what it produces."""
         n_full = len(tokens) // block_size
+        self._last.family = "cold"
         if n_full == 0:
             return []
         ident = self._ident(model_name, parent, block_size, extra, algo)
@@ -237,6 +250,7 @@ class ChainMemo:
             entry = cache.get(req_key)
             if entry is not None:
                 keys = entry[0]
+                self._last.family = "request"
                 self._count(True, len(keys), 0)
                 return list(keys)
 
@@ -296,6 +310,8 @@ class ChainMemo:
             inserts.append((req_key, (tuple(full),)))
         if inserts:
             cache.add_many(inserts)
+        if hit_boundaries > 0:
+            self._last.family = "boundary"
         self._count(hit_boundaries > 0, covered, len(tail))
         return full
 
@@ -332,5 +348,7 @@ class ChainMemo:
                 delta = tuple(full[s * sb:(s + 1) * sb])
                 inserts.append((fps[s], (delta, delta[-1].chunk_hash)))
             self._cache.add_many(inserts)
+        if covered_segs > 0:
+            self._last.family = "segment"
         self._count(covered_segs > 0, covered_segs * sb, len(tail))
         return full

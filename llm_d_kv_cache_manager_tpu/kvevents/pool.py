@@ -49,11 +49,14 @@ past the cap the OLDEST pending removal digest is discarded and counted
 from __future__ import annotations
 
 import collections
+import contextlib
 import queue
 import threading
+import time
 from dataclasses import dataclass
 from typing import Deque, List, Optional
 
+from llm_d_kv_cache_manager_tpu import obs
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.hashing import fnv32a
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
@@ -73,6 +76,10 @@ from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
 logger = kvlog.get_logger("kvevents.pool")
 
 DEFAULT_DEVICE_TIER = "hbm"  # TPU default (reference used "gpu")
+
+# Shared no-op context for the untraced fast path (obs stages only wrap the
+# sampled batches — see _process_event).
+_NOOP_CTX = contextlib.nullcontext()
 
 
 @dataclass
@@ -97,6 +104,10 @@ class Message:
     seq: int
     pod_identifier: str
     model_name: str
+    # Stamped by add_task (perf_counter): dequeue-time minus this is the
+    # shard-queue wait — the stage that separates "digestion is slow" from
+    # "a shard worker is backed up" (obs/ write-plane trace).
+    enqueue_t: float = 0.0
 
 
 class EventPool:
@@ -140,6 +151,11 @@ class EventPool:
         self._dropped = 0
         self._removals_lost = 0
         self._dropped_mu = threading.Lock()
+        # Write-plane trace sampling (obs/): batches are ~10x more frequent
+        # than read requests, so only every write_trace_stride-th batch is
+        # traced. Racy increments across shard workers only perturb which
+        # batch gets sampled.
+        self._batch_counter = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -242,6 +258,8 @@ class EventPool:
         """
         if self._shutdown:
             return  # shutdown in progress: drop quietly
+        if msg.enqueue_t == 0.0:
+            msg.enqueue_t = time.perf_counter()
         # Enqueuing before start() is fine — the bounded queue accumulates
         # (drop-oldest past the cap) until workers come up.
         shard = fnv32a(msg.pod_identifier.encode("utf-8")) % len(self._queues)
@@ -370,8 +388,24 @@ class EventPool:
                 return
 
     def _process_event(self, msg: Message) -> None:
+        if obs.enabled():
+            self._batch_counter += 1
+            stride = max(1, obs.get_config().write_trace_stride)
+            if self._batch_counter % stride == 0:
+                with obs.request("write.digest", {"topic": msg.topic}):
+                    if msg.enqueue_t:
+                        obs.record(
+                            "write.queue_wait", msg.enqueue_t,
+                            time.perf_counter(),
+                        )
+                    self._process_event_impl(msg, traced=True)
+                return
+        self._process_event_impl(msg)
+
+    def _process_event_impl(self, msg: Message, traced: bool = False) -> None:
         try:
-            batch = EventBatch.from_msgpack(msg.payload)
+            with obs.stage("write.decode") if traced else _NOOP_CTX:
+                batch = EventBatch.from_msgpack(msg.payload)
         except Exception as e:  # noqa: BLE001 - poison pill: drop, don't retry
             logger.debug("dropping undecodable event batch (topic=%s): %s", msg.topic, e)
             if self.health_tracker is not None:
@@ -394,7 +428,17 @@ class EventPool:
             # same DP-rank-qualified identity the index entries use, so the
             # tracker's state keys always match score keys.
             self.health_tracker.observe_batch(pod, msg.topic, msg.seq, batch.ts)
-        self._digest_events(pod, msg.model_name, batch)
+        with obs.stage("write.index_apply") if traced else _NOOP_CTX:
+            self._digest_events(pod, msg.model_name, batch)
+        # Event publish → index visible, observed for EVERY batch (the
+        # fleet-wide index-staleness signal, not a sampled trace stage).
+        # batch.ts is the publisher's wall clock; sim/bench batches carry
+        # synthetic ts values, which the plausibility window screens out.
+        ts = batch.ts
+        if isinstance(ts, float) and ts > 0.0:
+            delay = time.time() - ts
+            if 0.0 <= delay < 3600.0:
+                metrics.observe_apply_delay(delay)
 
     def _digest_events(
         self, pod_identifier: str, model_name: str, batch: EventBatch

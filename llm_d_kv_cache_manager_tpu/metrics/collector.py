@@ -58,10 +58,27 @@ redis_state_transitions: Optional[Counter] = None
 # queued by the route-driven prefetcher (kv_connectors/prefetch.py).
 transfer_failures: Optional[Counter] = None
 route_prefetch_blocks: Optional[Counter] = None
+# Tracing spine (obs/): per-stage latency across the three planes. Labels
+# are the fixed `plane.stage` names from the instrumentation sites —
+# bounded by code, never by traffic (tests/test_metrics_hygiene.py walks
+# the registry to keep it that way). Observation is strided
+# (ObsConfig.histogram_stride), so counts are sampled ×stride.
+stage_latency: Optional[Histogram] = None
+# Write-plane staleness: event publish (batch.ts) → index visible. The
+# fleet-wide freshness signal the ROADMAP's multi-replica indexer needs —
+# a replica whose apply delay grows is serving an increasingly stale
+# placement view. Observed per batch (not strided).
+event_apply_delay: Optional[Histogram] = None
+
+_APPLY_DELAY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+    5.0, 10.0, 30.0, 60.0,
+)
 
 _registered = False
 _register_lock = threading.Lock()
 _beat_thread: Optional[threading.Thread] = None
+_beat_stop: Optional[threading.Event] = None
 
 
 def register_metrics(registry=None) -> None:
@@ -74,6 +91,7 @@ def register_metrics(registry=None) -> None:
     global pod_state_transitions, stale_entries_purged
     global event_stream_anomalies, redis_state_transitions
     global transfer_failures, route_prefetch_blocks
+    global stage_latency, event_apply_delay
 
     with _register_lock:
         if _registered:
@@ -186,6 +204,21 @@ def register_metrics(registry=None) -> None:
             "KV blocks queued for prefetch by the route-driven prefetcher",
             registry=reg,
         )
+        stage_latency = Histogram(
+            "kvcache_stage_latency_seconds",
+            "Per-stage latency across the read/write/transfer planes "
+            "(obs/ tracing spine; sampled every histogram_stride calls)",
+            labelnames=("plane", "stage"),
+            buckets=_LATENCY_BUCKETS,
+            registry=reg,
+        )
+        event_apply_delay = Histogram(
+            "kvcache_event_apply_delay_seconds",
+            "KV-event publish (batch.ts) to index-visible latency — the "
+            "fleet-wide index staleness signal",
+            buckets=_APPLY_DELAY_BUCKETS,
+            registry=reg,
+        )
         _registered = True
 
 
@@ -254,33 +287,80 @@ def count_route_prefetch(n: int) -> None:
         route_prefetch_blocks.inc(n)
 
 
+def observe_stage(plane: str, stage: str, seconds: float) -> None:
+    """Record one (possibly sampled — see obs.ObsConfig.histogram_stride)
+    stage duration from the tracing spine."""
+    if stage_latency is not None:
+        stage_latency.labels(plane=plane, stage=stage).observe(seconds)
+
+
+def observe_apply_delay(seconds: float) -> None:
+    """Record one batch's event-publish → index-visible latency."""
+    if event_apply_delay is not None:
+        event_apply_delay.observe(seconds)
+
+
+def counter_value(c: Optional[Counter]) -> float:
+    """Public collect()-based counter read (the beat line's data source).
+
+    Replaces the old `c._value.get()` private-attribute peek, which silently
+    read 0 for any labeled counter (labeled collectors keep their values on
+    child objects, not the parent). Summing the exposition `_total` samples
+    works identically for plain and labeled counters — a labeled counter
+    reads as the sum across its label sets."""
+    if c is None:
+        return 0.0
+    total = 0.0
+    for metric in c.collect():
+        for sample in metric.samples:
+            if sample.name.endswith("_total"):
+                total += sample.value
+    return total
+
+
 def start_metrics_logging(interval_s: float = 60.0) -> None:
     """Start the periodic metrics-beat logger thread (idempotent)."""
-    global _beat_thread
+    global _beat_thread, _beat_stop
     with _register_lock:
         if _beat_thread is not None:
             return
+        _beat_stop = threading.Event()
         _beat_thread = threading.Thread(
-            target=_beat_loop, args=(interval_s,), name="metrics-beat", daemon=True
+            target=_beat_loop, args=(interval_s, _beat_stop),
+            name="metrics-beat", daemon=True,
         )
         _beat_thread.start()
 
 
-def _counter_value(c: Optional[Counter]) -> float:
-    if c is None:
-        return 0.0
-    return c._value.get()  # noqa: SLF001 - prometheus_client has no public read
+def stop_metrics_logging(timeout_s: float = 5.0) -> None:
+    """Stop the beat thread and wait for it to exit (idempotent). Tests and
+    embedders can now start/stop the beat without leaking a daemon thread
+    into every later test's thread count."""
+    global _beat_thread, _beat_stop
+    with _register_lock:
+        thread, _beat_thread = _beat_thread, None
+        stop, _beat_stop = _beat_stop, None
+    if thread is None:
+        return
+    if stop is not None:
+        stop.set()
+    thread.join(timeout=timeout_s)
 
 
-def _beat_loop(interval_s: float) -> None:
-    import time
-
-    while True:
-        time.sleep(interval_s)
+def _beat_loop(interval_s: float, stop: threading.Event) -> None:
+    while not stop.wait(interval_s):
         logger.info(
-            "metrics beat: admissions=%d evictions=%d lookups=%d hits=%d",
-            _counter_value(index_admissions),
-            _counter_value(index_evictions),
-            _counter_value(index_lookup_requests),
-            _counter_value(index_lookup_hits),
+            "metrics beat: admissions=%d evictions=%d lookups=%d hits=%d "
+            "events_dropped=%d tok_rejected=%d anomalies=%d purged=%d "
+            "transfer_failures=%d prefetch_blocks=%d",
+            counter_value(index_admissions),
+            counter_value(index_evictions),
+            counter_value(index_lookup_requests),
+            counter_value(index_lookup_hits),
+            counter_value(events_dropped),
+            counter_value(tokenization_rejected),
+            counter_value(event_stream_anomalies),
+            counter_value(stale_entries_purged),
+            counter_value(transfer_failures),
+            counter_value(route_prefetch_blocks),
         )

@@ -1,0 +1,191 @@
+"""Flight recorder: bounded ring of recent traces + slow-outlier reservoir.
+
+The ring answers "what do requests look like right now" (`/debug/traces`);
+the reservoir answers "what did the worst requests ever look like" — ring
+churn under load would otherwise evict exactly the traces worth keeping.
+The reservoir keeps the N slowest traces at or above
+`ObsConfig.slow_threshold_s`, so a tail-latency incident leaves evidence
+behind even after millions of fast requests have rolled the ring over.
+
+Synchronous and thread-light: `submit` is a deque append (plus a heap push
+for slow traces) under one lock — no background thread, no serialization
+until someone actually asks for a snapshot.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import statistics
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+from llm_d_kv_cache_manager_tpu.obs import spans as _spans
+
+
+class FlightRecorder:
+    def __init__(self, config: Optional[_spans.ObsConfig] = None):
+        config = config or _spans.get_config()
+        self._mu = threading.Lock()
+        self._ring: deque = deque(maxlen=max(1, config.ring_capacity))
+        self._slow_threshold_s = config.slow_threshold_s
+        self._reservoir_cap = max(1, config.reservoir_capacity)
+        # Min-heap of (duration, seq, trace): the root is the FASTEST of
+        # the retained slow outliers, so a new slower trace displaces it.
+        self._slow: List[tuple] = []
+        self._seq = itertools.count()
+        self._completed = 0
+        self._dropped = 0
+
+    def reconfigure(self, config: _spans.ObsConfig) -> None:
+        with self._mu:
+            if self._ring.maxlen != max(1, config.ring_capacity):
+                self._ring = deque(
+                    self._ring, maxlen=max(1, config.ring_capacity)
+                )
+            self._slow_threshold_s = config.slow_threshold_s
+            self._reservoir_cap = max(1, config.reservoir_capacity)
+            while len(self._slow) > self._reservoir_cap:
+                heapq.heappop(self._slow)
+
+    def submit(self, trace: _spans.Trace) -> None:
+        # Lock-free fast path: deque.append is GIL-atomic, and the
+        # completed/dropped counters are introspection-only (a lost
+        # increment under a submit race skews a /readyz stat by one, never
+        # a trace). Only slow-outlier admission — rare by definition —
+        # takes the lock, so the per-request submit cost stays flat.
+        dur = trace.t1 - trace.t0
+        ring = self._ring
+        if len(ring) == ring.maxlen:
+            self._dropped += 1  # ring overwrite: oldest trace lost
+        ring.append(trace)
+        n = self._completed = self._completed + 1
+        if dur >= self._slow_threshold_s:
+            with self._mu:
+                item = (dur, next(self._seq), trace)
+                if len(self._slow) < self._reservoir_cap:
+                    heapq.heappush(self._slow, item)
+                elif dur > self._slow[0][0]:
+                    heapq.heapreplace(self._slow, item)
+        # Strided per-stage histogram observation, one whole trace at a
+        # time (Histogram.observe locks internally). Strides on the global
+        # completion count: one counter for the whole recorder.
+        if n % _spans.get_config().histogram_stride == 0:
+            _spans.observe_trace(trace)
+
+    def clear(self) -> None:
+        with self._mu:
+            self._ring.clear()
+            self._slow = []
+            self._completed = 0
+            self._dropped = 0
+
+    # -- introspection -----------------------------------------------------
+
+    def recent(self, n: Optional[int] = None) -> List[_spans.Trace]:
+        with self._mu:
+            traces = list(self._ring)
+        if n is None:
+            return traces
+        return traces[-n:] if n > 0 else []
+
+    def slow(self) -> List[_spans.Trace]:
+        """Slow-outlier reservoir, slowest first."""
+        with self._mu:
+            items = sorted(self._slow, reverse=True)
+        return [t for _, _, t in items]
+
+    def stats(self) -> dict:
+        """Health of the recorder itself (for /readyz: degraded
+        observability must be observable)."""
+        with self._mu:
+            occupancy = len(self._ring)
+            capacity = self._ring.maxlen
+            completed = self._completed
+            dropped = self._dropped
+            slow_count = len(self._slow)
+            window = list(self._ring)
+        slowest_name, slowest_s = None, 0.0
+        for trace in window:
+            for name, _, t0, t1 in trace.spans:
+                d = t1 - t0
+                if d > slowest_s:
+                    slowest_name, slowest_s = name, d
+        return {
+            "enabled": _spans.enabled(),
+            "ring_occupancy": occupancy,
+            "ring_capacity": capacity,
+            "completed_traces": completed,
+            "dropped_traces": dropped,
+            "slow_traces_retained": slow_count,
+            "slow_threshold_ms": round(self._slow_threshold_s * 1e3, 3),
+            "slowest_stage_recent": (
+                {"stage": slowest_name, "ms": round(slowest_s * 1e3, 3)}
+                if slowest_name is not None
+                else None
+            ),
+        }
+
+    def snapshot(self, n: Optional[int] = None) -> dict:
+        """JSON-ready dump for GET /debug/traces."""
+        return {
+            "stats": self.stats(),
+            "recent": [t.as_dict() for t in self.recent(n)],
+            "slow": [t.as_dict() for t in self.slow()],
+        }
+
+
+def aggregate_stages(traces: List[_spans.Trace]) -> Dict[str, dict]:
+    """Per-stage latency summary over complete traces — the bench-side
+    reduction behind the committed stage-attribution sections. Returns
+    {stage: {p50_us, p90_us, mean_us, calls, share_pct}}. Each trace also
+    contributes a row under its own root name (the whole-request
+    duration). share_pct is the stage's fraction of the summed trace
+    *windows* — a window stretches to cover spans recorded before the
+    root opened (a queue wait stamped at enqueue time), so a wait larger
+    than the processing it preceded reads as a large share, not >100% of
+    a window that never contained it. Nested stages still overlap their
+    parents by design, so shares can sum past 100 across depths."""
+    samples: Dict[str, List[float]] = {}
+    total_s = 0.0
+    for trace in traces:
+        w0, w1 = trace.t0, trace.t1 or trace.t0
+        root_dur = trace.duration_s
+        samples.setdefault(trace.name, []).append(root_dur)
+        for name, _, t0, t1 in trace.spans:
+            samples.setdefault(name, []).append(t1 - t0)
+            if t0 < w0:
+                w0 = t0
+            if t1 > w1:
+                w1 = t1
+        total_s += w1 - w0
+    out: Dict[str, dict] = {}
+    for name, vals in sorted(samples.items()):
+        vals.sort()
+        stage_total = sum(vals)
+        out[name] = {
+            "p50_us": round(vals[len(vals) // 2] * 1e6, 1),
+            "p90_us": round(
+                vals[min(int(len(vals) * 0.9), len(vals) - 1)] * 1e6, 1
+            ),
+            "mean_us": round(statistics.mean(vals) * 1e6, 1),
+            "calls": len(vals),
+            "share_pct": round(100.0 * stage_total / total_s, 1)
+            if total_s > 0
+            else 0.0,
+        }
+    return out
+
+
+_recorder: Optional[FlightRecorder] = None
+_recorder_mu = threading.Lock()
+
+
+def get_recorder() -> FlightRecorder:
+    """Process-wide recorder (all planes share one ring)."""
+    global _recorder
+    with _recorder_mu:
+        if _recorder is None:
+            _recorder = FlightRecorder()
+        return _recorder

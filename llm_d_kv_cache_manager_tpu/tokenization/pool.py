@@ -29,6 +29,7 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
+from llm_d_kv_cache_manager_tpu import obs
 from llm_d_kv_cache_manager_tpu.tokenization.prefixstore.indexer import (
     PrefixStore,
     PrefixStoreConfig,
@@ -93,6 +94,12 @@ class _Task:
     prompt: str
     model_name: str
     future: Optional[Future]
+    # Tracing spine (obs/): the submitter's trace + enqueue stamp ride the
+    # task so the worker can attribute its queue wait and work to the
+    # blocked request (the submitter waits on `future`, so the handoff is
+    # race-free). Both None/0 when tracing is off or the submit is async.
+    obs_trace: Optional[object] = None
+    enqueue_t: float = 0.0
 
 
 class TokenizationPool:
@@ -208,6 +215,9 @@ class TokenizationPool:
             self.run()
         fut: Future = Future()
         task = _Task(render_request, prompt, model_name, fut)
+        if obs.enabled():
+            task.obs_trace = obs.current_trace()
+            task.enqueue_t = time.perf_counter()
         try:
             self._queue.put(task, timeout=self.config.enqueue_timeout_s)
         except queue.Full:
@@ -237,7 +247,17 @@ class TokenizationPool:
             try:
                 if task is None:
                     return
-                result = self._process(task)
+                # Record into the submitter's captured trace directly (it
+                # blocks on the future, so this worker is the trace's only
+                # running thread). Queue wait is the stage that separates
+                # "the tokenizer is slow" from "the pool is saturated".
+                trace = task.obs_trace
+                if task.enqueue_t:
+                    obs.record_into(
+                        trace, "read.tokenize_queue_wait", task.enqueue_t,
+                        time.perf_counter(),
+                    )
+                result = self._process(task, trace)
                 if task.future is not None:
                     task.future.set_result(result)
             except Exception as e:  # noqa: BLE001 - deliver errors to waiter
@@ -248,31 +268,48 @@ class TokenizationPool:
             finally:
                 self._queue.task_done()
 
-    def _process(self, task: _Task) -> TokenizedPrompt:
+    def _process(self, task: _Task, trace=None) -> TokenizedPrompt:
+        # Stage timing rides the submitter's captured trace (sync read
+        # path). Fire-and-forget warm-up tasks carry no trace and pay no
+        # timing at all.
+        traced = trace is not None
         prompt = task.prompt
         if task.render_request is not None:
             t0 = time.perf_counter()
             prompt = self.tokenizer.render_chat_template(task.render_request)
-            metrics.observe_render(time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            metrics.observe_render(t1 - t0)
+            if traced:
+                obs.record_into(trace, "read.render", t0, t1)
 
         # Prefix-store shortcut, with boundary state when the store supports
         # it (LRU store). The trie store only speaks the base contract.
+        t0 = time.perf_counter() if traced else 0.0
         find_with_state = getattr(
             self.prefix_store, "find_longest_with_state", None
         )
         if find_with_state is not None:
             tokens, ratio, state = find_with_state(prompt)
         else:
-            tokens, ratio = self.prefix_store.find_longest_contained_tokens(prompt)
+            tokens, ratio = self.prefix_store.find_longest_contained_tokens(
+                prompt
+            )
             state = ()
+        if traced:
+            obs.record_into(
+                trace, "read.prefix_store", t0, time.perf_counter()
+            )
         if ratio < self.config.min_prefix_overlap_ratio:
             t0 = time.perf_counter()
             result = self.tokenizer.encode(prompt, task.model_name)
-            metrics.observe_tokenization(
-                time.perf_counter() - t0, len(result.tokens)
-            )
+            t1 = time.perf_counter()
+            metrics.observe_tokenization(t1 - t0, len(result.tokens))
             state = self.prefix_store.add_tokenization(
                 prompt, result.tokens, result.offsets
             ) or ()
             tokens = list(result.tokens)
+            if traced:
+                obs.record_into(
+                    trace, "read.encode", t0, time.perf_counter()
+                )
         return TokenizedPrompt(tokens=tokens, prefix_state=tuple(state))

@@ -1,0 +1,112 @@
+"""Metrics hygiene: the registry walk that keeps cardinality bounded.
+
+Prometheus label cardinality is a production-outage vector: one label
+carrying a pod name, block hash, or request id turns a fixed-size scrape
+into an unbounded one. This test walks every collector
+`metrics/collector.py` registers and fails on:
+
+- a metric outside the `kvcache_` namespace (the exposition contract the
+  reference established and dashboards key on), or
+- a label name outside the bounded allowlist (every allowed label takes
+  values from a fixed, code-defined set — never from traffic).
+
+Adding a collector with a `pod`/`model`/`hash` label fails here, at review
+time, instead of in production at scrape time.
+"""
+
+import prometheus_client
+from prometheus_client import REGISTRY
+
+from llm_d_kv_cache_manager_tpu.metrics import collector as metrics
+from llm_d_kv_cache_manager_tpu.obs import spans as obs_spans
+
+# Every allowed label name takes values from a FIXED set defined in code:
+#   state   — pod/redis lifecycle states (healthy/suspect/stale, up/down/…)
+#   kind    — stream-anomaly kinds (seq_gap/duplicate/reorder/…)
+#   backend — tokenizer backend names (local/uds/hf)
+#   op      — tokenizer operations (encode/render)
+#   plane   — tracing planes (read/write/transfer/other)
+#   stage   — tracing stage names (fixed by the instrumentation sites)
+ALLOWED_LABELS = {"state", "kind", "backend", "op", "plane", "stage"}
+ALLOWED_PLANES = {"read", "write", "transfer", "other"}
+
+
+def _kvcache_collectors():
+    metrics.register_metrics()
+    seen = set()
+    for attr in dir(metrics):
+        obj = getattr(metrics, attr)
+        if isinstance(
+            obj, (prometheus_client.Counter, prometheus_client.Histogram)
+        ) and id(obj) not in seen:
+            seen.add(id(obj))
+            yield attr, obj
+
+
+def test_collectors_exist():
+    collectors = dict(_kvcache_collectors())
+    # The walk must actually see the collector set (guards against the
+    # introspection silently matching nothing).
+    assert len(collectors) >= 15
+    assert "stage_latency" in collectors
+    assert "event_apply_delay" in collectors
+
+
+def test_all_metrics_in_kvcache_namespace():
+    for attr, c in _kvcache_collectors():
+        for metric in c.describe():
+            assert metric.name.startswith("kvcache_"), (
+                f"collector.{attr} exposes {metric.name!r} outside the "
+                "kvcache_ namespace"
+            )
+
+
+def test_label_names_are_bounded():
+    for attr, c in _kvcache_collectors():
+        labels = set(c._labelnames)  # noqa: SLF001 - registry introspection
+        bad = labels - ALLOWED_LABELS
+        assert not bad, (
+            f"collector.{attr} uses label(s) {sorted(bad)} outside the "
+            f"bounded allowlist {sorted(ALLOWED_LABELS)} — labels must "
+            "never carry per-pod/per-request/per-block values"
+        )
+        assert len(labels) <= 2, (
+            f"collector.{attr} has {len(labels)} labels; the cardinality "
+            "budget is 2"
+        )
+
+
+def test_stage_label_values_are_code_defined():
+    """Every (plane, stage) pair observed so far must come from the fixed
+    instrumentation-site inventory: plane is one of the four planes, and
+    the stage name contains no digits (a digit in a stage name is the
+    classic smell of an identifier leaking into a label)."""
+    metrics.register_metrics()
+    for metric in REGISTRY.collect():
+        if metric.name != "kvcache_stage_latency_seconds":
+            continue
+        for sample in metric.samples:
+            plane = sample.labels.get("plane")
+            stage = sample.labels.get("stage")
+            if plane is None:
+                continue
+            assert plane in ALLOWED_PLANES, f"unexpected plane {plane!r}"
+            assert stage and not any(ch.isdigit() for ch in stage), (
+                f"stage label {stage!r} looks traffic-derived"
+            )
+
+
+def test_instrumentation_sites_split_into_known_planes():
+    """The span namespace itself stays bounded: split_stage maps every
+    name the code uses into one of the four planes."""
+    assert obs_spans.split_stage("read.tokenize") == ("read", "tokenize")
+    assert obs_spans.split_stage("write.index_apply") == (
+        "write", "index_apply"
+    )
+    assert obs_spans.split_stage("transfer.dcn_fetch") == (
+        "transfer", "dcn_fetch"
+    )
+    # Un-prefixed names fall into the 'other' plane instead of minting a
+    # new label value.
+    assert obs_spans.split_stage("adhoc")[0] == "other"
+    assert obs_spans.split_stage(".weird")[0] == "other"
