@@ -3,8 +3,8 @@
 # /root/reference/Makefile, /root/reference/hooks/pre-commit.sh).
 
 .PHONY: native kvtransfer test bench bench-micro bench-read bench-obs \
-	bench-faults bench-transfer clean proto lint precommit-install \
-	image-build image-push
+	bench-faults bench-replication bench-transfer clean proto lint \
+	precommit-install image-build image-push
 
 # Container image coordinates (override per environment/registry). The
 # release workflow (.github/workflows/ci-release.yaml) builds the same
@@ -79,6 +79,12 @@ bench-obs:
 # Headless; rewrites benchmarking/FLEET_BENCH_FAULTS.json.
 bench-faults:
 	JAX_PLATFORMS=cpu python bench.py --faults
+
+# Indexer kill-and-restart scenario (cluster/): the index service dies
+# mid-ShareGPT-replay; cold restart vs snapshot + seq-tail-replay restore.
+# Headless; rewrites benchmarking/FLEET_BENCH_REPLICATION.json.
+bench-replication:
+	JAX_PLATFORMS=cpu python bench.py --replication
 
 # Transfer-plane legs (CI-smoke sizes, printed only): async-offload
 # dispatch vs sync stage, batched-vs-serial multi-block DCN fetch, inflight

@@ -48,6 +48,7 @@ FLEET_BENCH.json with the subsystem enabled).
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import random
@@ -256,6 +257,10 @@ class FleetSim:
         gated: bool = True,
         health_config=None,
         fault_plan=None,
+        snapshot_restore: bool = False,
+        snapshot_path=None,
+        snapshot_every_s: float = 0.0,
+        tail_journal_len: int = 0,
     ):
         self.strategy = strategy
         self.host_tier = host_tier
@@ -310,6 +315,27 @@ class FleetSim:
         self._it = _it
         self._seq = {f"pod-{i}": _it.count() for i in range(N_PODS)}
         self._crashed = set()
+        # Indexer (control-plane) lifecycle: --replication. While the
+        # index service is down nothing digests events and scoring calls
+        # go unanswered (routing falls back least-loaded). The tail
+        # journal is the replay source a real deployment retains at the
+        # delivery seam (bounded ring); _applied_seq mirrors the
+        # per-(pod, topic) watermarks fleethealth tracks in the service
+        # wiring, captured here by the sim's own sink.
+        self._indexer_down = False
+        self._indexer_restarted = False
+        self.snapshot_restore = snapshot_restore
+        self.snapshot_path = snapshot_path
+        self.snapshot_every_s = snapshot_every_s
+        self._last_snapshot_at = None
+        self.tail_journal = (
+            collections.deque(maxlen=tail_journal_len)
+            if tail_journal_len else None
+        )
+        self._applied_seq = {}
+        self.indexer_down_requests = 0
+        self.scores_empty_after_restart = 0
+        self.replication_stats = {}
         # (sim_time, pod_idx) of every routing decision that picked a
         # crashed pod — phantom-placement routing the subsystem exists to
         # stop. The router's retry lands the request on a live pod.
@@ -388,6 +414,14 @@ class FleetSim:
 
     def _sink_for(self, pod_id: str):
         def deliver(msg):
+            # Journal BEFORE the indexer-down gate: published events exist
+            # whether or not the index service is up to hear them — that
+            # persistence is exactly what the seq-tail replay consumes.
+            if self.tail_journal is not None:
+                self.tail_journal.append(msg)
+            if self._indexer_down:
+                return  # index service dead: nothing digests
+            self._applied_seq[(msg.pod_identifier, msg.topic)] = msg.seq
             self.event_pool.add_task(msg)
 
         if self.injector is not None:
@@ -444,6 +478,111 @@ class FleetSim:
             return range(N_PODS)
         return [i for i in range(N_PODS) if i not in self._crashed]
 
+    # -- indexer lifecycle (--replication) ------------------------------
+
+    def _apply_indexer_lifecycle(self, now: float) -> None:
+        """Kill/restart the index SERVICE per the fault plan. A crash
+        discards the in-memory index (the process died); restart brings up
+        a replacement that starts either cold (empty) or from the last
+        snapshot + seq-tail replay (cluster/snapshot.py)."""
+        plan = self.fault_plan
+        if plan is None or plan.indexer_crash_at_s is None:
+            return
+        if (
+            not self._indexer_down
+            and not self._indexer_restarted
+            and now >= plan.indexer_crash_at_s
+        ):
+            self._indexer_down = True
+        if (
+            self._indexer_down
+            and plan.indexer_restart_at_s is not None
+            and now >= plan.indexer_restart_at_s
+        ):
+            self._restart_indexer()
+
+    def _restart_indexer(self) -> None:
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
+            IndexConfig,
+            new_index,
+        )
+
+        fresh = new_index(IndexConfig.default())
+        self.indexer.kv_block_index = fresh
+        self.event_pool.index = fresh
+        self._indexer_down = False
+        self._indexer_restarted = True
+        restart = {"mode": "cold"}
+        if (
+            self.snapshot_restore
+            and self.snapshot_path
+            and os.path.exists(self.snapshot_path)
+        ):
+            from llm_d_kv_cache_manager_tpu.cluster import (
+                read_snapshot,
+                restore_index,
+            )
+
+            snap = read_snapshot(self.snapshot_path)
+            imported = restore_index(fresh, snap)
+            # Replay only the seq tail: the snapshot's watermarks make
+            # re-delivery of already-applied events a no-op, so the whole
+            # retained journal can be fed back blindly.
+            self.event_pool.set_seq_floors(snap.seq_floors())
+            skipped_before = self.event_pool.replay_skipped
+            replayed = 0
+            if self.tail_journal is not None:
+                for msg in list(self.tail_journal):
+                    self._applied_seq[(msg.pod_identifier, msg.topic)] = msg.seq
+                    self.event_pool.add_task(msg)
+                    replayed += 1
+            self.event_pool.drain()
+            self.event_pool.clear_seq_floors()
+            restart = {
+                "mode": "snapshot_restore",
+                "imported_pod_entries": imported,
+                "snapshot_created_at_s": round(snap.created_ts, 3),
+                "seq_floors": len(snap.seq_counters),
+                "tail_replayed": replayed,
+                "replay_skipped": (
+                    self.event_pool.replay_skipped - skipped_before
+                ),
+            }
+        self.replication_stats["restart"] = restart
+
+    def _maybe_snapshot(self, now: float) -> None:
+        """Periodic snapshot cadence (pre-crash only; a real replica
+        snapshots on a timer — the LAST one before the crash is what the
+        restart restores, so snapshot age bounds the replay tail)."""
+        if (
+            not self.snapshot_every_s
+            or not self.snapshot_path
+            or self._indexer_down
+            or self._indexer_restarted
+        ):
+            return
+        if (
+            self._last_snapshot_at is not None
+            and now - self._last_snapshot_at < self.snapshot_every_s
+        ):
+            return
+        from llm_d_kv_cache_manager_tpu.cluster import write_snapshot
+
+        stats = write_snapshot(
+            self.snapshot_path,
+            self.indexer.kv_block_index,
+            dict(self._applied_seq),
+            created_ts=now,
+        )
+        self._last_snapshot_at = now
+        self.replication_stats["last_snapshot"] = {
+            "at_s": round(now, 3),
+            "bytes": stats["bytes"],
+            "keys": stats["keys"],
+            "pod_entries": stats["pod_entries"],
+            "seq_counters": stats["seq_counters"],
+        }
+
     def route(self, prompt: str) -> int:
         if self.route_override is not None:
             return self.route_override(prompt)
@@ -457,8 +596,15 @@ class FleetSim:
             return min(self._alive_pods(), key=lambda i: self.pod_free_at[i])
         if self.strategy == "estimated":
             return self._route_estimated(prompt)
+        if self._indexer_down:
+            # The index service is dead: the router's scoring call times
+            # out and it falls back to least-loaded — degraded, not stuck.
+            self.indexer_down_requests += 1
+            return min(self._alive_pods(), key=lambda i: self.pod_free_at[i])
         t0 = time.perf_counter()
         scores = self.indexer.get_pod_scores(prompt, MODEL, [])
+        if self._indexer_restarted and not scores:
+            self.scores_empty_after_restart += 1
         self.read_latencies.append(time.perf_counter() - t0)
         if self._crashed and scores and any(
             int(p.split("-")[1]) in self._crashed for p in scores
@@ -541,6 +687,8 @@ class FleetSim:
         synthetic workload uses the fixed RESPONSE_WORDS)."""
         self.now = arrival
         self._apply_lifecycle(arrival)
+        self._apply_indexer_lifecycle(arrival)
+        self._maybe_snapshot(arrival)
         self._release_finished(arrival)
         pod_idx = self.route(prompt)
         if pod_idx in self._crashed:
@@ -1056,6 +1204,216 @@ def main_faults(args):
     }))
 
 
+# Indexer kill-and-restart scenario (--replication; cluster/ subsystem):
+# replay the ShareGPT trace while the INDEX SERVICE itself crashes mid-run,
+# and compare what the restarted instance starts from:
+#   no_fault          same trace, no indexer fault — the hit-rate yardstick.
+#   cold_restart      restart with an empty index: routing is blind until
+#                     the fleet re-stores its chains (the pre-cluster/
+#                     production posture, ROADMAP "Scale out the indexer").
+#   snapshot_restore  restart from the last periodic snapshot + seq-tail
+#                     replay of the retained event journal
+#                     (cluster/snapshot.py): warm in seconds.
+# Time-to-warm is sim-time from restart until the CUMULATIVE post-restart
+# token hit rate reaches REPLICATION_WARM_FRACTION of the pre-crash
+# baseline — cumulative, not windowed, so one lucky window can't call a
+# blind index warm. The dip is quantified over a fixed post-restart window.
+REPLICATION_CRASH_AT_S = 25.0
+REPLICATION_RESTART_AT_S = 30.0
+REPLICATION_SNAPSHOT_EVERY_S = 5.0
+REPLICATION_TAIL_JOURNAL = 8192
+REPLICATION_WARM_FRACTION = 0.9
+REPLICATION_DIP_WINDOW_S = 15.0
+
+
+def run_replication_arm(requests, mode: str, snapshot_path=None):
+    """One precise-arm ShareGPT replay under an indexer fault (or none)."""
+    sim_kwargs = {}
+    if mode != "no_fault":
+        from llm_d_kv_cache_manager_tpu.fleethealth import FaultPlan
+
+        sim_kwargs = dict(
+            fault_plan=FaultPlan(
+                indexer_crash_at_s=REPLICATION_CRASH_AT_S,
+                indexer_restart_at_s=REPLICATION_RESTART_AT_S,
+            ),
+            snapshot_restore=(mode == "snapshot_restore"),
+            snapshot_path=snapshot_path,
+            snapshot_every_s=(
+                REPLICATION_SNAPSHOT_EVERY_S
+                if mode == "snapshot_restore" else 0.0
+            ),
+            tail_journal_len=(
+                REPLICATION_TAIL_JOURNAL
+                if mode == "snapshot_restore" else 0
+            ),
+        )
+    sim = FleetSim("precise", **sim_kwargs)
+    records = []
+    try:
+        for req in requests:
+            h0, t0 = sim.hit_tokens, sim.total_tokens
+            ttft = sim.serve(
+                req.arrival_s, req.prompt, response_words=req.output_len
+            )
+            records.append(
+                (req.arrival_s, ttft, sim.hit_tokens - h0,
+                 sim.total_tokens - t0)
+            )
+        return {
+            "records": records,
+            "replication": dict(sim.replication_stats),
+            "indexer_down_requests": sim.indexer_down_requests,
+            "scores_empty_after_restart": sim.scores_empty_after_restart,
+        }
+    finally:
+        sim.shutdown()
+
+
+def _replication_warm_stats(records, crash_at, restart_at):
+    """Time-to-warm + dip quantification for one arm's request records."""
+    baseline = _window_hit_rate(records, t_until=crash_at)
+    post = [r for r in records if r[0] >= restart_at]
+    # Warm = the cumulative post-restart token hit rate reaches
+    # warm_fraction x baseline AND NEVER drops below it again: the
+    # threshold time is the first request after the LAST sub-threshold
+    # point, so one lucky early request can't call a blind index warm.
+    target = REPLICATION_WARM_FRACTION * baseline
+    hit = tot = 0
+    last_below = -1
+    rows = []
+    for i, (arrival, _ttft, h, t) in enumerate(post):
+        hit += h
+        tot += t
+        rows.append(arrival)
+        if not tot or (hit / tot) < target:
+            last_below = i
+    time_to_warm = None
+    if post and last_below < len(post) - 1:
+        time_to_warm = rows[last_below + 1] - restart_at
+    last_post_arrival = post[-1][0] if post else restart_at
+    return {
+        "pre_crash_hit_rate": round(baseline, 4),
+        "post_restart_hit_rate": round(
+            _window_hit_rate(records, t_from=restart_at), 4
+        ),
+        "dip_window_hit_rate": round(
+            _window_hit_rate(
+                records, t_from=restart_at,
+                t_until=restart_at + REPLICATION_DIP_WINDOW_S,
+            ), 4,
+        ),
+        "hit_rate_dip": round(
+            baseline - _window_hit_rate(
+                records, t_from=restart_at,
+                t_until=restart_at + REPLICATION_DIP_WINDOW_S,
+            ), 4,
+        ),
+        "time_to_warm_s": (
+            None if time_to_warm is None else round(time_to_warm, 3)
+        ),
+        # Never warmed before the trace ended: lower-bound for ratios.
+        "warm_censored_at_s": (
+            round(last_post_arrival - restart_at, 3)
+            if time_to_warm is None else None
+        ),
+    }
+
+
+def main_replication(args):
+    import tempfile
+
+    from llm_d_kv_cache_manager_tpu.workloads import read_trace
+
+    t_start = time.time()
+    if args.trace:
+        trace = read_trace(args.trace)
+    else:
+        trace = build_sharegpt_trace(seed=args.seed, arrival=args.arrival)
+    requests = trace.requests()
+
+    snapshot_path = os.path.join(
+        tempfile.gettempdir(), f"kvtpu_bench_snapshot_{os.getpid()}.cbor"
+    )
+    arms = {}
+    for mode in ("no_fault", "cold_restart", "snapshot_restore"):
+        arm = run_replication_arm(requests, mode, snapshot_path=snapshot_path)
+        records = arm["records"]
+        ttfts = [r[1] for r in records]
+        stats = {
+            "ttft_p50_s": round(p50(ttfts), 4),
+            "ttft_p90_s": round(p90(ttfts), 4),
+            "prefix_hit_rate": round(_window_hit_rate(records), 4),
+        }
+        if mode != "no_fault":
+            stats.update(_replication_warm_stats(
+                records, REPLICATION_CRASH_AT_S, REPLICATION_RESTART_AT_S
+            ))
+            stats["indexer_down_requests"] = arm["indexer_down_requests"]
+            stats["scores_empty_after_restart"] = (
+                arm["scores_empty_after_restart"]
+            )
+            stats["replication"] = arm["replication"]
+        arms[mode] = stats
+    try:
+        os.unlink(snapshot_path)
+    except OSError:
+        pass
+
+    cold = arms["cold_restart"]
+    warm = arms["snapshot_restore"]
+    cold_ttw = cold["time_to_warm_s"]
+    if cold_ttw is None:
+        cold_ttw = cold["warm_censored_at_s"]
+    warm_ttw = warm["time_to_warm_s"]
+    speedup = (
+        round(cold_ttw / max(warm_ttw, 1e-9), 2)
+        if (cold_ttw is not None and warm_ttw is not None) else None
+    )
+    stats = {
+        "config": {
+            "workload": "sharegpt replay (workloads/), precise arm",
+            "trace": {
+                "seed": trace.seed,
+                "sessions": len(trace.sessions),
+                "requests": len(requests),
+                "tables_version": trace.tables_version,
+            },
+            "n_pods": N_PODS,
+            "pages_per_pod": PAGES_PER_POD,
+            "indexer_crash_at_s": REPLICATION_CRASH_AT_S,
+            "indexer_restart_at_s": REPLICATION_RESTART_AT_S,
+            "snapshot_every_s": REPLICATION_SNAPSHOT_EVERY_S,
+            "tail_journal_messages": REPLICATION_TAIL_JOURNAL,
+            "warm_fraction": REPLICATION_WARM_FRACTION,
+            "dip_window_s": REPLICATION_DIP_WINDOW_S,
+        },
+        "arms": arms,
+        "time_to_warm_cold_s": cold_ttw,
+        "time_to_warm_snapshot_s": warm_ttw,
+        "snapshot_restore_time_to_warm_speedup": speedup,
+        "wall_s": round(time.time() - t_start, 1),
+    }
+    print(json.dumps(stats), file=sys.stderr)
+    artifact = {k: v for k, v in stats.items() if k != "wall_s"}
+    out = os.path.join(REPO, "benchmarking", "FLEET_BENCH_REPLICATION.json")
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({
+        "metric": "snapshot_restore_time_to_warm_speedup",
+        "value": speedup,
+        "unit": "x",
+        # Acceptance: snapshot restore warms >=5x faster than cold restart.
+        "vs_baseline": None if speedup is None else round(speedup / 5.0, 3),
+        "time_to_warm_cold_s": cold_ttw,
+        "time_to_warm_snapshot_s": warm_ttw,
+        "hit_rate_dip_cold": cold["hit_rate_dip"],
+        "hit_rate_dip_snapshot": warm["hit_rate_dip"],
+        "source": "benchmarking/FLEET_BENCH_REPLICATION.json",
+    }))
+
+
 def p50(xs):
     return sorted(xs)[len(xs) // 2]
 
@@ -1485,12 +1843,21 @@ def parse_args(argv=None):
              "stall, batch drop/dup/reorder) over the synthetic chat "
              "workload and write benchmarking/FLEET_BENCH_FAULTS.json",
     )
+    ap.add_argument(
+        "--replication", action="store_true",
+        help="run the indexer kill-and-restart scenario (FaultPlan "
+             "indexer_crash) over the ShareGPT replay: cold restart vs "
+             "snapshot+seq-tail-replay restore (cluster/), writing "
+             "benchmarking/FLEET_BENCH_REPLICATION.json",
+    )
     return ap.parse_args(argv)
 
 
 if __name__ == "__main__":
     _args = parse_args()
-    if _args.faults:
+    if _args.replication:
+        main_replication(_args)
+    elif _args.faults:
         main_faults(_args)
     elif _args.workload == "sharegpt":
         main_sharegpt(_args)

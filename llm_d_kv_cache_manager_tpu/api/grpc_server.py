@@ -29,10 +29,12 @@ logger = kvlog.get_logger("api.grpc")
 
 SERVICE_NAME = "kvtpu.api.v1.IndexerService"
 METHOD_GET_POD_SCORES = "GetPodScores"
+METHOD_GET_POD_SCORES_EX = "GetPodScoresEx"
 METHOD_EXPLAIN_SCORES = "ExplainScores"
+METHOD_CLUSTER_STATUS = "ClusterStatus"
 
 
-def _make_handler(indexer):
+def _make_handler(indexer, cluster_status_fn=None):
     def get_pod_scores(
         request: pb.GetPodScoresRequest, context: grpc.ServicerContext
     ) -> pb.GetPodScoresResponse:
@@ -51,6 +53,45 @@ def _make_handler(indexer):
         for pod, score in sorted(scores.items(), key=lambda kv: -kv[1]):
             response.scores.append(pb.PodScore(pod_identifier=pod, score=score))
         return response
+
+    def get_pod_scores_ex(
+        request: pb.GetPodScoresRequest, context: grpc.ServicerContext
+    ) -> dict:
+        """Scatter-gather transport method (cluster/scorer.py): the scores
+        PLUS per-pod matched-prefix lengths and the prompt's block-hash
+        chain — everything the partition-ownership merge needs. JSON
+        payload, same no-protoc rationale as ExplainScores."""
+        try:
+            result = indexer.get_pod_scores_ex(
+                request.prompt,
+                request.model_name,
+                list(request.pod_identifiers),
+                lora_id=request.lora_id if request.HasField("lora_id") else None,
+            )
+        except Exception as e:  # noqa: BLE001 - surface as gRPC status
+            logger.warning("GetPodScoresEx failed: %s", e)
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+            return {}
+        return {
+            "scores": result.scores,
+            "match_blocks": result.match_blocks,
+            "block_hashes": result.block_hashes,
+        }
+
+    def cluster_status(
+        request: pb.GetPodScoresRequest, context: grpc.ServicerContext
+    ) -> dict:
+        """Replication introspection (same document as GET /cluster/status;
+        the request message is ignored — reused so no new proto type is
+        needed)."""
+        if cluster_status_fn is None:
+            return {"cluster": None}
+        try:
+            return cluster_status_fn()
+        except Exception as e:  # noqa: BLE001 - surface as gRPC status
+            logger.warning("ClusterStatus failed: %s", e)
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+            return {}
 
     def explain_scores(
         request: pb.GetPodScoresRequest, context: grpc.ServicerContext
@@ -73,6 +114,16 @@ def _make_handler(indexer):
             request_deserializer=pb.GetPodScoresRequest.FromString,
             response_serializer=pb.GetPodScoresResponse.SerializeToString,
         ),
+        METHOD_GET_POD_SCORES_EX: grpc.unary_unary_rpc_method_handler(
+            get_pod_scores_ex,
+            request_deserializer=pb.GetPodScoresRequest.FromString,
+            response_serializer=lambda d: json.dumps(d).encode("utf-8"),
+        ),
+        METHOD_CLUSTER_STATUS: grpc.unary_unary_rpc_method_handler(
+            cluster_status,
+            request_deserializer=pb.GetPodScoresRequest.FromString,
+            response_serializer=lambda d: json.dumps(d).encode("utf-8"),
+        ),
         METHOD_EXPLAIN_SCORES: grpc.unary_unary_rpc_method_handler(
             explain_scores,
             request_deserializer=pb.GetPodScoresRequest.FromString,
@@ -86,10 +137,18 @@ def serve_grpc(
     indexer,
     address: str = "[::]:50051",
     max_workers: int = 8,
+    cluster_status_fn=None,
 ) -> grpc.Server:
-    """Start (non-blocking) a gRPC server wrapping the indexer."""
+    """Start (non-blocking) a gRPC server wrapping the indexer.
+
+    `cluster_status_fn` (optional zero-arg callable) backs the
+    `ClusterStatus` method — pass `ClusterScorer.status` or a replica's
+    readiness composition when this server fronts a replicated index.
+    """
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
-    server.add_generic_rpc_handlers((_make_handler(indexer),))
+    server.add_generic_rpc_handlers(
+        (_make_handler(indexer, cluster_status_fn=cluster_status_fn),)
+    )
     server.add_insecure_port(address)
     server.start()
     logger.info("gRPC IndexerService listening on %s", address)
@@ -110,6 +169,16 @@ class IndexerGrpcClient:
         )
         self._explain_call = self._channel.unary_unary(
             f"/{SERVICE_NAME}/{METHOD_EXPLAIN_SCORES}",
+            request_serializer=pb.GetPodScoresRequest.SerializeToString,
+            response_deserializer=lambda b: json.loads(b.decode("utf-8")),
+        )
+        self._ex_call = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/{METHOD_GET_POD_SCORES_EX}",
+            request_serializer=pb.GetPodScoresRequest.SerializeToString,
+            response_deserializer=lambda b: json.loads(b.decode("utf-8")),
+        )
+        self._status_call = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/{METHOD_CLUSTER_STATUS}",
             request_serializer=pb.GetPodScoresRequest.SerializeToString,
             response_deserializer=lambda b: json.loads(b.decode("utf-8")),
         )
@@ -141,6 +210,27 @@ class IndexerGrpcClient:
         if lora_id is not None:
             request.lora_id = lora_id
         return self._explain_call(request, timeout=self._timeout)
+
+    def get_pod_scores_ex(
+        self, prompt: str, model_name: str, pod_identifiers=(), lora_id=None
+    ) -> dict:
+        """Scatter-gather transport call: {"scores", "match_blocks",
+        "block_hashes"} as plain JSON types (cluster/scorer.py rebuilds a
+        PodScores from it)."""
+        request = pb.GetPodScoresRequest(
+            prompt=prompt,
+            model_name=model_name,
+            pod_identifiers=list(pod_identifiers),
+        )
+        if lora_id is not None:
+            request.lora_id = lora_id
+        return self._ex_call(request, timeout=self._timeout)
+
+    def cluster_status(self) -> dict:
+        return self._status_call(
+            pb.GetPodScoresRequest(prompt="", model_name=""),
+            timeout=self._timeout,
+        )
 
     def close(self) -> None:
         self._channel.close()

@@ -17,6 +17,13 @@ the ZMQ KVEvents write plane, and Prometheus metrics behind HTTP:
                                 recorder's own health (`obs` section);
                                 503 while the event plane cannot make
                                 progress
+  GET  /cluster/status          replication introspection: this replica's
+                                partition + readiness state and (when a
+                                scatter-gather front is wired) per-replica
+                                health
+  POST /cluster/snapshot        drain + write this replica's index
+                                snapshot (view + seq watermarks) to
+                                CLUSTER_SNAPSHOT_PATH
   GET  /debug/traces            flight recorder dump: recent complete
                                 traces + the slow-outlier reservoir
                                 (?n=<count> caps the recent list)
@@ -101,13 +108,24 @@ def config_from_env() -> dict:
         "trace_enabled": os.environ.get("KVTPU_TRACE", "1") == "1",
         "trace_ring": int(os.environ.get("KVTPU_TRACE_RING", "256")),
         "trace_slow_ms": float(os.environ.get("KVTPU_TRACE_SLOW_MS", "10")),
+        # Replicated control plane (cluster/): this process's membership in
+        # the logical index. CLUSTER_REPLICAS=1 (default) is the monolithic
+        # deployment — no partition gate, no replication section.
+        "cluster_replicas": int(os.environ.get("CLUSTER_REPLICAS", "1")),
+        "cluster_replica_id": int(os.environ.get("CLUSTER_REPLICA_ID", "0")),
+        "cluster_snapshot_path": os.environ.get("CLUSTER_SNAPSHOT_PATH", ""),
     }
 
 
 class ScoringService:
     """Owns the Indexer (read path) + EventPool (write plane)."""
 
-    def __init__(self, env: Optional[dict] = None, indexer: Optional[Indexer] = None):
+    def __init__(
+        self,
+        env: Optional[dict] = None,
+        indexer: Optional[Indexer] = None,
+        cluster_replica=None,
+    ):
         env = env or config_from_env()
         self.env = env
         # Tracing spine knobs (obs/). Only reconfigure when the env spells
@@ -170,16 +188,50 @@ class ScoringService:
         if self.fleet_health.index is None:
             self.fleet_health.bind_index(self.indexer.kv_block_index)
 
-        self.event_pool = EventPool(
-            EventPoolConfig(
-                zmq_endpoint=env["zmq_endpoint"],
-                topic_filter=env["zmq_topic"],
-                concurrency=env["pool_concurrency"],
-            ),
-            self.indexer.kv_block_index,
-            self.indexer.token_processor,
-            health_tracker=self.fleet_health,
+        # Replicated deployments wrap the event pool in an IndexerReplica:
+        # the pool gains the partition-ownership gate, and the service
+        # gains the snapshot/warm-restart surface plus the `replaying`
+        # readiness state. A single-replica config keeps the monolithic
+        # wiring byte-for-byte (IndexerReplica passes message_filter=None).
+        pool_config = EventPoolConfig(
+            zmq_endpoint=env["zmq_endpoint"],
+            topic_filter=env["zmq_topic"],
+            concurrency=env["pool_concurrency"],
         )
+        self.replica = None
+        if cluster_replica is not None:
+            self.replica = cluster_replica
+            self.event_pool = cluster_replica.event_pool
+        elif (
+            int(env.get("cluster_replicas", 1)) > 1
+            or env.get("cluster_snapshot_path")
+        ):
+            from llm_d_kv_cache_manager_tpu.cluster import (
+                ClusterConfig,
+                IndexerReplica,
+            )
+
+            self.replica = IndexerReplica(
+                self.indexer,
+                ClusterConfig(
+                    num_replicas=int(env.get("cluster_replicas", 1)),
+                    replica_id=int(env.get("cluster_replica_id", 0)),
+                    snapshot_path=env.get("cluster_snapshot_path", ""),
+                ),
+                pool_config=pool_config,
+                health_tracker=self.fleet_health,
+            )
+            self.event_pool = self.replica.event_pool
+        else:
+            self.event_pool = EventPool(
+                pool_config,
+                self.indexer.kv_block_index,
+                self.indexer.token_processor,
+                health_tracker=self.fleet_health,
+            )
+        # Optional scatter-gather front (embedders wire a ClusterScorer
+        # over peer replicas); surfaces through /cluster/status only.
+        self.cluster_scorer = None
 
     def start(self, with_subscriber: bool = True) -> None:
         self.indexer.run()
@@ -324,10 +376,24 @@ class ScoringService:
             "removals_lost": self.event_pool.removals_lost,
         }
         ready = bool(self._started and workers > 0 and sub_ready)
+        status = "ready" if ready else "unready"
+        replication = None
+        if self.replica is not None:
+            replication = self.replica.readiness()
+            if ready and replication["state"] != "ready":
+                # Replaying the seq tail after a snapshot load: the view is
+                # partially stale, so routers must not scatter-gather here
+                # yet — but this is warm-up, not failure, and gets its own
+                # status string (still 503, like unready).
+                status = replication["state"]
         memo = self.indexer.token_processor.chain_memo
         return {
-            "status": "ready" if ready else "unready",
+            "status": status,
             "started": self._started,
+            # Replicated-control-plane section: replica id/partition shape,
+            # readiness state (ready | replaying), snapshot age, replay
+            # bookkeeping. None on monolithic deployments.
+            "replication": replication,
             "subscriber": sub_info,
             "event_pool": pool_info,
             "fleet": self.fleet_health.summary(),
@@ -345,6 +411,41 @@ class ScoringService:
         status = 200 if payload["status"] == "ready" else 503
         return web.json_response(payload, status=status)
 
+    async def handle_cluster_status(self, request: web.Request) -> web.Response:
+        """Replication introspection: this replica's partition/readiness
+        plus the scatter-gather front's per-replica health when one is
+        wired. Same document the gRPC ClusterStatus method serves."""
+        def build():
+            return {
+                "replica": (
+                    self.replica.readiness() if self.replica is not None else None
+                ),
+                "scorer": (
+                    self.cluster_scorer.status()
+                    if self.cluster_scorer is not None
+                    else None
+                ),
+            }
+
+        return web.json_response(await asyncio.to_thread(build))
+
+    async def handle_cluster_snapshot(self, request: web.Request) -> web.Response:
+        """POST: drain the event pool and write this replica's snapshot
+        (view + seq watermarks) to the configured path."""
+        if self.replica is None:
+            return web.json_response(
+                {"error": "not a replicated deployment (set CLUSTER_REPLICAS "
+                          "/ CLUSTER_SNAPSHOT_PATH)"},
+                status=400,
+            )
+        try:
+            stats = await asyncio.to_thread(self.replica.take_snapshot)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        except OSError as e:
+            return web.json_response({"error": str(e)}, status=500)
+        return web.json_response(stats)
+
     def make_app(self) -> web.Application:
         app = web.Application()
         app.router.add_post("/score_completions", self.handle_score_completions)
@@ -354,6 +455,8 @@ class ScoringService:
         app.router.add_get("/metrics", self.handle_metrics)
         app.router.add_get("/health", self.handle_health)
         app.router.add_get("/readyz", self.handle_readyz)
+        app.router.add_get("/cluster/status", self.handle_cluster_status)
+        app.router.add_post("/cluster/snapshot", self.handle_cluster_snapshot)
         app.router.add_get("/debug/traces", self.handle_debug_traces)
         app.router.add_get("/debug/score_explain", self.handle_score_explain)
         app.router.add_post("/debug/score_explain", self.handle_score_explain)

@@ -61,9 +61,25 @@ class PodFaults:
 class FaultPlan:
     seed: int = 0
     pods: Dict[str, PodFaults] = field(default_factory=dict)
+    # Indexer (control-plane) fault: the index service itself dies at
+    # crash_at and comes back at restart_at. While down, nothing digests
+    # events and nothing answers scoring calls — the replicated control
+    # plane's whole reason to exist. The bench's two recovery arms differ
+    # in what the restarted instance starts FROM: an empty index (cold) or
+    # a snapshot + seq-tail replay (warm, cluster/snapshot.py).
+    indexer_crash_at_s: Optional[float] = None
+    indexer_restart_at_s: Optional[float] = None
 
     def for_pod(self, pod_id: str) -> Optional[PodFaults]:
         return self.pods.get(pod_id)
+
+    def indexer_crashed(self, now: float) -> bool:
+        if self.indexer_crash_at_s is None or now < self.indexer_crash_at_s:
+            return False
+        return (
+            self.indexer_restart_at_s is None
+            or now < self.indexer_restart_at_s
+        )
 
     def as_dict(self) -> dict:
         """JSON-serializable provenance for bench artifacts."""
@@ -82,7 +98,13 @@ class FaultPlan:
                 )
                 if v not in (None, 0.0)
             }
-        return {"seed": self.seed, "pods": out}
+        doc = {"seed": self.seed, "pods": out}
+        if self.indexer_crash_at_s is not None:
+            doc["indexer"] = {
+                "crash_at_s": self.indexer_crash_at_s,
+                "restart_at_s": self.indexer_restart_at_s,
+            }
+        return doc
 
 
 class FaultInjector:

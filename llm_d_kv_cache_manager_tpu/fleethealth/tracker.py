@@ -379,6 +379,22 @@ class FleetHealthTracker:
                 },
             }
 
+    def seq_snapshot(self) -> Dict[str, Dict[str, int]]:
+        """Per-(pod, topic) last-applied wire seq: {pod: {topic: seq}}.
+
+        The replication counters (cluster/snapshot.py): a snapshot stores
+        them next to the index view so a restarted replica can replay only
+        the event tail — anything at-or-below these watermarks is already
+        inside the imported view. Pods whose transport carries no seq are
+        absent.
+        """
+        with self._mu:
+            return {
+                pod: dict(rec.last_seq)
+                for pod, rec in self._pods.items()
+                if rec.last_seq
+            }
+
     def anomaly_totals(self) -> dict:
         with self._mu:
             return {

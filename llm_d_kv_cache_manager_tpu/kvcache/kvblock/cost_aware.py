@@ -17,7 +17,7 @@ from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Set, Union
 
-from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index, IndexView
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry, pod_matches
 from llm_d_kv_cache_manager_tpu.utils.humansize import parse_human_size
 from llm_d_kv_cache_manager_tpu.utils.lru import LRUCache
@@ -190,6 +190,64 @@ class CostAwareMemoryIndex(Index):
                     self._total_cost -= pod_cache.cost
                     self._drop_engine_mappings(request_key)
         return removed
+
+    def export_view(self) -> IndexView:
+        """Snapshot oldest-first (Index.export_view contract); cost
+        bookkeeping is derived state and is recomputed on import."""
+        entries = []
+        engine_map = []
+        with self._mu:
+            for request_key, pod_cache in self._data.items():
+                with pod_cache.mu:
+                    pods = tuple(
+                        (e.pod_identifier, e.device_tier)
+                        for e in pod_cache.cache.keys()
+                    )
+                entries.append(
+                    (request_key.model_name, request_key.chunk_hash, pods)
+                )
+            engine_map = [
+                (ek.model_name, ek.chunk_hash, rk.model_name, rk.chunk_hash)
+                for ek, rk in self._engine_to_request.items()
+            ]
+        return IndexView(entries=entries, engine_map=engine_map)
+
+    def import_view(self, view: IndexView) -> int:
+        """Rebuild in view order, recosting each key and re-running the
+        byte-budget eviction sweep at the end (Index.import_view) — a
+        snapshot from a larger-budget replica imports to the newest
+        entries that fit, not over budget."""
+        imported = 0
+        with self._mu:
+            for model_name, chunk_hash, pods in view.entries:
+                request_key = Key(model_name, chunk_hash)
+                pod_cache = self._data.get(request_key)
+                if pod_cache is None:
+                    pod_cache = _CostedPodCache(self._pod_cache_size)
+                    self._data[request_key] = pod_cache
+                else:
+                    self._data.move_to_end(request_key)
+                self._total_cost -= pod_cache.cost
+                with pod_cache.mu:
+                    for pod, tier in pods:
+                        pod_cache.cache.add(PodEntry(pod, tier), None)
+                        imported += 1
+                    pod_cache.cost = calculate_byte_size(
+                        request_key, pod_cache.cache.keys()
+                    )
+                self._total_cost += pod_cache.cost
+            for engine_model, engine_hash, req_model, req_hash in view.engine_map:
+                engine_key = Key(engine_model, engine_hash)
+                request_key = Key(req_model, req_hash)
+                self._engine_to_request[engine_key] = request_key
+                self._request_to_engines.setdefault(request_key, set()).add(
+                    engine_key
+                )
+            while self._total_cost > self._budget and len(self._data) > 1:
+                evicted_key, evicted_cache = self._data.popitem(last=False)
+                self._total_cost -= evicted_cache.cost
+                self._drop_engine_mappings(evicted_key)
+        return imported
 
     def _drop_engine_mappings(self, request_key: Key) -> None:
         for engine_key in self._request_to_engines.pop(request_key, ()):  # noqa: B020

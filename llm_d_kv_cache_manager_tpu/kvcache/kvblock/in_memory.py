@@ -21,7 +21,7 @@ import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Set
 
-from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index, IndexView
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry, pod_matches
 from llm_d_kv_cache_manager_tpu.utils.lru import LRUCache
 from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
@@ -178,3 +178,38 @@ class InMemoryIndex(Index):
                 if request_key in emptied:
                     self._engine_to_request.remove(engine_key)
         return removed
+
+    def export_view(self) -> IndexView:
+        """Snapshot both LRUs oldest-first (Index.export_view contract)."""
+        entries = []
+        for request_key, pod_cache in self._data.items():
+            with pod_cache.mu:
+                pods = tuple(
+                    (e.pod_identifier, e.device_tier)
+                    for e in pod_cache.cache.keys()
+                )
+            entries.append((request_key.model_name, request_key.chunk_hash, pods))
+        engine_map = [
+            (ek.model_name, ek.chunk_hash, rk.model_name, rk.chunk_hash)
+            for ek, rk in self._engine_to_request.items()
+        ]
+        return IndexView(entries=entries, engine_map=engine_map)
+
+    def import_view(self, view: IndexView) -> int:
+        """Rebuild both key spaces in view order (Index.import_view)."""
+        imported = 0
+        for model_name, chunk_hash, pods in view.entries:
+            request_key = Key(model_name, chunk_hash)
+            pod_cache = self._data.get(request_key)
+            if pod_cache is None:
+                pod_cache = _PodCache(self._pod_cache_size)
+                self._data.add(request_key, pod_cache)
+            with pod_cache.mu:
+                for pod, tier in pods:
+                    pod_cache.cache.add(PodEntry(pod, tier), None)
+                    imported += 1
+        for engine_model, engine_hash, req_model, req_hash in view.engine_map:
+            self._engine_to_request.add(
+                Key(engine_model, engine_hash), Key(req_model, req_hash)
+            )
+        return imported

@@ -62,6 +62,56 @@ class Index(abc.ABC):
         both key spaces. Returns the number of pod entries removed.
         """
 
+    def export_view(self) -> "IndexView":
+        """Project the published read state into a portable `IndexView`.
+
+        The snapshot primitive (cluster/snapshot.py): entries come out in
+        recency order (oldest first) so `import_view` into a fresh backend
+        reconstructs LRU order, and `get_pod_scores` over the restored
+        index is bit-identical to the source (pinned by
+        tests/test_cluster.py across all four backends). Best-effort under
+        concurrent writers — like `remove_pod`, a racing add may or may
+        not be captured; warm-restart callers snapshot a quiesced or
+        drained index.
+        """
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support export_view"
+        )
+
+    def import_view(self, view: "IndexView") -> int:
+        """Load an `export_view` projection into this (fresh) backend.
+
+        Entries are applied oldest-first, re-establishing both key spaces
+        and recency order. Import targets an EMPTY index: existing entries
+        are kept (imports merge), but recency interleaving with pre-import
+        state is unspecified. Returns the number of pod entries imported.
+        """
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support import_view"
+        )
+
+
+@dataclass
+class IndexView:
+    """Portable projection of an index's read state (export/import_view).
+
+    `entries` holds one row per request key — ``(model_name, chunk_hash,
+    ((pod_identifier, device_tier), ...))`` — in recency order, oldest
+    first, with each key's pod tuple likewise oldest-first (the order
+    `LRUCache.keys()` publishes). `engine_map` rows are
+    ``(engine_model, engine_hash, request_model, request_hash)``. Plain
+    strings/ints only, so the view serializes to canonical CBOR
+    (cluster/snapshot.py) without backend knowledge.
+    """
+
+    entries: List[tuple] = field(default_factory=list)
+    engine_map: List[tuple] = field(default_factory=list)
+
+    def entry_count(self) -> int:
+        """Total pod entries across all keys (the unit `remove_pod` and
+        `import_view` count in)."""
+        return sum(len(row[2]) for row in self.entries)
+
 
 @dataclass
 class IndexConfig:

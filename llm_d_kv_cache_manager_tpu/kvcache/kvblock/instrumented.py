@@ -62,3 +62,12 @@ class InstrumentedIndex(Index):
         if m.index_evictions is not None and removed:
             m.index_evictions.inc(removed)
         return removed
+
+    def export_view(self):
+        return self.inner.export_view()
+
+    def import_view(self, view) -> int:
+        imported = self.inner.import_view(view)
+        if m.index_admissions is not None and imported:
+            m.index_admissions.inc(imported)
+        return imported

@@ -22,7 +22,7 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Set
 
-from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index, IndexView
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry, pod_matches
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.resp import (
     RespConnection,
@@ -335,6 +335,72 @@ class RedisIndex(Index):
                 if stale:
                     self._pipeline([("DEL", *stale)])
         return removed
+
+    def export_view(self) -> IndexView:
+        """SCAN-walk the keyspace into an IndexView (Index.export_view).
+
+        Redis has no recency order to preserve — rows come out in SCAN
+        order, which is fine: restores into redis are order-insensitive,
+        and restores into LRU backends get an arbitrary-but-valid recency
+        seed. Connection errors propagate (a snapshot must be complete or
+        fail loudly, never silently partial)."""
+        entries = []
+        engine_map = []
+        for page in self._scan_pages():
+            request_strs = [k for k in page if not k.startswith("engine:")]
+            engine_strs = [k for k in page if k.startswith("engine:")]
+            if request_strs:
+                replies = self._pipeline([("HKEYS", k) for k in request_strs])
+                for key_str, reply in zip(request_strs, replies):
+                    key = _parse_key(key_str)
+                    if key is None or isinstance(reply, RespError) or reply is None:
+                        continue
+                    pods = []
+                    for field in reply:
+                        entry = _parse_entry(
+                            field.decode("utf-8")
+                            if isinstance(field, bytes) else field
+                        )
+                        if entry is not None:
+                            pods.append((entry.pod_identifier, entry.device_tier))
+                    entries.append((key.model_name, key.chunk_hash, tuple(pods)))
+            if engine_strs:
+                values = self._pipeline([("GET", k) for k in engine_strs])
+                for key_str, value in zip(engine_strs, values):
+                    if value is None or isinstance(value, RespError):
+                        continue
+                    engine_key = _parse_key(key_str[len("engine:"):])
+                    request_key = _parse_key(
+                        value.decode("utf-8") if isinstance(value, bytes) else value
+                    )
+                    if engine_key is None or request_key is None:
+                        continue
+                    engine_map.append((
+                        engine_key.model_name, engine_key.chunk_hash,
+                        request_key.model_name, request_key.chunk_hash,
+                    ))
+        return IndexView(entries=entries, engine_map=engine_map)
+
+    def import_view(self, view: IndexView) -> int:
+        """Pipelined HSET/SET restore (Index.import_view). Batched in
+        pages so a large snapshot doesn't build one giant pipeline."""
+        imported = 0
+        commands = []
+        for model_name, chunk_hash, pods in view.entries:
+            key_str = _key_str(Key(model_name, chunk_hash))
+            for pod, tier in pods:
+                commands.append(
+                    ("HSET", key_str, str(PodEntry(pod, tier)), "")
+                )
+                imported += 1
+        for engine_model, engine_hash, req_model, req_hash in view.engine_map:
+            commands.append((
+                "SET", _engine_key_str(Key(engine_model, engine_hash)),
+                _key_str(Key(req_model, req_hash)),
+            ))
+        for i in range(0, len(commands), 1024):
+            self._pipeline(commands[i:i + 1024])
+        return imported
 
     def _scan_pages(self, match: str = "*"):
         """Yield pages of keys (decoded str) via cursor SCAN."""

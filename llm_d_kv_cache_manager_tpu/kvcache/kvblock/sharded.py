@@ -50,7 +50,7 @@ from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
     DEFAULT_INDEX_SIZE,
     DEFAULT_PODS_PER_KEY,
 )
-from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index, IndexView
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry, pod_matches
 from llm_d_kv_cache_manager_tpu.utils.lru import LRUCache
 from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
@@ -353,3 +353,56 @@ class ShardedIndex(Index):
                     if request_key in emptied:
                         seg.engine_to_request.remove(engine_key)
         return removed
+
+    def export_view(self) -> IndexView:
+        """Snapshot segment by segment, each stripe oldest-first
+        (Index.export_view contract). Keys re-stripe identically on
+        import (chunk_hash % S is config-independent of insertion
+        history), so a same-shape restore reproduces per-segment recency
+        exactly; cross-backend restores see segment-grouped order."""
+        entries = []
+        engine_map = []
+        for seg in self._segments:
+            for request_key, pod_cache in seg.data.items():
+                with pod_cache.mu:
+                    pods = tuple(
+                        (e.pod_identifier, e.device_tier)
+                        for e in pod_cache.cache.keys()
+                    )
+                entries.append(
+                    (request_key.model_name, request_key.chunk_hash, pods)
+                )
+            for engine_key, request_key in seg.engine_to_request.items():
+                engine_map.append((
+                    engine_key.model_name, engine_key.chunk_hash,
+                    request_key.model_name, request_key.chunk_hash,
+                ))
+        return IndexView(entries=entries, engine_map=engine_map)
+
+    def import_view(self, view: IndexView) -> int:
+        """Rebuild segments + the lock-free read view (Index.import_view).
+
+        Entries publish under each pod cache's mutex exactly like `add`,
+        so a replica can import while its read path is already serving —
+        lookups see before/after states of a key, never a torn one."""
+        imported = 0
+        read_view = self._view
+        for model_name, chunk_hash, pods in view.entries:
+            request_key = Key(model_name, chunk_hash)
+            seg = self._segments[self.shard_of(request_key)]
+            pod_cache = seg.data.get(request_key)
+            if pod_cache is None:
+                pod_cache = _ShardPodCache(self._pod_cache_size)
+                seg.data.add(request_key, pod_cache)
+            with pod_cache.mu:
+                for pod, tier in pods:
+                    pod_cache.cache.add(PodEntry(pod, tier), None)
+                    imported += 1
+                pod_cache.republish()
+                read_view[request_key] = pod_cache.entries
+        for engine_model, engine_hash, req_model, req_hash in view.engine_map:
+            engine_key = Key(engine_model, engine_hash)
+            self._segments[self.shard_of(engine_key)].engine_to_request.add(
+                engine_key, Key(req_model, req_hash)
+            )
+        return imported

@@ -86,6 +86,10 @@ _NOOP_CTX = contextlib.nullcontext()
 class EventPoolConfig:
     zmq_endpoint: str = "tcp://*:5557"
     topic_filter: str = "kv@"
+    # Partitioned subscribe (cluster/): when set, overrides `topic_filter`
+    # with an explicit filter list — one "kv@<pod-id>@" prefix per owned
+    # pod. `ZMQSubscriber.resubscribe` swaps the live set on reassignment.
+    topic_filters: Optional[List[str]] = None
     concurrency: int = 4
     default_device_tier: str = DEFAULT_DEVICE_TIER
     # Per-shard queue bound; <=0 means unbounded (not recommended in
@@ -119,10 +123,28 @@ class EventPool:
         index: Index,
         token_processor: ChunkedTokenDatabase,
         health_tracker=None,
+        message_filter=None,
     ):
         self.config = config or EventPoolConfig()
         self.index = index
         self.token_processor = token_processor
+        # Optional partition gate (cluster/partition.py): a predicate over
+        # the incoming Message; False means "another replica owns this
+        # pod's stream" and the message is discarded before sharding. The
+        # belt to the ZMQ topic-filter braces — prefix subscriptions are
+        # best-effort (a replica may subscribe broadly while its pod list
+        # is still being discovered), ownership here is authoritative.
+        self.message_filter = message_filter
+        # Seq-tail replay floors (cluster/replica.py warm restart): per
+        # (pod_identifier, topic) wire-seq watermarks loaded from a
+        # snapshot. A replayed message at-or-below its floor was already
+        # applied to the imported view — dropping it is what makes replay
+        # idempotent. Cleared by `clear_seq_floors()` once the tail is
+        # consumed, so a publisher that later restarts its seq at 0 is not
+        # mistaken for stale replay.
+        self._seq_floors: dict = {}
+        self._filtered = 0
+        self._replay_skipped = 0
         # Optional fleethealth.FleetHealthTracker (duck-typed to avoid an
         # import cycle): every decoded batch stamps per-pod liveness and
         # runs seq/ts gap detection; poison pills count as decode failures.
@@ -178,7 +200,11 @@ class EventPool:
                 )
 
                 self._subscriber = ZMQSubscriber(
-                    self, self.config.zmq_endpoint, self.config.topic_filter
+                    self,
+                    self.config.zmq_endpoint,
+                    self.config.topic_filters
+                    if self.config.topic_filters is not None
+                    else self.config.topic_filter,
                 )
                 self._subscriber.start()
 
@@ -241,6 +267,30 @@ class EventPool:
         with self._dropped_mu:
             return self._removals_lost
 
+    @property
+    def filtered_events(self) -> int:
+        """Messages discarded by the partition gate (another replica's)."""
+        with self._dropped_mu:
+            return self._filtered
+
+    @property
+    def replay_skipped(self) -> int:
+        """Replayed messages dropped at-or-below their seq floor."""
+        with self._dropped_mu:
+            return self._replay_skipped
+
+    def set_seq_floors(self, floors: dict) -> None:
+        """Install per-(pod_identifier, topic) replay watermarks.
+
+        `floors` maps ``(pod, topic) -> last_applied_seq`` (the counters a
+        snapshot carries). Messages at-or-below the floor are no-ops.
+        """
+        self._seq_floors = dict(floors)
+
+    def clear_seq_floors(self) -> None:
+        """End of replay: live-stream seqs flow unfiltered again."""
+        self._seq_floors = {}
+
     def queue_depths(self) -> List[int]:
         """Approximate per-shard queue depth (readiness introspection)."""
         return [q.qsize() for q in self._queues]
@@ -258,6 +308,16 @@ class EventPool:
         """
         if self._shutdown:
             return  # shutdown in progress: drop quietly
+        if self.message_filter is not None and not self.message_filter(msg):
+            with self._dropped_mu:
+                self._filtered += 1
+            return
+        if self._seq_floors:
+            floor = self._seq_floors.get((msg.pod_identifier, msg.topic))
+            if floor is not None and msg.seq <= floor:
+                with self._dropped_mu:
+                    self._replay_skipped += 1
+                return
         if msg.enqueue_t == 0.0:
             msg.enqueue_t = time.perf_counter()
         # Enqueuing before start() is fine — the bounded queue accumulates

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import random
 import threading
-from typing import Optional
+from typing import List, Optional, Sequence, Union
 
 import zmq
 
@@ -59,17 +59,73 @@ def backoff_delay(
     return delay
 
 
+def _normalize_filters(topic_filter: Union[str, Sequence[str]]) -> List[str]:
+    """One filter string, or a sequence of them (partitioned subscribe).
+
+    An empty sequence degenerates to the subscribe-everything filter ""
+    rather than a socket with no subscriptions at all — a replica whose
+    partition map is momentarily empty should see (and discard) traffic,
+    not silently go deaf.
+    """
+    if isinstance(topic_filter, str):
+        return [topic_filter]
+    filters = [str(f) for f in topic_filter]
+    return filters or [""]
+
+
 class ZMQSubscriber:
-    def __init__(self, pool, endpoint: str, topic_filter: str = "kv@"):
+    def __init__(
+        self,
+        pool,
+        endpoint: str,
+        topic_filter: Union[str, Sequence[str]] = "kv@",
+    ):
         self.pool = pool
         self.endpoint = endpoint
-        self.topic_filter = topic_filter
+        # Subscription filter set. ZMQ SUB filters are prefix matches, so a
+        # partitioned replica subscribes to one "kv@<pod-id>@" prefix per
+        # owned pod (cluster/partition.py builds the list) instead of the
+        # firehose "kv@". Kept as a list; `topic_filter` (the first entry)
+        # survives for single-filter callers and log lines.
+        self.topic_filters = _normalize_filters(topic_filter)
         # Consecutive _run_subscriber exits without a successful bind+poll
         # session; reset on every successful bind. Read by /readyz.
         self.consecutive_failures = 0
+        # Filter swaps applied by the receive loop (introspection/tests).
+        self.resubscriptions = 0
+        self._filters_mu = threading.Lock()
+        self._pending_filters: Optional[List[str]] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._ctx: Optional[zmq.Context] = None
+
+    @property
+    def topic_filter(self) -> str:
+        return self.topic_filters[0]
+
+    def resubscribe(self, topic_filter: Union[str, Sequence[str]]) -> None:
+        """Swap the subscription filter set without a process restart.
+
+        Partition reassignment (a replica joining/leaving the cluster)
+        changes which topic prefixes this subscriber should digest. The
+        swap is applied by the receive loop between polls on the SAME
+        bound socket — no rebind, no backoff reset, and engines' PUB
+        sockets never see the endpoint flap. When the loop isn't running
+        the new set simply becomes the initial subscription of the next
+        `start()`.
+        """
+        filters = _normalize_filters(topic_filter)
+        with self._filters_mu:
+            if self._thread is not None and self._thread.is_alive():
+                self._pending_filters = filters
+            else:
+                self.topic_filters = filters
+                self._pending_filters = None
+
+    def _take_pending_filters(self) -> Optional[List[str]]:
+        with self._filters_mu:
+            pending, self._pending_filters = self._pending_filters, None
+            return pending
 
     def start(self) -> None:
         if self._thread is not None:
@@ -126,8 +182,17 @@ class ZMQSubscriber:
             return
         try:
             sub.bind(self.endpoint)
-            sub.setsockopt_string(zmq.SUBSCRIBE, self.topic_filter)
-            logger.info("bound subscriber socket at %s", self.endpoint)
+            # Fold any resubscribe() that raced the (re)bind into the
+            # initial subscription set, then subscribe every filter.
+            pending = self._take_pending_filters()
+            if pending is not None:
+                self.topic_filters = pending
+            for f in self.topic_filters:
+                sub.setsockopt_string(zmq.SUBSCRIBE, f)
+            logger.info(
+                "bound subscriber socket at %s (%d filter(s))",
+                self.endpoint, len(self.topic_filters),
+            )
             self.consecutive_failures = 0
             self._notify_health(connected=True)
 
@@ -135,6 +200,23 @@ class ZMQSubscriber:
             poller.register(sub, zmq.POLLIN)
 
             while not self._stop.is_set():
+                pending = self._take_pending_filters()
+                if pending is not None:
+                    # Partition reassignment: swap filters on the live
+                    # socket. Unsubscribe-then-subscribe on the same socket
+                    # is atomic enough for our semantics — a message
+                    # matching neither set during the swap was not owned by
+                    # this replica under either assignment.
+                    for f in self.topic_filters:
+                        sub.setsockopt_string(zmq.UNSUBSCRIBE, f)
+                    for f in pending:
+                        sub.setsockopt_string(zmq.SUBSCRIBE, f)
+                    self.topic_filters = pending
+                    self.resubscriptions += 1
+                    logger.info(
+                        "resubscribed %s with %d filter(s)",
+                        self.endpoint, len(pending),
+                    )
                 try:
                     polled = dict(poller.poll(POLL_TIMEOUT_MS))
                 except zmq.ZMQError as e:

@@ -12,7 +12,7 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
-from prometheus_client import REGISTRY, Counter, Histogram
+from prometheus_client import REGISTRY, Counter, Gauge, Histogram
 
 from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
 
@@ -70,6 +70,16 @@ stage_latency: Optional[Histogram] = None
 # placement view. Observed per batch (not strided).
 event_apply_delay: Optional[Histogram] = None
 
+# Replicated control plane (cluster/): partition shape, snapshot freshness,
+# replay progress, and scatter-gather degradation. Gauges are per-process
+# (one replica per process); the state-transition counter's label takes
+# values from the fixed {ready, replaying} set in cluster/replica.py.
+replica_partitions: Optional[Gauge] = None
+replica_snapshot_age: Optional[Gauge] = None
+replica_replay_lag: Optional[Gauge] = None
+replica_state_transitions: Optional[Counter] = None
+replica_scatter_errors: Optional[Counter] = None
+
 _APPLY_DELAY_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
     5.0, 10.0, 30.0, 60.0,
@@ -92,6 +102,8 @@ def register_metrics(registry=None) -> None:
     global event_stream_anomalies, redis_state_transitions
     global transfer_failures, route_prefetch_blocks
     global stage_latency, event_apply_delay
+    global replica_partitions, replica_snapshot_age, replica_replay_lag
+    global replica_state_transitions, replica_scatter_errors
 
     with _register_lock:
         if _registered:
@@ -219,6 +231,36 @@ def register_metrics(registry=None) -> None:
             buckets=_APPLY_DELAY_BUCKETS,
             registry=reg,
         )
+        replica_partitions = Gauge(
+            "kvcache_replica_partition_count",
+            "Number of replicas the event-stream partition map is striped "
+            "over (cluster/partition.py)",
+            registry=reg,
+        )
+        replica_snapshot_age = Gauge(
+            "kvcache_replica_snapshot_age_seconds",
+            "Age of this replica's last written index snapshot",
+            registry=reg,
+        )
+        replica_replay_lag = Gauge(
+            "kvcache_replica_replay_lag_events",
+            "Event-tail messages still pending during a warm restart's "
+            "seq-tail replay (0 when ready)",
+            registry=reg,
+        )
+        replica_state_transitions = Counter(
+            "kvcache_replica_state_transitions_total",
+            "Replica readiness-state transitions, labeled by the state "
+            "entered (ready/replaying)",
+            labelnames=("state",),
+            registry=reg,
+        )
+        replica_scatter_errors = Counter(
+            "kvcache_replica_scatter_errors_total",
+            "Scatter-gather fan-out calls that a replica failed or timed "
+            "out (its partition degraded to no-cache-signal)",
+            registry=reg,
+        )
         _registered = True
 
 
@@ -298,6 +340,31 @@ def observe_apply_delay(seconds: float) -> None:
     """Record one batch's event-publish → index-visible latency."""
     if event_apply_delay is not None:
         event_apply_delay.observe(seconds)
+
+
+def set_replica_partitions(n: int) -> None:
+    if replica_partitions is not None:
+        replica_partitions.set(n)
+
+
+def set_snapshot_age(seconds: float) -> None:
+    if replica_snapshot_age is not None:
+        replica_snapshot_age.set(seconds)
+
+
+def set_replay_lag(n: int) -> None:
+    if replica_replay_lag is not None:
+        replica_replay_lag.set(n)
+
+
+def count_replica_transition(state: str) -> None:
+    if replica_state_transitions is not None:
+        replica_state_transitions.labels(state=state).inc()
+
+
+def count_scatter_error() -> None:
+    if replica_scatter_errors is not None:
+        replica_scatter_errors.inc()
 
 
 def counter_value(c: Optional[Counter]) -> float:

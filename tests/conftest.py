@@ -52,6 +52,19 @@ def pytest_collection_modifyitems(config, items):
             if "transfer" in item.keywords:
                 item.add_marker(skip)
 
+    # `cluster`-marked tests exercise the gRPC scatter-gather transport;
+    # the local-transport cluster tests are unmarked and always run.
+    try:
+        import grpc  # noqa: F401
+    except ImportError:
+        skip = pytest.mark.skip(
+            reason="grpcio not available — the cluster gRPC transport "
+            "tests need it (pip install grpcio)"
+        )
+        for item in items:
+            if "cluster" in item.keywords:
+                item.add_marker(skip)
+
 
 FIXTURES_DIR = os.path.join(os.path.dirname(__file__), "fixtures")
 TEST_MODEL_NAME = "test-model"

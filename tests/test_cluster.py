@@ -1,0 +1,811 @@
+"""Replicated indexer control plane tests (cluster/ subsystem).
+
+The load-bearing pins:
+
+- Scatter-gather `get_pod_scores` across N=4 local replicas, each digesting
+  only its event-stream partition, is BIT-IDENTICAL to a single indexer
+  that digested everything (the acceptance criterion).
+- `import_view(export_view(idx))` yields bit-identical lookup+score results
+  for randomized chains across all four backends (in_memory, sharded,
+  cost_aware, redis via fake_redis), including the file round-trip through
+  the versioned CBOR snapshot.
+- Seq-tail replay is idempotent: replaying an already-applied event is a
+  no-op (even a conflicting payload at the same seq cannot corrupt the
+  restored view).
+- /readyz reports `replaying` (503, distinct from `unready`) while a
+  replica is replaying its tail.
+"""
+
+import asyncio
+import os
+import random
+import socket
+
+import pytest
+
+from tests.conftest import TEST_MODEL_NAME, TEST_TOKENIZER_JSON
+from llm_d_kv_cache_manager_tpu.cluster import (
+    ClusterConfig,
+    ClusterScorer,
+    IndexerReplica,
+    LocalReplicaTransport,
+    ReplicaPartitioner,
+    SnapshotFormatError,
+    read_snapshot,
+    restore_index,
+    write_snapshot,
+)
+from llm_d_kv_cache_manager_tpu.cluster.snapshot import (
+    decode_snapshot,
+    encode_snapshot,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.cost_aware import (
+    CostAwareIndexConfig,
+    CostAwareMemoryIndex,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.hashing import fnv32a
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+    InMemoryIndex,
+    InMemoryIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.sharded import (
+    ShardedIndex,
+    ShardedIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.scorer import LongestPrefixScorer
+from llm_d_kv_cache_manager_tpu.kvevents.events import BlockStored, EventBatch
+from llm_d_kv_cache_manager_tpu.kvevents.pool import (
+    EventPool,
+    EventPoolConfig,
+    Message,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPool,
+    TokenizersPoolConfig,
+)
+
+BLOCK_SIZE = 4
+N_REPLICAS = 4
+PODS = [f"pod-{i}" for i in range(8)]
+
+WORDS = (
+    "alpha bravo charlie delta echo foxtrot golf hotel india juliet "
+    "kilo lima mike november oscar papa quebec romeo sierra tango"
+).split()
+
+
+def _text(rng, n):
+    return " ".join(rng.choice(WORDS) for _ in range(n))
+
+
+# -- partitioner --------------------------------------------------------------
+
+
+class TestPartitioner:
+    def test_fnv_striping_alignment(self):
+        # The assignment IS the kvevents pool's FNV striping formula —
+        # pinned so the two can never drift apart silently.
+        p = ReplicaPartitioner(N_REPLICAS)
+        for pod in PODS:
+            assert p.replica_for(pod) == fnv32a(pod.encode()) % N_REPLICAS
+
+    def test_dp_ranks_follow_their_pod(self):
+        p = ReplicaPartitioner(N_REPLICAS)
+        for pod in PODS:
+            for rank in (0, 1, 7):
+                assert p.replica_for(f"{pod}@dp{rank}") == p.replica_for(pod)
+
+    def test_partition_map_covers_and_disjoint(self):
+        p = ReplicaPartitioner(N_REPLICAS)
+        pmap = p.partition_map(PODS)
+        all_pods = [pod for pods in pmap.values() for pod in pods]
+        assert sorted(all_pods) == sorted(PODS)
+        assert len(all_pods) == len(set(all_pods))
+
+    def test_topic_filters_are_owned_prefixes(self):
+        p = ReplicaPartitioner(N_REPLICAS, replica_id=1)
+        filters = p.topic_filters(PODS + ["pod-0@dp3"])
+        assert filters == sorted(filters)
+        for f in filters:
+            pod = f[len("kv@"):-1]
+            assert p.owns(pod)
+        # Every filter is a ZMQ prefix of that pod's real topics.
+        assert all(f.startswith("kv@") and f.endswith("@") for f in filters)
+
+    def test_invalid_shapes_rejected(self):
+        with pytest.raises(ValueError):
+            ReplicaPartitioner(0)
+        with pytest.raises(ValueError):
+            ReplicaPartitioner(2, replica_id=2)
+        with pytest.raises(ValueError):
+            ClusterConfig(num_replicas=3, replica_id=5)
+
+
+# -- scatter-gather bit-identity ---------------------------------------------
+
+
+def _shared_tokenization_pool():
+    pool = TokenizationPool(
+        TokenizersPoolConfig(
+            workers=2,
+            local_tokenizer_files={TEST_MODEL_NAME: TEST_TOKENIZER_JSON},
+        ),
+    )
+    pool.run()
+    return pool
+
+
+def _make_indexer(tok_pool):
+    return Indexer(
+        config=IndexerConfig(
+            token_processor_config=TokenProcessorConfig(block_size=BLOCK_SIZE),
+        ),
+        tokenization_pool=tok_pool,
+    )
+
+
+def _event_pool_for(indexer, message_filter=None):
+    pool = EventPool(
+        EventPoolConfig(concurrency=2),
+        indexer.kv_block_index,
+        indexer.token_processor,
+        message_filter=message_filter,
+    )
+    pool.start(with_subscriber=False)
+    return pool
+
+
+def _store_message(pod, tokens, first_engine_hash, seq, dp_rank=None):
+    batch = EventBatch(
+        ts=0.0,
+        events=[BlockStored(
+            block_hashes=list(range(
+                first_engine_hash,
+                first_engine_hash + len(tokens) // BLOCK_SIZE,
+            )),
+            parent_block_hash=None,
+            token_ids=list(tokens),
+            block_size=BLOCK_SIZE,
+        )],
+        data_parallel_rank=dp_rank,
+    )
+    return Message(
+        topic=f"kv@{pod}@{TEST_MODEL_NAME}",
+        payload=batch.to_msgpack(),
+        seq=seq,
+        pod_identifier=pod,
+        model_name=TEST_MODEL_NAME,
+    )
+
+
+class _FailingTransport:
+    def get_pod_scores_ex(self, *a, **k):
+        raise ConnectionError("replica is down")
+
+
+class TestScatterGather:
+    @pytest.fixture
+    def cluster(self):
+        """A 4-replica cluster + a monolithic reference, fed the SAME
+        event stream (replicas through their partition gates)."""
+        tok_pool = _shared_tokenization_pool()
+        reference = _make_indexer(tok_pool)
+        replicas = [_make_indexer(tok_pool) for _ in range(N_REPLICAS)]
+        ref_pool = _event_pool_for(reference)
+        partitioners = [
+            ReplicaPartitioner(N_REPLICAS, rid) for rid in range(N_REPLICAS)
+        ]
+        replica_pools = [
+            _event_pool_for(replicas[rid], message_filter=partitioners[rid].accepts)
+            for rid in range(N_REPLICAS)
+        ]
+        rng = random.Random(7)
+        group_prefixes = [_text(rng, 40) for _ in range(3)]
+        prompts = []
+        seq = 0
+        engine_base = 1000
+        for i, pod in enumerate(PODS):
+            prefix = group_prefixes[i % len(group_prefixes)]
+            # Pods in one group cache different depths of the shared
+            # prefix chain, so scores genuinely differ per pod.
+            depth_words = 8 * (1 + i // len(group_prefixes))
+            prompt = prefix + " " + _text(rng, depth_words)
+            prompts.append(prefix + " " + _text(rng, 30))
+            tokens = tok_pool.tokenizer.encode(prompt, TEST_MODEL_NAME).tokens
+            n_full = (len(tokens) // BLOCK_SIZE) * BLOCK_SIZE
+            dp_rank = 1 if i % 3 == 0 else None  # some ranked identities
+            msg = _store_message(
+                pod, tokens[:n_full], engine_base, seq, dp_rank=dp_rank
+            )
+            engine_base += 1000
+            seq += 1
+            for pool in replica_pools:
+                pool.add_task(_store_message(
+                    pod, tokens[:n_full], engine_base - 1000, seq - 1,
+                    dp_rank=dp_rank,
+                ))
+            ref_pool.add_task(msg)
+        for pool in replica_pools + [ref_pool]:
+            pool.drain()
+        yield {
+            "reference": reference,
+            "replicas": replicas,
+            "prompts": prompts + group_prefixes,
+            "pools": replica_pools + [ref_pool],
+            "tok_pool": tok_pool,
+        }
+        for pool in replica_pools + [ref_pool]:
+            pool.shutdown()
+        tok_pool.shutdown()
+
+    def test_partition_gate_splits_the_stream(self, cluster):
+        # Every replica digested only its partition: the per-pool filtered
+        # counters sum to (N-1) x messages.
+        filtered = [p.filtered_events for p in cluster["pools"][:-1]]
+        assert sum(filtered) == (N_REPLICAS - 1) * len(PODS)
+
+    def test_merged_scores_bit_identical_to_single_replica(self, cluster):
+        scorer = ClusterScorer(
+            [LocalReplicaTransport(ix) for ix in cluster["replicas"]],
+        )
+        try:
+            for prompt in cluster["prompts"]:
+                ref = cluster["reference"].get_pod_scores_ex(
+                    prompt, TEST_MODEL_NAME, []
+                )
+                merged = scorer.get_pod_scores_ex(prompt, TEST_MODEL_NAME, [])
+                assert merged.scores == ref.scores
+                assert merged.match_blocks == ref.match_blocks
+                assert merged.block_hashes == ref.block_hashes
+            # The stream genuinely produced scores (guards a vacuous pass).
+            assert any(
+                cluster["reference"].get_pod_scores(p, TEST_MODEL_NAME, [])
+                for p in cluster["prompts"]
+            )
+        finally:
+            scorer.close()
+
+    def test_pod_filter_and_lora_pass_through(self, cluster):
+        scorer = ClusterScorer(
+            [LocalReplicaTransport(ix) for ix in cluster["replicas"]],
+        )
+        try:
+            prompt = cluster["prompts"][0]
+            ref = cluster["reference"].get_pod_scores(
+                prompt, TEST_MODEL_NAME, ["pod-0", "pod-3"]
+            )
+            merged = scorer.get_pod_scores(
+                prompt, TEST_MODEL_NAME, ["pod-0", "pod-3"]
+            )
+            assert merged == ref
+        finally:
+            scorer.close()
+
+    def test_dead_replica_degrades_to_missing_partition(self, cluster):
+        down = 1
+        transports = [
+            _FailingTransport() if rid == down else
+            LocalReplicaTransport(cluster["replicas"][rid])
+            for rid in range(N_REPLICAS)
+        ]
+        scorer = ClusterScorer(transports)
+        try:
+            part = ReplicaPartitioner(N_REPLICAS)
+            for prompt in cluster["prompts"]:
+                ref = cluster["reference"].get_pod_scores(
+                    prompt, TEST_MODEL_NAME, []
+                )
+                merged = scorer.get_pod_scores(prompt, TEST_MODEL_NAME, [])
+                surviving = {
+                    pod: s for pod, s in ref.items()
+                    if part.replica_for(pod) != down
+                }
+                # Never a stall, never an exception: the dead partition's
+                # pods carry no signal, everything else is untouched.
+                assert merged == surviving
+            assert scorer.scatter_errors > 0
+            status = scorer.status()
+            assert status["replicas"]["replica-1"]["failures"] > 0
+        finally:
+            scorer.close()
+
+    def test_stale_replica_skipped_by_state_machine(self):
+        clock = {"t": 0.0}
+        scorer = ClusterScorer(
+            [_FailingTransport(), _FailingTransport()],
+            config=ClusterConfig(
+                num_replicas=2,
+                replica_suspect_after_s=5.0,
+                replica_stale_after_s=10.0,
+            ),
+            clock=lambda: clock["t"],
+        )
+        try:
+            scorer.health.observe_batch("replica-0", "scatter", None, 0.0)
+            scorer.health.observe_batch("replica-1", "scatter", None, 0.0)
+            clock["t"] = 20.0  # both silent past the stale window
+            assert scorer.health.state_of("replica-0") == "stale"
+            assert scorer.health.state_of("replica-1") == "stale"
+        finally:
+            scorer.close()
+
+
+# -- snapshot round-trip across all four backends -----------------------------
+
+
+def _backend_factories(fake_redis_url=None):
+    factories = {
+        "in_memory": lambda: InMemoryIndex(
+            InMemoryIndexConfig(size=4096, pod_cache_size=10)
+        ),
+        "sharded": lambda: ShardedIndex(
+            ShardedIndexConfig(size=4096, num_shards=8)
+        ),
+        "cost_aware": lambda: CostAwareMemoryIndex(
+            CostAwareIndexConfig(max_size_bytes="64MiB")
+        ),
+    }
+    if fake_redis_url is not None:
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import (
+            RedisIndex,
+            RedisIndexConfig,
+        )
+
+        factories["redis"] = lambda: RedisIndex(
+            RedisIndexConfig(url=fake_redis_url)
+        )
+    return factories
+
+
+def _populate_random(index, rng, processor):
+    """Randomized chains: shared roots, divergent tails, random pods/tiers,
+    some evictions. Returns the request-key chains for score probes."""
+    chains = []
+    for c in range(6):
+        tokens = [rng.randrange(1, 30_000) for _ in range(
+            BLOCK_SIZE * rng.randint(2, 10)
+        )]
+        keys = processor.tokens_to_kv_block_keys(
+            None, tokens, TEST_MODEL_NAME
+        )
+        engine_keys = [
+            Key(TEST_MODEL_NAME, 100_000 + c * 1000 + i)
+            for i in range(len(keys))
+        ]
+        pods = rng.sample(PODS, rng.randint(1, 4))
+        entries = [
+            PodEntry(pod, rng.choice(("hbm", "host"))) for pod in pods
+        ]
+        # Per-pod varying depth: each pod holds a random prefix of the chain.
+        for entry in entries:
+            depth = rng.randint(1, len(keys))
+            index.add(engine_keys[:depth], keys[:depth], [entry])
+        # Occasional eviction, so restored emptiness matches too.
+        if rng.random() < 0.3:
+            index.evict(engine_keys[0], [entries[0]])
+        chains.append(keys)
+    return chains
+
+
+@pytest.fixture
+def fake_redis():
+    from tests.fake_redis import FakeRedisServer
+
+    server = FakeRedisServer()
+    yield server
+    server.close()
+
+
+class TestSnapshotRoundTrip:
+    @pytest.mark.parametrize(
+        "backend", ["in_memory", "sharded", "cost_aware", "redis"]
+    )
+    def test_import_export_bit_identical_scores(
+        self, backend, fake_redis, tmp_path
+    ):
+        """Property test: randomized chains, export -> CBOR file ->
+        import into a FRESH backend, then lookup + LongestPrefixScorer
+        must agree bit-for-bit with the source — get_pod_scores is exactly
+        lookup+score over these chains."""
+        processor = ChunkedTokenDatabase(
+            TokenProcessorConfig(block_size=BLOCK_SIZE)
+        )
+        scorer = LongestPrefixScorer({"hbm": 1.0, "host": 0.8})
+        for trial in range(3):
+            rng = random.Random(100 + trial)
+            factories = _backend_factories(fake_redis.url)
+            source = factories[backend]()
+            if backend == "redis":
+                source._pipeline([("FLUSHALL",)])  # noqa: SLF001
+            chains = _populate_random(source, rng, processor)
+            path = str(tmp_path / f"{backend}_{trial}.cbor")
+            write_snapshot(
+                path, source,
+                {("pod-0", f"kv@pod-0@{TEST_MODEL_NAME}"): 41 + trial},
+            )
+            snap = read_snapshot(path)
+            assert snap.seq_counters == {
+                ("pod-0", f"kv@pod-0@{TEST_MODEL_NAME}"): 41 + trial
+            }
+            if backend == "redis":
+                fresh = InMemoryIndex(  # fresh redis == same server; use
+                    InMemoryIndexConfig(size=4096)  # a cross-backend target
+                )
+            else:
+                fresh = factories[backend]()
+            imported = restore_index(fresh, snap)
+            assert imported == snap.view.entry_count()
+            for keys in chains:
+                src_lookup = source.lookup(keys, set())
+                dst_lookup = fresh.lookup(keys, set())
+                assert {k: sorted(map(str, v)) for k, v in src_lookup.items()} \
+                    == {k: sorted(map(str, v)) for k, v in dst_lookup.items()}
+                assert scorer.score(keys, src_lookup) == scorer.score(
+                    keys, dst_lookup
+                )
+                assert scorer.score_ex(keys, src_lookup) == scorer.score_ex(
+                    keys, dst_lookup
+                )
+            # Engine->request resolution survives (replay needs it for
+            # parent-chain continuation).
+            for model, h, req_model, req_h in snap.view.engine_map[:10]:
+                assert fresh.get_request_key(Key(model, h)) == Key(
+                    req_model, req_h
+                )
+
+    def test_cross_backend_restore(self, tmp_path):
+        """A sharded replica's snapshot restores into an in-memory (and
+        cost-aware) backend: the view format is backend-agnostic."""
+        processor = ChunkedTokenDatabase(
+            TokenProcessorConfig(block_size=BLOCK_SIZE)
+        )
+        scorer = LongestPrefixScorer({"hbm": 1.0})
+        rng = random.Random(5)
+        source = ShardedIndex(ShardedIndexConfig(size=4096, num_shards=4))
+        chains = _populate_random(source, rng, processor)
+        path = str(tmp_path / "cross.cbor")
+        write_snapshot(path, source, {})
+        snap = read_snapshot(path)
+        for target in (
+            InMemoryIndex(InMemoryIndexConfig(size=4096)),
+            CostAwareMemoryIndex(CostAwareIndexConfig(max_size_bytes="64MiB")),
+        ):
+            restore_index(target, snap)
+            for keys in chains:
+                assert scorer.score(keys, target.lookup(keys, set())) == \
+                    scorer.score(keys, source.lookup(keys, set()))
+
+    def test_version_and_magic_are_enforced(self, tmp_path):
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import IndexView
+
+        data = encode_snapshot(IndexView(), {})
+        snap = decode_snapshot(data)
+        assert snap.version == 1
+        with pytest.raises(SnapshotFormatError):
+            decode_snapshot(b"NOTASNAP" + data)
+        # Flip the version byte (first CBOR uint after the magic+array head).
+        from llm_d_kv_cache_manager_tpu.cluster.snapshot import SNAPSHOT_MAGIC
+
+        bad = bytearray(data)
+        bad[len(SNAPSHOT_MAGIC) + 1] = 0x17  # version 23
+        with pytest.raises(SnapshotFormatError):
+            decode_snapshot(bytes(bad))
+        with pytest.raises(SnapshotFormatError):
+            decode_snapshot(data[:-3])  # truncated
+
+    def test_atomic_write_leaves_no_tmp(self, tmp_path):
+        source = InMemoryIndex(InMemoryIndexConfig(size=64))
+        source.add(
+            [Key(TEST_MODEL_NAME, 1)], [Key(TEST_MODEL_NAME, 2)],
+            [PodEntry("pod-0", "hbm")],
+        )
+        path = str(tmp_path / "snap.cbor")
+        write_snapshot(path, source, {})
+        assert os.path.exists(path)
+        assert [p for p in os.listdir(tmp_path) if ".tmp." in p] == []
+
+
+# -- seq-tail replay idempotence ----------------------------------------------
+
+
+class TestSeqTailReplay:
+    def _pool(self):
+        index = InMemoryIndex(InMemoryIndexConfig(size=1000))
+        processor = ChunkedTokenDatabase(
+            TokenProcessorConfig(block_size=BLOCK_SIZE)
+        )
+        pool = EventPool(EventPoolConfig(concurrency=1), index, processor)
+        pool.start(with_subscriber=False)
+        return pool, index, processor
+
+    def test_replay_at_or_below_floor_is_noop(self):
+        pool, index, processor = self._pool()
+        try:
+            topic = f"kv@pod-1@{TEST_MODEL_NAME}"
+            pool.set_seq_floors({("pod-1", topic): 5})
+            # A CONFLICTING payload at an already-applied seq must be
+            # dropped — replay can never corrupt the restored view.
+            tokens = [9, 9, 9, 9]
+            pool.add_task(_store_message("pod-1", tokens, 777, seq=5))
+            pool.add_task(_store_message("pod-1", tokens, 778, seq=3))
+            pool.drain()
+            keys = processor.tokens_to_kv_block_keys(
+                None, tokens, TEST_MODEL_NAME
+            )
+            assert index.lookup(keys, set()) == {}
+            assert pool.replay_skipped == 2
+            # Above the floor applies normally.
+            pool.add_task(_store_message("pod-1", tokens, 779, seq=6))
+            pool.drain()
+            assert keys[0] in index.lookup(keys, set())
+        finally:
+            pool.shutdown()
+
+    def test_floor_is_per_pod_and_topic(self):
+        pool, index, processor = self._pool()
+        try:
+            topic1 = f"kv@pod-1@{TEST_MODEL_NAME}"
+            pool.set_seq_floors({("pod-1", topic1): 10})
+            tokens = [1, 2, 3, 4]
+            # Different pod: same seq is NOT floored.
+            pool.add_task(_store_message("pod-2", tokens, 100, seq=4))
+            pool.drain()
+            keys = processor.tokens_to_kv_block_keys(
+                None, tokens, TEST_MODEL_NAME
+            )
+            assert keys[0] in index.lookup(keys, set())
+            assert pool.replay_skipped == 0
+        finally:
+            pool.shutdown()
+
+    def test_clear_floors_restores_live_stream(self):
+        pool, index, processor = self._pool()
+        try:
+            topic = f"kv@pod-1@{TEST_MODEL_NAME}"
+            pool.set_seq_floors({("pod-1", topic): 1_000_000})
+            pool.clear_seq_floors()
+            tokens = [5, 6, 7, 8]
+            # A restarted publisher's seq=0 flows once floors are cleared.
+            pool.add_task(_store_message("pod-1", tokens, 200, seq=0))
+            pool.drain()
+            keys = processor.tokens_to_kv_block_keys(
+                None, tokens, TEST_MODEL_NAME
+            )
+            assert keys[0] in index.lookup(keys, set())
+        finally:
+            pool.shutdown()
+
+
+# -- replica warm restart + readiness ----------------------------------------
+
+
+class TestIndexerReplica:
+    def test_warm_restart_replays_only_the_tail(self, tmp_path):
+        tok_pool = _shared_tokenization_pool()
+        indexer = _make_indexer(tok_pool)
+        from llm_d_kv_cache_manager_tpu.fleethealth import (
+            FleetHealthConfig,
+            FleetHealthTracker,
+        )
+
+        health = FleetHealthTracker(FleetHealthConfig())
+        path = str(tmp_path / "replica.cbor")
+        replica = IndexerReplica(
+            indexer,
+            ClusterConfig(num_replicas=1, snapshot_path=path),
+            health_tracker=health,
+        )
+        replica.start()
+        try:
+            t1, t2 = [1, 2, 3, 4], [5, 6, 7, 8]
+            applied = _store_message("pod-1", t1, 300, seq=0)
+            replica.ingest(applied)
+            replica.event_pool.drain()
+            stats = replica.take_snapshot()
+            assert stats["pod_entries"] > 0
+            assert stats["seq_counters"] == 1
+            # The tail: one already-applied message + one the snapshot
+            # never saw.
+            tail = [applied, _store_message("pod-1", t2, 400, seq=1)]
+
+            fresh = _make_indexer(tok_pool)
+            replica2 = IndexerReplica(
+                fresh,
+                ClusterConfig(num_replicas=1, snapshot_path=path),
+                health_tracker=FleetHealthTracker(FleetHealthConfig()),
+            )
+            replica2.start()
+            try:
+                restored = replica2.warm_restart(tail=tail)
+                assert replica2.state == "ready"
+                assert restored["tail_messages"] == 2
+                assert restored["replay_skipped"] == 1  # the pre-floor one
+                proc = fresh.token_processor
+                k1 = proc.tokens_to_kv_block_keys(None, t1, TEST_MODEL_NAME)
+                k2 = proc.tokens_to_kv_block_keys(None, t2, TEST_MODEL_NAME)
+                assert k1[0] in fresh.kv_block_index.lookup(k1, set())
+                assert k2[0] in fresh.kv_block_index.lookup(k2, set())
+                readiness = replica2.readiness()
+                assert readiness["state"] == "ready"
+                assert readiness["last_restart"]["replay_skipped"] == 1
+            finally:
+                replica2.shutdown()
+        finally:
+            replica.shutdown()
+            tok_pool.shutdown()
+
+    def test_readyz_reports_replaying_as_503(self):
+        from aiohttp.test_utils import TestClient, TestServer
+        from llm_d_kv_cache_manager_tpu.api.http_service import ScoringService
+
+        tok_pool = _shared_tokenization_pool()
+        indexer = _make_indexer(tok_pool)
+        replica = IndexerReplica(indexer, ClusterConfig(num_replicas=1))
+        env = {
+            "zmq_endpoint": "tcp://*:0",
+            "zmq_topic": "kv@",
+            "pool_concurrency": 1,
+            "hash_seed": "",
+            "block_size": BLOCK_SIZE,
+            "http_port": 0,
+            "enable_metrics": False,
+        }
+        service = ScoringService(env, indexer=indexer, cluster_replica=replica)
+
+        async def run():
+            async with TestClient(TestServer(service.make_app())) as client:
+                service.start(with_subscriber=False)
+                resp = await client.get("/readyz")
+                body = await resp.json()
+                assert resp.status == 200
+                assert body["status"] == "ready"
+                assert body["replication"]["state"] == "ready"
+                assert body["replication"]["num_replicas"] == 1
+
+                # Mid-warm-restart: the replica is REPLAYING — 503, with a
+                # status string distinct from plain unready.
+                replica._set_state("replaying")  # noqa: SLF001
+                resp = await client.get("/readyz")
+                body = await resp.json()
+                assert resp.status == 503
+                assert body["status"] == "replaying"
+                assert body["replication"]["state"] == "replaying"
+
+                replica._set_state("ready")  # noqa: SLF001
+                resp = await client.get("/readyz")
+                assert resp.status == 200
+
+                status = await client.get("/cluster/status")
+                doc = await status.json()
+                assert doc["replica"]["replica_id"] == 0
+        try:
+            asyncio.run(run())
+        finally:
+            service.stop()
+            tok_pool.shutdown()
+
+    def test_cluster_snapshot_endpoint(self, tmp_path):
+        from aiohttp.test_utils import TestClient, TestServer
+        from llm_d_kv_cache_manager_tpu.api.http_service import ScoringService
+
+        tok_pool = _shared_tokenization_pool()
+        indexer = _make_indexer(tok_pool)
+        path = str(tmp_path / "http_snap.cbor")
+        replica = IndexerReplica(
+            indexer, ClusterConfig(num_replicas=1, snapshot_path=path)
+        )
+        env = {
+            "zmq_endpoint": "tcp://*:0",
+            "zmq_topic": "kv@",
+            "pool_concurrency": 1,
+            "hash_seed": "",
+            "block_size": BLOCK_SIZE,
+            "http_port": 0,
+            "enable_metrics": False,
+        }
+        service = ScoringService(env, indexer=indexer, cluster_replica=replica)
+
+        async def run():
+            async with TestClient(TestServer(service.make_app())) as client:
+                service.start(with_subscriber=False)
+                resp = await client.post("/cluster/snapshot")
+                body = await resp.json()
+                assert resp.status == 200
+                assert body["path"] == path
+                assert os.path.exists(path)
+        try:
+            asyncio.run(run())
+        finally:
+            service.stop()
+            tok_pool.shutdown()
+
+
+# -- gRPC transport (cluster marker: needs grpcio) ----------------------------
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.cluster
+class TestGrpcTransport:
+    def test_scatter_gather_over_grpc_matches_local(self):
+        from llm_d_kv_cache_manager_tpu.api.grpc_server import serve_grpc
+        from llm_d_kv_cache_manager_tpu.cluster import GrpcReplicaTransport
+
+        tok_pool = _shared_tokenization_pool()
+        reference = _make_indexer(tok_pool)
+        replicas = [_make_indexer(tok_pool) for _ in range(2)]
+        part = ReplicaPartitioner(2)
+        prompt = "the quick brown fox jumps over the lazy dog " * 3
+        tokens = tok_pool.tokenizer.encode(prompt, TEST_MODEL_NAME).tokens
+        n_full = (len(tokens) // BLOCK_SIZE) * BLOCK_SIZE
+        keys = reference.token_processor.tokens_to_kv_block_keys(
+            None, tokens[:n_full], TEST_MODEL_NAME
+        )
+        for i, pod in enumerate(("pod-0", "pod-1", "pod-2")):
+            depth = len(keys) - i  # distinct per-pod scores
+            engine_keys = [
+                Key(TEST_MODEL_NAME, 50_000 + 100 * i + j)
+                for j in range(depth)
+            ]
+            entry = [PodEntry(pod, "hbm")]
+            reference.kv_block_index.add(
+                engine_keys, keys[:depth], entry
+            )
+            owner = part.replica_for(pod)
+            replicas[owner].kv_block_index.add(
+                engine_keys, keys[:depth], entry
+            )
+        servers = []
+        targets = []
+        for replica in replicas:
+            port = _free_port()
+            servers.append(serve_grpc(replica, f"127.0.0.1:{port}"))
+            targets.append(f"127.0.0.1:{port}")
+        scorer = ClusterScorer(
+            [GrpcReplicaTransport(t, timeout_s=5.0) for t in targets],
+            config=ClusterConfig(num_replicas=2, scatter_timeout_s=5.0),
+        )
+        try:
+            ref = reference.get_pod_scores_ex(prompt, TEST_MODEL_NAME, [])
+            merged = scorer.get_pod_scores_ex(prompt, TEST_MODEL_NAME, [])
+            assert ref.scores  # non-vacuous
+            assert merged.scores == ref.scores
+            assert merged.match_blocks == ref.match_blocks
+            assert merged.block_hashes == ref.block_hashes
+        finally:
+            scorer.close()
+            for server in servers:
+                server.stop(grace=0)
+            tok_pool.shutdown()
+
+    def test_cluster_status_over_grpc(self):
+        from llm_d_kv_cache_manager_tpu.api.grpc_server import (
+            IndexerGrpcClient,
+            serve_grpc,
+        )
+
+        tok_pool = _shared_tokenization_pool()
+        indexer = _make_indexer(tok_pool)
+        port = _free_port()
+        server = serve_grpc(
+            indexer, f"127.0.0.1:{port}",
+            cluster_status_fn=lambda: {"replicas": {"replica-0": {"state": "healthy"}}},
+        )
+        try:
+            client = IndexerGrpcClient(f"127.0.0.1:{port}")
+            doc = client.cluster_status()
+            assert doc["replicas"]["replica-0"]["state"] == "healthy"
+            client.close()
+        finally:
+            server.stop(grace=0)
+            tok_pool.shutdown()

@@ -300,3 +300,159 @@ class TestEventPool:
             assert index.lookup(keys, set()) == {}
         finally:
             pool.shutdown()
+
+    def test_message_filter_gates_ingest(self):
+        # The cluster partition gate (cluster/partition.py) plugs in here:
+        # a rejected pod's messages are discarded before sharding.
+        index = InMemoryIndex(InMemoryIndexConfig(size=1000, pod_cache_size=10))
+        processor = ChunkedTokenDatabase(TokenProcessorConfig(block_size=4))
+        pool = EventPool(
+            EventPoolConfig(concurrency=2), index, processor,
+            message_filter=lambda m: m.pod_identifier == "pod-1",
+        )
+        pool.start(with_subscriber=False)
+        try:
+            tokens = [1, 2, 3, 4]
+            pool.add_task(_msg(EventBatch(0.0, [BlockStored([1], None, tokens, 4)]), pod="pod-1"))
+            pool.add_task(_msg(EventBatch(0.0, [BlockStored([2], None, tokens, 4)]), pod="pod-2"))
+            pool.drain()
+            keys = processor.tokens_to_kv_block_keys(None, tokens, "m")
+            got = index.lookup(keys, set())
+            assert got[keys[0]] == [PodEntry("pod-1", "hbm")]
+            assert pool.filtered_events == 1
+        finally:
+            pool.shutdown()
+
+
+class TestSubscriberFilters:
+    """Partitioned subscribe + live resubscribe (zmq_subscriber.py).
+
+    Real PUB/SUB over ipc endpoints, like the e2e suite: per-topic prefix
+    filters must actually gate delivery on the wire, and `resubscribe()`
+    must swap the set on the live socket — no rebind, no backoff reset.
+    """
+
+    def _pool_with_subscriber(self, tmp_path, topic_filters):
+        import uuid
+
+        endpoint = f"ipc://{tmp_path}/sub-{uuid.uuid4().hex[:8]}.sock"
+        index = InMemoryIndex(InMemoryIndexConfig(size=1000, pod_cache_size=10))
+        processor = ChunkedTokenDatabase(TokenProcessorConfig(block_size=4))
+        pool = EventPool(
+            EventPoolConfig(
+                zmq_endpoint=endpoint, concurrency=1,
+                topic_filters=list(topic_filters),
+            ),
+            index, processor,
+        )
+        pool.start(with_subscriber=True)
+        return pool, index, processor, endpoint
+
+    @staticmethod
+    def _wait_until(predicate, timeout=10.0, interval=0.05):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if predicate():
+                return True
+            time.sleep(interval)
+        return False
+
+    @staticmethod
+    def _publish(endpoint, pod, tokens, engine_hash):
+        from llm_d_kv_cache_manager_tpu.kvevents.publisher import (
+            Publisher,
+            make_topic,
+        )
+
+        publisher = Publisher(endpoint, make_topic(pod, "m"))
+        time.sleep(0.3)  # slow-joiner
+        publisher.publish(EventBatch(
+            ts=0.0, events=[BlockStored([engine_hash], None, tokens, 4)]
+        ))
+        return publisher
+
+    def test_topic_filters_gate_on_the_wire(self, tmp_path):
+        pool, index, processor, endpoint = self._pool_with_subscriber(
+            tmp_path, ["kv@pod-a@"]
+        )
+        try:
+            t_a, t_b = [1, 2, 3, 4], [5, 6, 7, 8]
+            pub_a = self._publish(endpoint, "pod-a", t_a, 11)
+            pub_b = self._publish(endpoint, "pod-b", t_b, 22)
+            keys_a = processor.tokens_to_kv_block_keys(None, t_a, "m")
+            keys_b = processor.tokens_to_kv_block_keys(None, t_b, "m")
+            assert self._wait_until(
+                lambda: keys_a[0] in index.lookup(keys_a, set())
+            )
+            # pod-b's topic never matched a subscribed prefix: the frame
+            # was dropped by ZMQ itself, not by this process.
+            time.sleep(0.3)
+            pool.drain()
+            assert index.lookup(keys_b, set()) == {}
+            pub_a.close()
+            pub_b.close()
+        finally:
+            pool.shutdown()
+
+    def test_resubscribe_swaps_partition_without_restart(self, tmp_path):
+        pool, index, processor, endpoint = self._pool_with_subscriber(
+            tmp_path, ["kv@pod-a@"]
+        )
+        try:
+            sub = pool._subscriber  # noqa: SLF001
+            failures_before = sub.consecutive_failures
+            # Reassignment: this replica now owns pod-b instead of pod-a.
+            sub.resubscribe(["kv@pod-b@"])
+            assert self._wait_until(lambda: sub.resubscriptions == 1)
+            assert sub.topic_filters == ["kv@pod-b@"]
+            t_b = [9, 10, 11, 12]
+            pub_b = self._publish(endpoint, "pod-b", t_b, 33)
+            keys_b = processor.tokens_to_kv_block_keys(None, t_b, "m")
+            assert self._wait_until(
+                lambda: keys_b[0] in index.lookup(keys_b, set())
+            )
+            t_a = [13, 14, 15, 16]
+            pub_a = self._publish(endpoint, "pod-a", t_a, 44)
+            time.sleep(0.3)
+            pool.drain()
+            keys_a = processor.tokens_to_kv_block_keys(None, t_a, "m")
+            assert index.lookup(keys_a, set()) == {}
+            # The swap happened on the live socket: no reconnect cycle, so
+            # the capped-backoff bookkeeping never moved.
+            assert sub.consecutive_failures == failures_before == 0
+            assert sub.is_alive()
+            pub_a.close()
+            pub_b.close()
+        finally:
+            pool.shutdown()
+
+    def test_resubscribe_before_start_sets_initial_filters(self):
+        from llm_d_kv_cache_manager_tpu.kvevents.zmq_subscriber import (
+            ZMQSubscriber,
+        )
+
+        sub = ZMQSubscriber(None, "ipc:///tmp/unused.sock", "kv@")
+        sub.resubscribe(["kv@pod-x@", "kv@pod-y@"])
+        assert sub.topic_filters == ["kv@pod-x@", "kv@pod-y@"]
+        assert sub.topic_filter == "kv@pod-x@"
+
+    def test_empty_filter_list_degenerates_to_subscribe_all(self):
+        from llm_d_kv_cache_manager_tpu.kvevents.zmq_subscriber import (
+            _normalize_filters,
+        )
+
+        assert _normalize_filters([]) == [""]
+        assert _normalize_filters("kv@") == ["kv@"]
+        assert _normalize_filters(["a", "b"]) == ["a", "b"]
+
+    def test_backoff_schedule_preserved(self):
+        # The capped-exponential reconnect schedule predates the filter
+        # work and must survive it (PR-3 semantics).
+        from llm_d_kv_cache_manager_tpu.kvevents.zmq_subscriber import (
+            backoff_delay,
+        )
+
+        assert backoff_delay(1, base=5.0, cap=60.0) == 5.0
+        assert backoff_delay(2, base=5.0, cap=60.0) == 10.0
+        assert backoff_delay(5, base=5.0, cap=60.0) == 60.0  # capped
+        assert backoff_delay(99, base=5.0, cap=60.0) == 60.0

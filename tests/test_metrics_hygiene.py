@@ -28,7 +28,7 @@ from llm_d_kv_cache_manager_tpu.obs import spans as obs_spans
 #   plane   — tracing planes (read/write/transfer/other)
 #   stage   — tracing stage names (fixed by the instrumentation sites)
 ALLOWED_LABELS = {"state", "kind", "backend", "op", "plane", "stage"}
-ALLOWED_PLANES = {"read", "write", "transfer", "other"}
+ALLOWED_PLANES = {"read", "write", "transfer", "cluster", "other"}
 
 
 def _kvcache_collectors():
@@ -37,7 +37,12 @@ def _kvcache_collectors():
     for attr in dir(metrics):
         obj = getattr(metrics, attr)
         if isinstance(
-            obj, (prometheus_client.Counter, prometheus_client.Histogram)
+            obj,
+            (
+                prometheus_client.Counter,
+                prometheus_client.Gauge,
+                prometheus_client.Histogram,
+            ),
         ) and id(obj) not in seen:
             seen.add(id(obj))
             yield attr, obj
@@ -50,6 +55,14 @@ def test_collectors_exist():
     assert len(collectors) >= 15
     assert "stage_latency" in collectors
     assert "event_apply_delay" in collectors
+    # Replicated control plane (cluster/): partition count, snapshot age,
+    # replay lag, plus its transition/degradation counters — gauges are
+    # part of the walk now, so a new per-pod gauge label fails here too.
+    assert "replica_partitions" in collectors
+    assert "replica_snapshot_age" in collectors
+    assert "replica_replay_lag" in collectors
+    assert "replica_state_transitions" in collectors
+    assert "replica_scatter_errors" in collectors
 
 
 def test_all_metrics_in_kvcache_namespace():
