@@ -3,8 +3,8 @@
 # /root/reference/Makefile, /root/reference/hooks/pre-commit.sh).
 
 .PHONY: native kvtransfer test bench bench-micro bench-read bench-obs \
-	bench-faults bench-replication bench-transfer clean proto lint \
-	precommit-install image-build image-push
+	bench-faults bench-replication bench-placement bench-transfer clean \
+	proto lint precommit-install image-build image-push
 
 # Container image coordinates (override per environment/registry). The
 # release workflow (.github/workflows/ci-release.yaml) builds the same
@@ -85,6 +85,13 @@ bench-faults:
 # Headless; rewrites benchmarking/FLEET_BENCH_REPLICATION.json.
 bench-replication:
 	JAX_PLATFORMS=cpu python bench.py --replication
+
+# Multi-tenant placement scenario (placement/): Zipf tenant hotspot over
+# per-tenant LoRA-isolated system prefixes; precise-only routing vs
+# proactive K-way hot-prefix replication through the transfer plane.
+# Headless; rewrites benchmarking/FLEET_BENCH_PLACEMENT.json.
+bench-placement: kvtransfer
+	JAX_PLATFORMS=cpu python bench.py --placement
 
 # Transfer-plane legs (CI-smoke sizes, printed only): async-offload
 # dispatch vs sync stage, batched-vs-serial multi-block DCN fetch, inflight

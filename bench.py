@@ -261,6 +261,8 @@ class FleetSim:
         snapshot_path=None,
         snapshot_every_s: float = 0.0,
         tail_journal_len: int = 0,
+        placement=None,
+        cluster_replicas: int = 1,
     ):
         self.strategy = strategy
         self.host_tier = host_tier
@@ -348,6 +350,101 @@ class FleetSim:
         # subsystem, forever.
         self.phantom_scores = []
 
+        # Replicated control plane (--cluster-replicas; cluster/): the
+        # precise arm scores through a ClusterScorer scatter-gather over N
+        # partition-gated replicas instead of the monolithic indexer. Each
+        # replica owns the event streams of the pods FNV-striped to it
+        # (every published message is offered to every replica pool; the
+        # ownership gate drops foreign streams), so the merged answer is
+        # bit-identical to the monolithic run on full answers.
+        self.cluster_scorer = None
+        self.replica_pools = []
+        self.replica_indexers = []
+        if cluster_replicas > 1:
+            from llm_d_kv_cache_manager_tpu.cluster import (
+                ClusterConfig,
+                ClusterScorer,
+                LocalReplicaTransport,
+                ReplicaPartitioner,
+            )
+
+            transports = []
+            for rid in range(cluster_replicas):
+                part = ReplicaPartitioner(cluster_replicas, replica_id=rid)
+                ridx = Indexer(
+                    config=IndexerConfig(
+                        token_processor_config=TokenProcessorConfig(
+                            block_size=PAGE_SIZE
+                        ),
+                    ),
+                    # Share the main tokenization pool (already running):
+                    # replicas differ only in which event streams they
+                    # digest, never in derivation.
+                    tokenization_pool=self.indexer.tokenizers_pool,
+                )
+                rpool = EventPool(
+                    EventPoolConfig(concurrency=2),
+                    ridx.kv_block_index,
+                    ridx.token_processor,
+                    message_filter=part.accepts,
+                )
+                rpool.start(with_subscriber=False)
+                self.replica_indexers.append(ridx)
+                self.replica_pools.append(rpool)
+                transports.append(LocalReplicaTransport(ridx))
+            self.cluster_scorer = ClusterScorer(
+                transports,
+                partitioner=ReplicaPartitioner(cluster_replicas),
+                config=ClusterConfig(num_replicas=cluster_replicas),
+            )
+
+        # Predictive placement (--placement; placement/): the popularity
+        # tracker rides the read path, the replicator ticks under the sim
+        # clock, and replication jobs flow through the real RoutePrefetcher
+        # into prefetch_hashes + warm_chain on the target pods.
+        self.popularity = None
+        self.replicator = None
+        self.route_prefetcher = None
+        self.replicated_blocks = 0
+        self.replication_charged_s = 0.0
+        if placement is not None:
+            from llm_d_kv_cache_manager_tpu.kv_connectors.prefetch import (
+                RoutePrefetcher,
+            )
+            from llm_d_kv_cache_manager_tpu.placement import (
+                ChainPopularityTracker,
+                HotPrefixReplicator,
+                PopularityConfig,
+                ReplicationConfig,
+            )
+
+            rep_cfg = placement if isinstance(
+                placement, ReplicationConfig
+            ) else ReplicationConfig(**placement)
+            self.popularity = ChainPopularityTracker(
+                PopularityConfig(
+                    half_life_s=PLACEMENT_HALF_LIFE_S,
+                    max_prefix_blocks=rep_cfg.max_prefix_blocks,
+                ),
+                clock=lambda: self.now,
+            )
+            self.indexer.popularity = self.popularity
+            self.route_prefetcher = RoutePrefetcher(
+                self._replication_prefetch,
+                queue_bound=PLACEMENT_QUEUE_BOUND,
+            )
+            self.replicator = HotPrefixReplicator(
+                self.popularity,
+                submit_fn=lambda pod, hashes, chain: (
+                    self.route_prefetcher.submit(pod, hashes)
+                ),
+                pods_fn=lambda: [f"pod-{i}" for i in self._alive_pods()],
+                config=rep_cfg,
+                fleet_health=self.health,
+                index=self.indexer.kv_block_index,
+                clock=lambda: self.now,
+            )
+
         self.pods = []
         for i in range(N_PODS):
             self.pods.append(self._make_pod(i))
@@ -366,6 +463,7 @@ class FleetSim:
                 ))
         self.pod_free_at = [0.0] * N_PODS
         self.rr_counter = 0
+        self.last_pod_idx = 0
         self.route_rng = random.Random(1234)  # "random" arm; workload rng untouched
         # "estimated" arm state: block-key -> pod the chain was last ROUTED
         # to. Never sees engine events (eviction silently invalidates it),
@@ -423,6 +521,10 @@ class FleetSim:
                 return  # index service dead: nothing digests
             self._applied_seq[(msg.pod_identifier, msg.topic)] = msg.seq
             self.event_pool.add_task(msg)
+            for rpool in self.replica_pools:
+                # Every replica is offered every message; the partition
+                # ownership gate (message_filter) keeps exactly one.
+                rpool.add_task(msg)
 
         if self.injector is not None:
             deliver = self.injector.wrap(pod_id, deliver)
@@ -583,7 +685,7 @@ class FleetSim:
             "seq_counters": stats["seq_counters"],
         }
 
-    def route(self, prompt: str) -> int:
+    def route(self, prompt: str, lora_id=None) -> int:
         if self.route_override is not None:
             return self.route_override(prompt)
         if self.strategy == "round_robin":
@@ -602,7 +704,14 @@ class FleetSim:
             self.indexer_down_requests += 1
             return min(self._alive_pods(), key=lambda i: self.pod_free_at[i])
         t0 = time.perf_counter()
-        scores = self.indexer.get_pod_scores(prompt, MODEL, [])
+        if self.cluster_scorer is not None:
+            scores = self.cluster_scorer.get_pod_scores(
+                prompt, MODEL, [], lora_id=lora_id
+            )
+        else:
+            scores = self.indexer.get_pod_scores(
+                prompt, MODEL, [], lora_id=lora_id
+            )
         if self._indexer_restarted and not scores:
             self.scores_empty_after_restart += 1
         self.read_latencies.append(time.perf_counter() - t0)
@@ -679,18 +788,35 @@ class FleetSim:
         return self.alpha * n_tokens
 
     def serve(
-        self, arrival: float, prompt: str, response_words: int = RESPONSE_WORDS
+        self,
+        arrival: float,
+        prompt: str,
+        response_words: int = RESPONSE_WORDS,
+        lora_id=None,
     ) -> float:
         """Returns TTFT for this request under the simulated clock.
         `response_words` sizes the decode that holds this request's pages
         (trace-driven workloads carry per-turn output lengths; the
-        synthetic workload uses the fixed RESPONSE_WORDS)."""
+        synthetic workload uses the fixed RESPONSE_WORDS). `lora_id`
+        scopes the request to that tenant's keyspace end-to-end: scoring,
+        allocation, and the engine events all carry it."""
         self.now = arrival
         self._apply_lifecycle(arrival)
         self._apply_indexer_lifecycle(arrival)
         self._maybe_snapshot(arrival)
         self._release_finished(arrival)
-        pod_idx = self.route(prompt)
+        if self.replicator is not None:
+            # Placement policy tick, between requests: detect hot chains,
+            # push replication jobs through the prefetch plane, and drain
+            # both the plane and the event pool so the landed replicas'
+            # BlockStored events are index-visible before routing — the
+            # same effects a real deployment gets asynchronously, made
+            # deterministic under the simulated clock.
+            if self.replicator.tick(arrival):
+                self.route_prefetcher.drain(timeout_s=30.0)
+                self.event_pool.drain()
+        pod_idx = self.route(prompt, lora_id=lora_id)
+        self.last_pod_idx = pod_idx
         if pod_idx in self._crashed:
             # Phantom placement: the index still credits a dead pod. The
             # router's connection fails and it retries least-loaded — the
@@ -721,7 +847,7 @@ class FleetSim:
         requeue_s = 0.0
         while state is None:
             try:
-                state, cached = pod.prefill(tokens)
+                state, cached = pod.prefill(tokens, lora_id=lora_id)
             except OutOfPagesError:
                 if self.pod_active[pod_idx]:
                     requeue_s += self._preempt_youngest(pod_idx)
@@ -766,9 +892,54 @@ class FleetSim:
         decode_finish = start + prefill_s + ITL_S_PER_TOKEN * response_words
         self.pod_active[pod_idx].append((decode_finish, state, len(tokens)))
         self.event_pool.drain()
+        for rpool in self.replica_pools:
+            rpool.drain()
         return ttft
 
+    # -- proactive replication executor (--placement) --------------------
+
+    def _replication_prefetch(self, pod_identifier: str, hashes) -> int:
+        """The RoutePrefetcher's prefetch_fn for replication jobs: fill the
+        target pod's ready buffer over the real transfer plane, then warm
+        the chain through the normal allocate/restore path (commits the
+        blocks + emits BlockStored, so the index learns the replica). The
+        transfer time is charged to the target pod's clock — replication
+        is background work, but it is not free work."""
+        i = int(pod_identifier.split("-")[1])
+        if i in self._crashed:
+            return 0
+        pod = self.pods[i]
+        pod.prefetch_hashes(list(hashes))
+        chain = self.popularity.chain(hashes[0])
+        if chain is None or not chain.prefix_tokens:
+            return 0
+        lora = chain.extra[0] if chain.extra else None
+        landed = pod.warm_chain(chain.prefix_tokens, lora_id=lora)
+        if landed:
+            self.replicated_blocks += landed
+            cost_s = self.delta * landed * PAGE_SIZE
+            self.pod_free_at[i] = max(self.pod_free_at[i], self.now) + cost_s
+            self.replication_charged_s += cost_s
+        return landed
+
+    def placement_stats(self) -> dict:
+        if self.replicator is None:
+            return {}
+        return {
+            "replicator": dict(self.replicator.stats),
+            "tracker": self.popularity.stats(),
+            "prefetcher": dict(self.route_prefetcher.stats),
+            "replicated_blocks": self.replicated_blocks,
+            "replication_charged_s": round(self.replication_charged_s, 4),
+        }
+
     def shutdown(self):
+        if self.route_prefetcher is not None:
+            self.route_prefetcher.close()
+        if self.cluster_scorer is not None:
+            self.cluster_scorer.close()
+        for rpool in self.replica_pools:
+            rpool.shutdown()
         self.event_pool.shutdown()
         self.indexer.shutdown()
         for pod in self.pods:
@@ -1414,6 +1585,310 @@ def main_replication(args):
     }))
 
 
+# Multi-tenant placement scenario (--placement; placement/ subsystem):
+# T tenants share the fleet, each with its own system prefix served under
+# its own LoRA keyspace; tenant popularity is Zipf. Three precise-routing
+# arms over matched traces:
+#   uniform_precise    zipf_s=0 control mix (tenants spread evenly — the
+#                      "single-tenant" hit-rate yardstick: no hotspot, so
+#                      precise routing is at its best).
+#   hotspot_precise    Zipf hotspot mix, placement OFF: the hot tenants'
+#                      traffic concentrates on whichever pod owns each hot
+#                      prefix — that pod saturates and churns while the
+#                      rest of the fleet idles.
+#   hotspot_placement  same hotspot mix, placement ON: the popularity
+#                      tracker detects the hot chains and the replicator
+#                      K-way-replicates their prefixes through the
+#                      prefetch/transfer plane, so new sessions tie across
+#                      replicas and least-loaded tie-breaking spreads them.
+# All arms run the data plane (host tier + DCN peers) in the winning-regime
+# model class (wide-MQA int8-KV — same derivation as the scale-out warm-up
+# scenario), so the placement-off arm already has every REACTIVE remedy;
+# what the artifact isolates is the value of PROACTIVE placement.
+PLACEMENT_TENANTS = 12
+PLACEMENT_SESSIONS = 200
+PLACEMENT_ZIPF_S = 1.8
+PLACEMENT_SESSION_RATE = 6.0
+PLACEMENT_MAX_TURNS = 3
+# Every tenant's system prompt is the same length (the mix is the variable
+# under test, not the prefix-length lottery): 900 words ≈ 1.6k fixture
+# tokens ≈ 102 blocks.
+PLACEMENT_PREFIX_WORDS = 1500
+PLACEMENT_PAGES_PER_POD = 1024
+PLACEMENT_HOST_CAPACITY = 512
+PLACEMENT_K_REPLICAS = 3
+PLACEMENT_HOTNESS = 30.0
+PLACEMENT_COOLDOWN_S = 6.0
+PLACEMENT_HALF_LIFE_S = 60.0
+PLACEMENT_QUEUE_BOUND = 64
+# Retained/replicated prefix bound: must cover the whole shared prefix —
+# a partial replica never ties with the full-prefix owner, so routing
+# would keep concentrating (128 blocks = 2048 tokens > the 102-block
+# prefix above).
+PLACEMENT_MAX_PREFIX_BLOCKS = 192
+
+
+def _winning_regime_constants():
+    """(alpha, gamma, delta, source): per-token recompute/restore/onboard
+    seconds for the wide-MQA int8-KV model class, derived from the SAME
+    measured rig rates as everything else (DEVICE_BENCH.json when present;
+    assumed v5e rates otherwise). Shared by run_winning_regime and the
+    placement scenario so 'the regime where transfer wins' means one
+    thing."""
+    from llm_d_kv_cache_manager_tpu.engine import costs as costs_mod
+    from llm_d_kv_cache_manager_tpu.models.llama import LlamaConfig
+
+    rates = costs_mod.measured_rates() or costs_mod.ASSUMED_RATES
+    wide = LlamaConfig(
+        vocab_size=32768, d_model=8192, n_layers=4, n_q_heads=64,
+        n_kv_heads=1, head_dim=128, d_ff=28672,
+    )
+    kv_bytes = costs_mod.kv_bytes_per_token(wide, quantized=True)
+    alpha = costs_mod.flops_per_token(wide) / rates["compute_flops_per_s"]
+    gamma = kv_bytes / rates["staged_bytes_per_s"]
+    delta = kv_bytes / rates["peer_bytes_per_s"]
+    return alpha, gamma, delta, rates["source"]
+
+
+def build_placement_trace(seed: int = 42, zipf_s: float = PLACEMENT_ZIPF_S):
+    from llm_d_kv_cache_manager_tpu.workloads import (
+        MultiTenantConfig,
+        generate_multitenant,
+    )
+
+    return generate_multitenant(MultiTenantConfig(
+        n_tenants=PLACEMENT_TENANTS,
+        n_sessions=PLACEMENT_SESSIONS,
+        seed=seed,
+        zipf_s=zipf_s,
+        session_rate_per_s=PLACEMENT_SESSION_RATE,
+        max_turns=PLACEMENT_MAX_TURNS,
+        prefix_words=PLACEMENT_PREFIX_WORDS,
+    ))
+
+
+def run_placement_arm(requests, placement=None):
+    """One precise-arm replay of a multi-tenant trace, data plane on, in
+    the winning-regime model class. `placement` (a ReplicationConfig or
+    kwargs dict) enables the placement subsystem; None pins today's
+    reactive-only read path."""
+    from llm_d_kv_cache_manager_tpu.workloads import tenant_of
+
+    alpha, gamma, delta, _src = _winning_regime_constants()
+    sim = FleetSim(
+        "precise",
+        pages_per_pod=PLACEMENT_PAGES_PER_POD,
+        host_tier=True,
+        host_capacity=PLACEMENT_HOST_CAPACITY,
+        alpha=alpha, gamma=gamma, delta=delta,
+        placement=placement,
+    )
+    ttfts = []
+    per_tenant: dict = {}
+    hot_pod_counts = [0] * N_PODS
+    try:
+        for req in requests:
+            tenant = tenant_of(req.session)
+            h0, t0 = sim.hit_tokens, sim.total_tokens
+            ttfts.append(sim.serve(
+                req.arrival_s, req.prompt,
+                response_words=req.output_len, lora_id=tenant,
+            ))
+            rec = per_tenant.setdefault(tenant, [0, 0, 0])
+            rec[0] += sim.hit_tokens - h0
+            rec[1] += sim.total_tokens - t0
+            rec[2] += 1
+            if tenant == 0:
+                hot_pod_counts[sim.last_pod_idx] += 1
+        hit_rate = sim.hit_tokens / max(sim.total_tokens, 1)
+        extras = {
+            "restored_blocks": sim.restored_blocks,
+            "onboarded_blocks": sim.onboarded_blocks,
+            "preemptions": sim.preemptions,
+            "placement": sim.placement_stats(),
+            "per_tenant": per_tenant,
+            "hot_tenant_pod_counts": hot_pod_counts,
+        }
+        return ttfts, hit_rate, extras
+    finally:
+        sim.shutdown()
+
+
+def main_placement(args):
+    """--placement: the multi-tenant hotspot comparison. Writes
+    benchmarking/FLEET_BENCH_PLACEMENT.json."""
+    from llm_d_kv_cache_manager_tpu.kv_connectors.connector import (
+        native_available,
+    )
+
+    if not native_available():
+        print(json.dumps({
+            "metric": "placement_hit_rate_retention",
+            "value": None,
+            "skipped": "libkvtransfer.so not built (make kvtransfer)",
+        }))
+        return
+
+    t_start = time.time()
+    uniform_trace = build_placement_trace(seed=args.seed, zipf_s=0.0)
+    hotspot_trace = build_placement_trace(
+        seed=args.seed, zipf_s=PLACEMENT_ZIPF_S
+    )
+    uniform_requests = uniform_trace.requests()
+    hotspot_requests = hotspot_trace.requests()
+
+    placement_cfg = dict(
+        k_replicas=PLACEMENT_K_REPLICAS,
+        hotness_threshold=PLACEMENT_HOTNESS,
+        cooldown_s=PLACEMENT_COOLDOWN_S,
+        max_prefix_blocks=PLACEMENT_MAX_PREFIX_BLOCKS,
+    )
+    arms = {}
+    for name, requests, placement in (
+        ("uniform_precise", uniform_requests, None),
+        ("hotspot_precise", hotspot_requests, None),
+        ("hotspot_placement", hotspot_requests, placement_cfg),
+    ):
+        ttfts, hit, ex = run_placement_arm(requests, placement=placement)
+        hot_tenant = ex["per_tenant"].get(0, [0, 0, 0])
+        arms[name] = {
+            "ttft_p50_s": round(p50(ttfts), 4),
+            "ttft_p90_s": round(p90(ttfts), 4),
+            "ttft_mean_s": round(sum(ttfts) / len(ttfts), 4),
+            "prefix_hit_rate": round(hit, 4),
+            "preemptions": ex["preemptions"],
+            "onboarded_blocks": ex["onboarded_blocks"],
+            "restored_blocks": ex["restored_blocks"],
+            "hot_tenant_hit_rate": round(
+                hot_tenant[0] / max(hot_tenant[1], 1), 4
+            ),
+            "hot_tenant_requests": hot_tenant[2],
+            # Where the hot tenant's requests actually landed — the
+            # concentration-vs-spread mechanism, measured: precise-only
+            # piles them onto the prefix owner; replication spreads them
+            # across the K-replica set via the least-loaded tie-break.
+            "hot_tenant_pod_counts": ex["hot_tenant_pod_counts"],
+            "hot_tenant_pods_used": sum(
+                1 for c in ex["hot_tenant_pod_counts"] if c > 0
+            ),
+        }
+        if ex["placement"]:
+            arms[name]["placement"] = ex["placement"]
+
+    alpha, gamma, delta, rates_source = _winning_regime_constants()
+    baseline_hit = arms["uniform_precise"]["prefix_hit_rate"]
+    retention = arms["hotspot_placement"]["prefix_hit_rate"] / max(
+        baseline_hit, 1e-9
+    )
+    degraded = arms["hotspot_precise"]["prefix_hit_rate"] / max(
+        baseline_hit, 1e-9
+    )
+    from llm_d_kv_cache_manager_tpu.workloads import tenant_weights
+
+    stats = {
+        "config": {
+            "workload": "multitenant-sharegpt (workloads/multitenant.py), "
+                        "precise arm, data plane on",
+            "n_tenants": PLACEMENT_TENANTS,
+            "n_sessions": PLACEMENT_SESSIONS,
+            "zipf_s": PLACEMENT_ZIPF_S,
+            "prefix_words": PLACEMENT_PREFIX_WORDS,
+            "hot_tenant_session_share": round(
+                tenant_weights(PLACEMENT_TENANTS, PLACEMENT_ZIPF_S)[0], 4
+            ),
+            "session_rate_per_s": PLACEMENT_SESSION_RATE,
+            "max_turns": PLACEMENT_MAX_TURNS,
+            "requests_uniform": len(uniform_requests),
+            "requests_hotspot": len(hotspot_requests),
+            "n_pods": N_PODS,
+            "pages_per_pod": PLACEMENT_PAGES_PER_POD,
+            "host_capacity_blocks": PLACEMENT_HOST_CAPACITY,
+            "seed": args.seed,
+            "model_class": "wide MQA + int8 KV (winning regime, shared "
+                           "with data_plane_winning_regime)",
+            "rates_source": rates_source,
+            "alpha_recompute_s_per_token": round(alpha, 8),
+            "gamma_staged_s_per_token": round(gamma, 8),
+            "delta_dcn_s_per_token": round(delta, 8),
+            "placement": {
+                "k_replicas": PLACEMENT_K_REPLICAS,
+                "hotness_threshold": PLACEMENT_HOTNESS,
+                "cooldown_s": PLACEMENT_COOLDOWN_S,
+                "half_life_s": PLACEMENT_HALF_LIFE_S,
+                "queue_bound": PLACEMENT_QUEUE_BOUND,
+                "max_prefix_blocks": PLACEMENT_MAX_PREFIX_BLOCKS,
+            },
+        },
+        "arms": arms,
+        # Acceptance: the replication arm retains >=90% of the uniform-mix
+        # ("single-tenant") hit rate at the hotspot mix where the
+        # precise-only arm measurably degrades.
+        "hit_rate_retention_placement": round(retention, 4),
+        "hit_rate_retention_precise_only": round(degraded, 4),
+        "ttft_p50_speedup_vs_precise_only": round(
+            arms["hotspot_precise"]["ttft_p50_s"]
+            / max(arms["hotspot_placement"]["ttft_p50_s"], 1e-9), 3
+        ),
+        # How many times worse than the uniform-mix baseline each hotspot
+        # arm's mean TTFT is — the degradation the hotspot causes, and
+        # what replication buys back.
+        "ttft_mean_degradation_precise_only_x": round(
+            arms["hotspot_precise"]["ttft_mean_s"]
+            / max(arms["uniform_precise"]["ttft_mean_s"], 1e-9), 2
+        ),
+        "ttft_mean_degradation_placement_x": round(
+            arms["hotspot_placement"]["ttft_mean_s"]
+            / max(arms["uniform_precise"]["ttft_mean_s"], 1e-9), 2
+        ),
+        "wall_s": round(time.time() - t_start, 1),
+    }
+    print(json.dumps(stats), file=sys.stderr)
+    artifact = {k: v for k, v in stats.items() if k != "wall_s"}
+    out = os.path.join(REPO, "benchmarking", "FLEET_BENCH_PLACEMENT.json")
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({
+        "metric": "placement_hit_rate_retention",
+        "value": round(retention, 4),
+        # Target: >=0.9 of the uniform-mix hit rate under the hotspot mix.
+        "vs_baseline": round(retention / 0.9, 3),
+        "unit": "fraction",
+        "precise_only_retention": round(degraded, 4),
+        "ttft_p50_speedup_vs_precise_only": stats[
+            "ttft_p50_speedup_vs_precise_only"
+        ],
+        "source": "benchmarking/FLEET_BENCH_PLACEMENT.json",
+    }))
+
+
+def main_cluster_check(args):
+    """--cluster-replicas N: route the synthetic headline precise arm
+    through a ClusterScorer scatter-gather over N partition-gated local
+    replicas and pin it bit-identical to the monolithic run (full answers
+    => identical merged scores => identical routing => identical TTFT
+    stream). Prints the verdict; commits nothing — the monolithic
+    artifacts stay the single source of truth."""
+    n = args.cluster_replicas
+    t_start = time.time()
+    ttft_mono, hit_mono, _, _ = run_strategy("precise")
+    ttft_clu, hit_clu, _, _ = run_strategy("precise", cluster_replicas=n)
+    identical = ttft_mono == ttft_clu and hit_mono == hit_clu
+    print(json.dumps({
+        "metric": "cluster_precise_bit_identical",
+        "value": bool(identical),
+        "replicas": n,
+        "prefix_hit_rate_monolithic": round(hit_mono, 4),
+        "prefix_hit_rate_cluster": round(hit_clu, 4),
+        "ttft_p50_monolithic_s": round(p50(ttft_mono), 4),
+        "ttft_p50_cluster_s": round(p50(ttft_clu), 4),
+        "requests": len(ttft_mono),
+        "wall_s": round(time.time() - t_start, 1),
+    }))
+    if not identical:
+        sys.exit(1)
+
+
 def p50(xs):
     return sorted(xs)[len(xs) // 2]
 
@@ -1539,22 +2014,12 @@ def run_winning_regime():
     data plane the new pod onboards each conversation's prefix from its
     home pod over DCN (real connector, real index lookups, gate admits);
     without, it recomputes every prefix from scratch."""
-    from llm_d_kv_cache_manager_tpu.engine import costs as costs_mod
     from llm_d_kv_cache_manager_tpu.kv_connectors.connector import native_available
-    from llm_d_kv_cache_manager_tpu.models.llama import LlamaConfig
 
     if not native_available():
         return {"skipped": "libkvtransfer.so not built"}
 
-    rates = costs_mod.measured_rates() or costs_mod.ASSUMED_RATES
-    wide = LlamaConfig(
-        vocab_size=32768, d_model=8192, n_layers=4, n_q_heads=64,
-        n_kv_heads=1, head_dim=128, d_ff=28672,
-    )
-    kv_bytes = costs_mod.kv_bytes_per_token(wide, quantized=True)
-    alpha_w = costs_mod.flops_per_token(wide) / rates["compute_flops_per_s"]
-    gamma_w = kv_bytes / rates["staged_bytes_per_s"]
-    delta_w = kv_bytes / rates["peer_bytes_per_s"]
+    alpha_w, gamma_w, delta_w, rates_source = _winning_regime_constants()
 
     def run(data_plane: bool):
         rng = random.Random(7)
@@ -1614,7 +2079,7 @@ def run_winning_regime():
                     "control)",
         "model_class": "wide MQA + int8 KV (d_model 8192, n_layers 4, "
                        "n_kv_heads 1): ~6.7 GF/token vs ~1.06 KB/token",
-        "rates_source": rates["source"],
+        "rates_source": rates_source,
         "alpha_recompute_s_per_token": round(alpha_w, 8),
         "gamma_staged_s_per_token": round(gamma_w, 8),
         "delta_dcn_s_per_token": round(delta_w, 8),
@@ -1844,6 +2309,20 @@ def parse_args(argv=None):
              "workload and write benchmarking/FLEET_BENCH_FAULTS.json",
     )
     ap.add_argument(
+        "--placement", action="store_true",
+        help="run the multi-tenant hotspot scenario (placement/ "
+             "subsystem): Zipf tenant mix over per-tenant LoRA-isolated "
+             "system prefixes; precise-only vs proactive K-way "
+             "replication, writing benchmarking/FLEET_BENCH_PLACEMENT.json",
+    )
+    ap.add_argument(
+        "--cluster-replicas", type=int, default=0, metavar="N",
+        help="route the synthetic headline precise arm through N "
+             "partitioned ClusterScorer replicas (cluster/) and verify the "
+             "result is bit-identical to the monolithic arm; prints the "
+             "verdict, writes no artifact",
+    )
+    ap.add_argument(
         "--replication", action="store_true",
         help="run the indexer kill-and-restart scenario (FaultPlan "
              "indexer_crash) over the ShareGPT replay: cold restart vs "
@@ -1855,7 +2334,11 @@ def parse_args(argv=None):
 
 if __name__ == "__main__":
     _args = parse_args()
-    if _args.replication:
+    if _args.placement:
+        main_placement(_args)
+    elif _args.cluster_replicas > 1:
+        main_cluster_check(_args)
+    elif _args.replication:
         main_replication(_args)
     elif _args.faults:
         main_faults(_args)

@@ -325,6 +325,74 @@ def fleet_replication_section() -> str:
     ])
 
 
+def fleet_placement_section() -> str:
+    """Multi-tenant hotspot scenario (bench.py --placement / placement/
+    subsystem): what proactive K-way hot-prefix replication buys over
+    precise routing alone when tenant popularity is Zipf."""
+    path = os.path.join(HERE, "FLEET_BENCH_PLACEMENT.json")
+    if not os.path.exists(path):
+        raise SystemExit(
+            "benchmarking/FLEET_BENCH_PLACEMENT.json missing — run "
+            "`python bench.py --placement`"
+        )
+    stats = _load(path)
+    cfg = stats["config"]
+    arms = stats["arms"]
+    rows = []
+    for name, label in (
+        ("uniform_precise", "uniform mix, precise"),
+        ("hotspot_precise", "hotspot mix, precise only"),
+        ("hotspot_placement", "**hotspot mix, + placement**"),
+    ):
+        a = arms[name]
+        rows.append(
+            f"| {label} | {a['ttft_p50_s']} | {a['ttft_p90_s']} "
+            f"| {a['ttft_mean_s']} | {a['prefix_hit_rate']:.1%} "
+            f"| {a['preemptions']} | {a['hot_tenant_pods_used']} |"
+        )
+    placement = arms["hotspot_placement"].get("placement", {})
+    rep = placement.get("replicator", {})
+    pf = placement.get("prefetcher", {})
+    hot_counts = arms["hotspot_precise"]["hot_tenant_pod_counts"]
+    spread_counts = arms["hotspot_placement"]["hot_tenant_pod_counts"]
+    return "\n".join([
+        f"Multi-tenant ShareGPT arm ({cfg['n_tenants']} tenants × "
+        f"{cfg['prefix_words']}-word system prefixes, each under its own "
+        f"LoRA keyspace; Zipf s={cfg['zipf_s']} tenant popularity — the "
+        f"hot tenant draws {cfg['hot_tenant_session_share']:.0%} of "
+        f"sessions). All arms route precisely with the data plane on "
+        f"(winning-regime model class), so the precise-only arm already "
+        "has every REACTIVE remedy; the comparison isolates PROACTIVE "
+        "placement: a decayed heavy-hitters tracker detects hot chains "
+        f"and replicates their prefixes to K={cfg['placement']['k_replicas']} "
+        "pods through the route-prefetch/transfer plane.",
+        "",
+        "| Arm | TTFT p50 (s) | TTFT p90 (s) | TTFT mean (s) | Hit rate "
+        "| Preemptions | Hot-tenant pods |",
+        "|---|---:|---:|---:|---:|---:|---:|",
+        *rows,
+        "",
+        f"The hotspot concentrates all "
+        f"{arms['hotspot_precise']['hot_tenant_requests']} hot-tenant "
+        f"requests onto ONE pod ({hot_counts}) — mean TTFT degrades "
+        f"{stats['ttft_mean_degradation_precise_only_x']}x vs the uniform "
+        "baseline as its prefill queue and preemption churn compound. "
+        f"Replication spreads them {spread_counts} via the least-loaded "
+        "tie-break over warm replicas, holding the degradation to "
+        f"{stats['ttft_mean_degradation_placement_x']}x "
+        f"(**{stats['ttft_p50_speedup_vs_precise_only']}x TTFT p50 vs "
+        "precise-only**) and retaining "
+        f"**{stats['hit_rate_retention_placement']:.1%}** of the "
+        "uniform-mix hit rate (target ≥90%). Replication is safe by "
+        f"construction: {rep.get('jobs_submitted', 0)} jobs / "
+        f"{placement.get('replicated_blocks', 0)} blocks landed with "
+        f"{pf.get('dropped', 0)} queue drops and "
+        f"{rep.get('skipped_unhealthy', 0)} unhealthy targets skipped "
+        "(suspect/stale pods are never chosen). Source: "
+        "`FLEET_BENCH_PLACEMENT.json`.",
+    ])
+
+
 def fleet_device_section() -> str:
     """Device-measured mini-fleet TTFTs (VERDICT r2 #3: measured, not
     modeled). Rendered from FLEET_DEVICE_BENCH.json when the bench has run
@@ -902,6 +970,7 @@ def regenerate(text: str) -> str:
         ("fleet", fleet_section()),
         ("fleet-faults", fleet_faults_section()),
         ("fleet-replication", fleet_replication_section()),
+        ("fleet-placement", fleet_placement_section()),
         ("fleet-device", fleet_device_section()),
         ("device", device_section()),
         ("micro", micro_section()),

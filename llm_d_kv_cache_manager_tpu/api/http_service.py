@@ -65,6 +65,7 @@ from llm_d_kv_cache_manager_tpu.fleethealth import (
     FleetHealthTracker,
 )
 from llm_d_kv_cache_manager_tpu.kvevents.pool import EventPool, EventPoolConfig
+from llm_d_kv_cache_manager_tpu.metrics import collector as metrics_collector
 from llm_d_kv_cache_manager_tpu.preprocessing.chat_completions import (
     ChatTemplatingProcessor,
     RenderRequest,
@@ -114,6 +115,19 @@ def config_from_env() -> dict:
         "cluster_replicas": int(os.environ.get("CLUSTER_REPLICAS", "1")),
         "cluster_replica_id": int(os.environ.get("CLUSTER_REPLICA_ID", "0")),
         "cluster_snapshot_path": os.environ.get("CLUSTER_SNAPSHOT_PATH", ""),
+        # Predictive placement (placement/): PLACEMENT=1 attaches the
+        # hot-prefix popularity tracker to the read path, the event pool,
+        # and (when the backends support it) the instrumented/cost-aware
+        # index — observation only; scores stay bit-identical. PLACEMENT=0
+        # (default) leaves every hook None.
+        "placement": os.environ.get("PLACEMENT", "0") == "1",
+        "placement_top_k": int(os.environ.get("PLACEMENT_TOP_K", "64")),
+        "placement_half_life_s": float(
+            os.environ.get("PLACEMENT_HALF_LIFE_S", "120")
+        ),
+        "placement_hotness": float(
+            os.environ.get("PLACEMENT_HOTNESS", "30")
+        ),
     }
 
 
@@ -232,6 +246,33 @@ class ScoringService:
         # Optional scatter-gather front (embedders wire a ClusterScorer
         # over peer replicas); surfaces through /cluster/status only.
         self.cluster_scorer = None
+
+        # Predictive placement (placement/): PLACEMENT=1 attaches the
+        # popularity tracker at every ingest seam this process owns. The
+        # replicator itself needs a prefetch plane to the engine fleet —
+        # embedders wire a HotPrefixReplicator over their RoutePrefetcher
+        # and assign it to `self.replicator` to surface through
+        # /placement/status.
+        self.popularity = None
+        self.replicator = None
+        if env.get("placement"):
+            from llm_d_kv_cache_manager_tpu.placement import (
+                ChainPopularityTracker,
+                PopularityConfig,
+            )
+
+            self.popularity = ChainPopularityTracker(PopularityConfig(
+                top_k=int(env.get("placement_top_k", 64)),
+                half_life_s=float(env.get("placement_half_life_s", 120.0)),
+            ))
+            self.indexer.popularity = self.popularity
+            self.event_pool.popularity = self.popularity
+            index = self.indexer.kv_block_index
+            if hasattr(index, "popularity"):  # InstrumentedIndex wrapper
+                index.popularity = self.popularity
+                index = index.inner
+            if hasattr(index, "bind_popularity"):  # cost-aware backend
+                index.bind_popularity(self.popularity)
 
     def start(self, with_subscriber: bool = True) -> None:
         self.indexer.run()
@@ -429,6 +470,42 @@ class ScoringService:
 
         return web.json_response(await asyncio.to_thread(build))
 
+    async def handle_placement_status(self, request: web.Request) -> web.Response:
+        """Placement introspection: tracker occupancy/ingest counters, the
+        currently-hot chains (heads as hex — data, never metric labels),
+        and the replicator's policy stats when one is wired."""
+        if self.popularity is None:
+            return web.json_response(
+                {"error": "placement disabled (set PLACEMENT=1)"},
+                status=400,
+            )
+
+        def build():
+            threshold = float(self.env.get("placement_hotness", 30.0))
+            hot = self.popularity.hot_chains(threshold)
+            metrics_collector.set_placement_hot_chains(len(hot))
+            return {
+                "tracker": self.popularity.stats(),
+                "hotness_threshold": threshold,
+                "hot_chains": [
+                    {
+                        "head": f"{c.head:016x}",
+                        "score": round(c.score, 2),
+                        "tenant_extra": list(c.extra),
+                        "model": c.model_name,
+                        "prefix_blocks": len(c.prefix_hashes),
+                        "observations": c.observations,
+                    }
+                    for c in hot[:32]
+                ],
+                "replicator": (
+                    self.replicator.status()
+                    if self.replicator is not None else None
+                ),
+            }
+
+        return web.json_response(await asyncio.to_thread(build))
+
     async def handle_cluster_snapshot(self, request: web.Request) -> web.Response:
         """POST: drain the event pool and write this replica's snapshot
         (view + seq watermarks) to the configured path."""
@@ -456,6 +533,7 @@ class ScoringService:
         app.router.add_get("/health", self.handle_health)
         app.router.add_get("/readyz", self.handle_readyz)
         app.router.add_get("/cluster/status", self.handle_cluster_status)
+        app.router.add_get("/placement/status", self.handle_placement_status)
         app.router.add_post("/cluster/snapshot", self.handle_cluster_snapshot)
         app.router.add_get("/debug/traces", self.handle_debug_traces)
         app.router.add_get("/debug/score_explain", self.handle_score_explain)

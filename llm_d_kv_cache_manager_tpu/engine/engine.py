@@ -25,6 +25,7 @@ import numpy as np
 from llm_d_kv_cache_manager_tpu.engine.block_manager import (
     BlockManager,
     BlockManagerConfig,
+    OutOfPagesError,
     SequenceState,
 )
 from llm_d_kv_cache_manager_tpu.engine.tiering import PageCodec
@@ -714,6 +715,47 @@ class EnginePod:
             h for h in chunk_hashes if not self.block_manager.is_cached(h)
         ]
         return self.tier_store.prefetch(missing)
+
+    def warm_chain(self, tokens: List[int], lora_id: Optional[int] = None) -> int:
+        """Replication warm admission (placement/): materialize the longest
+        *restorable* prefix of this token chain through the data plane
+        (ready buffer → host store → peers over DCN), commit it as cached
+        blocks — `_try_load_chain` emits the chained BlockStored, so the
+        fleet index learns the new replica — and release the pages back to
+        the evictable prefix cache. Never computes: blocks no tier can
+        supply are simply not admitted (a replication hint must not burn
+        MXU time on speculation), and already-resident blocks cost nothing
+        (idempotent re-warm). Returns the number of blocks newly landed."""
+        if self.tier_store is None:
+            return 0
+        tokens = [int(t) for t in tokens]
+        ps = self.config.page_size
+        keys = self.block_manager.token_db.tokens_to_kv_block_keys(
+            None, tokens, "", lora_id=lora_id
+        )
+        if not keys:
+            return 0
+        n_resident = 0
+        for key in keys:
+            if not self.block_manager.is_cached(key.chunk_hash):
+                break
+            n_resident += 1
+        rest = [k.chunk_hash for k in keys[n_resident:]]
+        if not rest:
+            return 0
+        restorable = self.tier_store.plan_restore(rest)
+        if restorable <= 0:
+            return 0
+        n_blocks = n_resident + restorable
+        try:
+            state = self.block_manager.allocate(
+                tokens[: n_blocks * ps], lora_id=lora_id
+            )
+        except OutOfPagesError:
+            return 0  # pressure wins: replication never preempts serving
+        landed = max(state.num_cached_tokens // ps - n_resident, 0)
+        self.block_manager.free(state)
+        return landed
 
     def close(self) -> None:
         if self._publisher is not None:

@@ -95,6 +95,7 @@ class Indexer:
         kv_block_index: Optional[Index] = None,
         chat_templating=None,
         fleet_health=None,
+        popularity=None,
     ):
         self.config = config or IndexerConfig()
         # Optional fleethealth.FleetHealthTracker: when wired, scores pass
@@ -103,6 +104,12 @@ class Indexer:
         # fleet passes through untouched, so enabling the subsystem is
         # bit-identical on the no-fault path.
         self.fleet_health = fleet_health
+        # Optional placement.ChainPopularityTracker: every scored request
+        # reports its chain head + tenant/LoRA extra to the hot-prefix
+        # detector (placement/popularity.py). Observation only — scores are
+        # bit-identical with the tracker attached, and None (the default)
+        # keeps the hot path at one attribute check.
+        self.popularity = popularity
 
         self.prefix_store = (
             tokenization_pool.prefix_store
@@ -240,6 +247,19 @@ class Indexer:
             if _explain is not None:
                 _explain.setdefault("degraded", "no_block_keys")
             return PodScores()
+
+        if self.popularity is not None:
+            # Hot-prefix detection (placement/): the chain head + tenant
+            # extra this request routed under, plus the leading token slice
+            # a replication warm-up would need. Pure observation — nothing
+            # below reads the tracker.
+            self.popularity.observe_route(
+                [k.chunk_hash for k in block_keys],
+                tokens=tokenized.tokens,
+                lora_id=lora_id,
+                model_name=model_name,
+                block_size=self.token_processor.block_size,
+            )
 
         with obs.stage("read.lookup"):
             key_to_pods = self.kv_block_index.lookup(

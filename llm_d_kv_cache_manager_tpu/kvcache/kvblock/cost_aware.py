@@ -41,6 +41,12 @@ def calculate_byte_size(key: Key, entries: Sequence[PodEntry]) -> int:
 class CostAwareIndexConfig:
     max_size_bytes: Union[int, str] = DEFAULT_MAX_SIZE
     pod_cache_size: int = DEFAULT_PODS_PER_KEY
+    # Popularity-weighted eviction (placement/): when a popularity tracker
+    # is bound, pressure evicts the lowest-retention key among this many
+    # LRU-oldest candidates instead of strictly the oldest. 1 (default)
+    # is pure LRU — bit-identical to the pre-placement backend whether or
+    # not a tracker is bound.
+    eviction_sample: int = 1
 
 
 class _CostedPodCache:
@@ -59,11 +65,35 @@ class CostAwareMemoryIndex(Index):
         cfg = config or CostAwareIndexConfig()
         self._budget = parse_human_size(cfg.max_size_bytes)
         self._pod_cache_size = cfg.pod_cache_size
+        self._eviction_sample = max(1, cfg.eviction_sample)
         self._data: "OrderedDict[Key, _CostedPodCache]" = OrderedDict()
         self._engine_to_request: Dict[Key, Key] = {}
         self._request_to_engines: Dict[Key, Set[Key]] = {}
         self._total_cost = 0
         self._mu = threading.Lock()
+        # Placement integration (bind_popularity): eviction weighs decayed
+        # block popularity against what re-landing the block would cost.
+        self._popularity = None
+        self._reland_cost_model = None
+        self.eviction_stats = {"lru": 0, "weighted": 0}
+
+    def bind_popularity(self, tracker, cost_model=None) -> None:
+        """Attach a placement popularity tracker (and optionally an
+        engine/costs.TransferCostModel) to eviction.
+
+        Under byte pressure the victim becomes the key with the lowest
+        *retention value* among the `eviction_sample` LRU-oldest
+        candidates, where retention = decayed block popularity x the
+        per-token seconds losing the placement would cost the fleet: with
+        a cost model, `recompute_s` for a block only resident in device
+        tiers, `staged_restore_s` when a host-tier copy exists (the
+        knowledge is cheaper to rebuild, so the entry is less sticky);
+        without one, popularity alone ranks the window. Hot replicated
+        prefixes therefore stay pinned while the cold long tail drains in
+        LRU order — and with `eviction_sample` left at 1 the backend stays
+        bit-identical to pure LRU regardless of this binding."""
+        self._popularity = tracker
+        self._reland_cost_model = cost_model
 
     @property
     def total_cost_bytes(self) -> int:
@@ -128,11 +158,53 @@ class CostAwareMemoryIndex(Index):
                     )
                 self._total_cost += pod_cache.cost
 
-            # Evict least-recently-used keys until under budget.
-            while self._total_cost > self._budget and len(self._data) > 1:
-                evicted_key, evicted_cache = self._data.popitem(last=False)
-                self._total_cost -= evicted_cache.cost
-                self._drop_engine_mappings(evicted_key)
+            # Evict until under budget (LRU, or popularity-weighted within
+            # the LRU sample window when a tracker is bound).
+            self._evict_over_budget()
+
+    def _evict_over_budget(self) -> None:
+        """Shed keys until the byte budget holds (caller holds _mu)."""
+        while self._total_cost > self._budget and len(self._data) > 1:
+            victim = self._pick_victim()
+            evicted_cache = self._data.pop(victim)
+            self._total_cost -= evicted_cache.cost
+            self._drop_engine_mappings(victim)
+
+    def _pick_victim(self) -> Key:
+        """LRU victim, unless a popularity tracker is bound AND the sample
+        window is >1: then the lowest-retention key among the
+        `eviction_sample` oldest (ties keep LRU order, so a tracker that
+        scores everything equally degenerates to exact LRU)."""
+        it = iter(self._data)
+        oldest = next(it)
+        if self._popularity is None or self._eviction_sample <= 1:
+            self.eviction_stats["lru"] += 1
+            return oldest
+        self.eviction_stats["weighted"] += 1
+        best_key, best_value = oldest, self._retention(oldest)
+        for _ in range(self._eviction_sample - 1):
+            key = next(it, None)
+            if key is None:
+                break
+            value = self._retention(key)
+            if value < best_value:
+                best_key, best_value = key, value
+        return best_key
+
+    def _retention(self, key: Key) -> float:
+        """Popularity x per-token re-landing cost for one key (see
+        bind_popularity)."""
+        pop = self._popularity.block_score(key.chunk_hash)
+        model = self._reland_cost_model
+        if model is None:
+            return pop
+        pod_cache = self._data[key]
+        restorable = any(
+            e.device_tier not in ("hbm", "gpu", "device")
+            for e in pod_cache.cache.keys()
+        )
+        reland_s = model.staged_restore_s if restorable else model.recompute_s
+        return pop * reland_s
 
     def evict(self, engine_key: Key, entries: Sequence[PodEntry]) -> None:
         if not entries:
@@ -243,10 +315,7 @@ class CostAwareMemoryIndex(Index):
                 self._request_to_engines.setdefault(request_key, set()).add(
                     engine_key
                 )
-            while self._total_cost > self._budget and len(self._data) > 1:
-                evicted_key, evicted_cache = self._data.popitem(last=False)
-                self._total_cost -= evicted_cache.cost
-                self._drop_engine_mappings(evicted_key)
+            self._evict_over_budget()
         return imported
 
     def _drop_engine_mappings(self, request_key: Key) -> None:

@@ -122,6 +122,10 @@ class IndexConfig:
     redis_config: Optional["RedisIndexConfig"] = None
     enable_metrics: bool = False
     metrics_logging_interval_s: float = 60.0
+    # InstrumentedIndex: observe kvcache_index_max_pod_hit_count every Nth
+    # lookup (the per-lookup pod hit-count walk is the one O(result) pass
+    # the wrapper adds; 1 = every call, the historical behavior).
+    metrics_hit_count_stride: int = 1
     # In-memory striping (kvblock/sharded.py). When the in-memory backend is
     # selected (explicitly or by default), `sharded=True` builds a
     # lock-striped ShardedIndex over `num_shards` segments instead of the
@@ -173,7 +177,9 @@ def new_index(config: Optional[IndexConfig] = None) -> Index:
 
         register_metrics()
         start_metrics_logging(config.metrics_logging_interval_s)
-        index = InstrumentedIndex(index)
+        index = InstrumentedIndex(
+            index, hit_count_stride=config.metrics_hit_count_stride
+        )
 
     return index
 

@@ -4,6 +4,20 @@ Parity target: instrumentedIndex
 (/root/reference/pkg/kvcache/kvblock/instrumented_index.go:25-92): wraps any
 Index, emitting admission/eviction counters and, per lookup, the latency plus
 the maximum per-pod consecutive hit count.
+
+The per-lookup pod hit-count walk is the expensive part: it re-scans every
+(key, entry) pair of the result to rebuild a Counter from scratch, purely to
+observe one histogram sample — measurable on a read path whose whole lookup
+is ~75µs. Two changes keep the signal without the per-call tax:
+
+- **Strided observation.** `hit_count_stride` observes
+  `kvcache_index_max_pod_hit_count` every Nth lookup (1 = seed behavior,
+  every call). The histogram is a distribution-shape signal; sampling it
+  does not bias it.
+- **Shared ingest.** When a placement popularity tracker is attached, the
+  same walk that builds the hit counts feeds the tracker's block sketch
+  (`observe_lookup`) — blocks that keep getting looked up *and found* are
+  reuse evidence. One walk, two consumers; with neither due, no walk at all.
 """
 
 from __future__ import annotations
@@ -16,10 +30,22 @@ from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
 from llm_d_kv_cache_manager_tpu.metrics import collector as m
 
+DEFAULT_HIT_COUNT_STRIDE = 1
+
 
 class InstrumentedIndex(Index):
-    def __init__(self, inner: Index):
+    def __init__(
+        self,
+        inner: Index,
+        hit_count_stride: int = DEFAULT_HIT_COUNT_STRIDE,
+        popularity=None,
+    ):
         self.inner = inner
+        self.hit_count_stride = max(1, int(hit_count_stride))
+        # Optional placement.ChainPopularityTracker: lookup hits feed its
+        # block sketch through the same result walk the histogram uses.
+        self.popularity = popularity
+        self._lookup_count = 0
 
     def lookup(
         self, request_keys: Sequence[Key], pod_identifier_set: Set[str]
@@ -32,11 +58,24 @@ class InstrumentedIndex(Index):
             m.index_lookup_requests.inc()
             m.index_lookup_latency.observe(elapsed)
             m.index_lookup_hits.inc(len(result))
-            hit_counts: PyCounter = PyCounter()
-            for entries in result.values():
-                for entry in entries:
-                    hit_counts[entry.pod_identifier] += 1
-            m.index_max_pod_hits.observe(max(hit_counts.values()) if hit_counts else 0)
+            # Racy increment under concurrent readers only perturbs which
+            # lookup gets sampled, never the count of samples per stride
+            # window by more than the reader count.
+            self._lookup_count += 1
+            observe_hits = self._lookup_count % self.hit_count_stride == 0
+            if observe_hits or self.popularity is not None:
+                hit_counts: PyCounter = PyCounter()
+                for entries in result.values():
+                    for entry in entries:
+                        hit_counts[entry.pod_identifier] += 1
+                if observe_hits:
+                    m.index_max_pod_hits.observe(
+                        max(hit_counts.values()) if hit_counts else 0
+                    )
+                if self.popularity is not None and result:
+                    self.popularity.observe_lookup(
+                        [k.chunk_hash for k in result]
+                    )
         return result
 
     def add(

@@ -124,6 +124,7 @@ class EventPool:
         token_processor: ChunkedTokenDatabase,
         health_tracker=None,
         message_filter=None,
+        popularity=None,
     ):
         self.config = config or EventPoolConfig()
         self.index = index
@@ -149,6 +150,11 @@ class EventPool:
         # import cycle): every decoded batch stamps per-pod liveness and
         # runs seq/ts gap detection; poison pills count as decode failures.
         self.health_tracker = health_tracker
+        # Optional placement.ChainPopularityTracker (duck-typed likewise):
+        # BlockStored digests credit the stored request keys in the block
+        # sketch — fleet-wide re-store traffic is reuse evidence the
+        # cost-aware eviction weighs. Observation only; None costs one check.
+        self.popularity = popularity
         depth = max(0, self.config.max_queue_depth)
         self._queues: List["queue.Queue[Optional[Message]]"] = [
             queue.Queue(maxsize=depth) for _ in range(self.config.concurrency)
@@ -545,6 +551,9 @@ class EventPool:
         request_keys = self.token_processor.tokens_to_kv_block_keys(
             parent_request_key, ev.token_ids, model_name, lora_id=lora_id
         )
+
+        if self.popularity is not None and request_keys:
+            self.popularity.observe_store([k.chunk_hash for k in request_keys])
 
         if engine_keys:
             try:

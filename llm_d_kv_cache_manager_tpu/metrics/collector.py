@@ -80,6 +80,16 @@ replica_replay_lag: Optional[Gauge] = None
 replica_state_transitions: Optional[Counter] = None
 replica_scatter_errors: Optional[Counter] = None
 
+# Predictive placement (placement/): hot-chain table occupancy, replication
+# jobs/blocks pushed through the prefetch plane, bounded-queue drops, and
+# targets skipped because fleet health doubted them. All unlabeled —
+# chain heads and pod names are data, never labels.
+placement_hot_chains: Optional[Gauge] = None
+placement_replications: Optional[Counter] = None
+placement_replicated_blocks: Optional[Counter] = None
+placement_drops: Optional[Counter] = None
+placement_skipped_unhealthy: Optional[Counter] = None
+
 _APPLY_DELAY_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
     5.0, 10.0, 30.0, 60.0,
@@ -104,6 +114,9 @@ def register_metrics(registry=None) -> None:
     global stage_latency, event_apply_delay
     global replica_partitions, replica_snapshot_age, replica_replay_lag
     global replica_state_transitions, replica_scatter_errors
+    global placement_hot_chains, placement_replications
+    global placement_replicated_blocks, placement_drops
+    global placement_skipped_unhealthy
 
     with _register_lock:
         if _registered:
@@ -261,6 +274,35 @@ def register_metrics(registry=None) -> None:
             "out (its partition degraded to no-cache-signal)",
             registry=reg,
         )
+        placement_hot_chains = Gauge(
+            "kvcache_placement_hot_chains",
+            "Chains currently above the hotness threshold in the "
+            "popularity tracker's top-K table (placement/popularity.py)",
+            registry=reg,
+        )
+        placement_replications = Counter(
+            "kvcache_placement_replications_total",
+            "Replication jobs submitted to the prefetch plane by the "
+            "hot-prefix replicator",
+            registry=reg,
+        )
+        placement_replicated_blocks = Counter(
+            "kvcache_placement_replicated_blocks_total",
+            "Prefix blocks submitted for proactive replication",
+            registry=reg,
+        )
+        placement_drops = Counter(
+            "kvcache_placement_drops_total",
+            "Replication jobs dropped because the bounded prefetch queue "
+            "was full or closed",
+            registry=reg,
+        )
+        placement_skipped_unhealthy = Counter(
+            "kvcache_placement_skipped_unhealthy_total",
+            "Replication targets skipped because fleet health reported "
+            "them suspect or stale",
+            registry=reg,
+        )
         _registered = True
 
 
@@ -365,6 +407,27 @@ def count_replica_transition(state: str) -> None:
 def count_scatter_error() -> None:
     if replica_scatter_errors is not None:
         replica_scatter_errors.inc()
+
+
+def set_placement_hot_chains(n: int) -> None:
+    if placement_hot_chains is not None:
+        placement_hot_chains.set(n)
+
+
+def count_placement_replication(blocks: int) -> None:
+    if placement_replications is not None:
+        placement_replications.inc()
+        placement_replicated_blocks.inc(blocks)
+
+
+def count_placement_drop() -> None:
+    if placement_drops is not None:
+        placement_drops.inc()
+
+
+def count_placement_skip_unhealthy() -> None:
+    if placement_skipped_unhealthy is not None:
+        placement_skipped_unhealthy.inc()
 
 
 def counter_value(c: Optional[Counter]) -> float:

@@ -20,6 +20,14 @@ makes that measurable without network egress:
                 kept for artifact continuity; formerly utils/workload.py).
 """
 
+from llm_d_kv_cache_manager_tpu.workloads.multitenant import (  # noqa: F401
+    MultiTenantConfig,
+    tenant_of,
+    tenant_weights,
+)
+from llm_d_kv_cache_manager_tpu.workloads.multitenant import (  # noqa: F401
+    generate as generate_multitenant,
+)
 from llm_d_kv_cache_manager_tpu.workloads.sharegpt import (  # noqa: F401
     ShareGPTConfig,
     generate,
@@ -36,8 +44,12 @@ from llm_d_kv_cache_manager_tpu.workloads.trace import (  # noqa: F401
 )
 
 __all__ = [
+    "MultiTenantConfig",
     "ShareGPTConfig",
     "generate",
+    "generate_multitenant",
+    "tenant_of",
+    "tenant_weights",
     "uniform_control",
     "MaterializedRequest",
     "TraceTurn",

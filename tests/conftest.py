@@ -51,6 +51,12 @@ def pytest_collection_modifyitems(config, items):
         for item in items:
             if "transfer" in item.keywords:
                 item.add_marker(skip)
+        # `placement`-marked tests replicate real KV payloads through the
+        # same transfer plane; the sketch/replicator policy tests are
+        # unmarked and always run.
+        for item in items:
+            if "placement" in item.keywords:
+                item.add_marker(skip)
 
     # `cluster`-marked tests exercise the gRPC scatter-gather transport;
     # the local-transport cluster tests are unmarked and always run.
