@@ -3,8 +3,8 @@
 # /root/reference/Makefile, /root/reference/hooks/pre-commit.sh).
 
 .PHONY: native kvtransfer test bench bench-micro bench-read bench-obs \
-	bench-faults bench-replication bench-placement bench-transfer clean \
-	proto lint precommit-install image-build image-push
+	bench-batch bench-faults bench-replication bench-placement \
+	bench-transfer clean proto lint precommit-install image-build image-push
 
 # Container image coordinates (override per environment/registry). The
 # release workflow (.github/workflows/ci-release.yaml) builds the same
@@ -73,6 +73,14 @@ bench-read:
 # MICRO_BENCH.json): python benchmarking/micro_bench.py
 bench-obs:
 	JAX_PLATFORMS=cpu python benchmarking/micro_bench.py --quick --legs obs
+
+# Batched read-path legs only (Indexer.score_many at router batch sizes
+# 1/8/32/128, shared-prefix vs disjoint mixes, warm vs cold, plus the
+# 32-sequential-single-calls baseline). Acceptance: warm per-request
+# < 50µs at batch 32. Full mode (rewrites MICRO_BENCH.json):
+#   python benchmarking/micro_bench.py
+bench-batch: native
+	JAX_PLATFORMS=cpu python benchmarking/micro_bench.py --quick --legs batch
 
 # Fault-injection fleet scenario (fleethealth/): pod crash/restart, event
 # stall, lossy/reordering streams over the synthetic chat workload.

@@ -83,7 +83,11 @@ _ensure_native()
 
 from llm_d_kv_cache_manager_tpu.engine.block_manager import OutOfPagesError
 from llm_d_kv_cache_manager_tpu.engine.engine import EnginePod, EnginePodConfig
-from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import (
+    Indexer,
+    IndexerConfig,
+    ScoreRequest,
+)
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
     TokenProcessorConfig,
 )
@@ -263,8 +267,16 @@ class FleetSim:
         tail_journal_len: int = 0,
         placement=None,
         cluster_replicas: int = 1,
+        batch_window: int = 0,
     ):
         self.strategy = strategy
+        # Router batching (--batch-window; the score_many read path):
+        # serve_batch() scores a whole arrival window in one bulk call
+        # and queues the per-item score maps here; route() consumes them
+        # in arrival order instead of making a per-request scoring call.
+        # Empty deque (the default path) leaves route() untouched.
+        self.batch_window = batch_window
+        self._prescored = collections.deque()
         self.host_tier = host_tier
         self.alpha = alpha
         self.gamma = gamma
@@ -703,18 +715,24 @@ class FleetSim:
             # out and it falls back to least-loaded — degraded, not stuck.
             self.indexer_down_requests += 1
             return min(self._alive_pods(), key=lambda i: self.pod_free_at[i])
-        t0 = time.perf_counter()
-        if self.cluster_scorer is not None:
-            scores = self.cluster_scorer.get_pod_scores(
-                prompt, MODEL, [], lora_id=lora_id
-            )
+        if self._prescored:
+            # Batched router window (serve_batch): this request's scores
+            # were produced by ONE score_many call over the whole window;
+            # its amortized read latency was recorded at prescore time.
+            scores = self._prescored.popleft()
         else:
-            scores = self.indexer.get_pod_scores(
-                prompt, MODEL, [], lora_id=lora_id
-            )
+            t0 = time.perf_counter()
+            if self.cluster_scorer is not None:
+                scores = self.cluster_scorer.get_pod_scores(
+                    prompt, MODEL, [], lora_id=lora_id
+                )
+            else:
+                scores = self.indexer.get_pod_scores(
+                    prompt, MODEL, [], lora_id=lora_id
+                )
+            self.read_latencies.append(time.perf_counter() - t0)
         if self._indexer_restarted and not scores:
             self.scores_empty_after_restart += 1
-        self.read_latencies.append(time.perf_counter() - t0)
         if self._crashed and scores and any(
             int(p.split("-")[1]) in self._crashed for p in scores
         ):
@@ -786,6 +804,44 @@ class FleetSim:
         self.pods[pod_idx].free(victim)
         self.preemptions += 1
         return self.alpha * n_tokens
+
+    def serve_batch(self, items) -> list:
+        """Serve one router arrival window: ONE `score_many` call over
+        the whole window (against the index state at the window's head —
+        what a real batching router sees), then the requests are served
+        in arrival order consuming the prescored decisions. `items` is a
+        list of `(arrival_s, prompt)` pairs. At window=1 the prescore IS
+        a single-item bulk call over exactly the state the per-request
+        path would score, so routing (and therefore the whole TTFT
+        stream) is bit-identical to the flag-off run — pinned by
+        `--batch-window 1`. Wired for the plain precise arm (no faults /
+        replication / placement composition)."""
+        if not items:
+            return []
+        first = items[0][0]
+        # The same prelude serve() runs before routing, so the window is
+        # scored against exactly the state the head request would see.
+        # serve() re-runs these at the same sim time as a no-op.
+        self.now = first
+        self._apply_lifecycle(first)
+        self._apply_indexer_lifecycle(first)
+        self._maybe_snapshot(first)
+        self._release_finished(first)
+        if not self._indexer_down:
+            reqs = [
+                ScoreRequest(prompt=prompt, model_name=MODEL)
+                for _, prompt in items
+            ]
+            t0 = time.perf_counter()
+            if self.cluster_scorer is not None:
+                results = self.cluster_scorer.score_many(reqs)
+            else:
+                results = self.indexer.score_many(reqs)
+            amortized = (time.perf_counter() - t0) / len(items)
+            for r in results:
+                self._prescored.append(r.scores)
+                self.read_latencies.append(amortized)
+        return [self.serve(arrival, prompt) for arrival, prompt in items]
 
     def serve(
         self,
@@ -1889,6 +1945,74 @@ def main_cluster_check(args):
         sys.exit(1)
 
 
+def run_batch_window_arm(window: int, qps: float = QPS):
+    """The synthetic chat workload served through router arrival windows:
+    requests are grouped into windows of `window` arrivals, each window
+    scored by ONE `Indexer.score_many` call, then served in order. The
+    prompt stream is built with the exact RNG call sequence of
+    run_strategy (question then response per request), so the served
+    prompts are identical to the flag-off run and any TTFT difference is
+    purely a routing-decision difference."""
+    requests, conversations, rng = build_workload(qps=qps)
+    sim = FleetSim("precise", batch_window=window)
+    ttfts = []
+    window_buf = []
+    try:
+        for arrival, conv_id in requests:
+            question = _text(rng, QUESTION_WORDS)
+            prompt = conversations[conv_id] + " [user] " + question
+            conversations[conv_id] = (
+                prompt + " [assistant] " + _text(rng, RESPONSE_WORDS)
+            )
+            window_buf.append((arrival, prompt))
+            if len(window_buf) == window:
+                ttfts.extend(sim.serve_batch(window_buf))
+                window_buf = []
+        if window_buf:
+            ttfts.extend(sim.serve_batch(window_buf))
+        hit_rate = sim.hit_tokens / max(sim.total_tokens, 1)
+        lat = sorted(sim.read_latencies)
+        read_p50 = lat[len(lat) // 2] if lat else 0.0
+        return ttfts, hit_rate, read_p50
+    finally:
+        sim.shutdown()
+
+
+def main_batch_window(args):
+    """--batch-window W: serve the synthetic headline precise arm through
+    router arrival windows scored by `score_many`. Always runs the W=1
+    pin first — one-item windows must route bit-identically to the
+    per-request path (identical TTFT stream + hit rate) — then reports
+    the requested window. Prints the verdict; commits nothing — the
+    per-request artifacts stay the single source of truth."""
+    w = args.batch_window
+    t_start = time.time()
+    ttft_single, hit_single, _, _ = run_strategy("precise")
+    ttft_w1, hit_w1, _ = run_batch_window_arm(1)
+    identical = ttft_single == ttft_w1 and hit_single == hit_w1
+    out = {
+        "metric": "batch_window_w1_bit_identical",
+        "value": bool(identical),
+        "window": w,
+        "prefix_hit_rate_per_request": round(hit_single, 4),
+        "prefix_hit_rate_w1": round(hit_w1, 4),
+        "ttft_p50_per_request_s": round(p50(ttft_single), 4),
+        "ttft_p50_w1_s": round(p50(ttft_w1), 4),
+        "requests": len(ttft_single),
+    }
+    if w > 1:
+        ttft_w, hit_w, read_w = run_batch_window_arm(w)
+        out.update({
+            "prefix_hit_rate_at_window": round(hit_w, 4),
+            "ttft_p50_at_window_s": round(p50(ttft_w), 4),
+            "read_path_p50_ms_at_window": round(read_w * 1e3, 3),
+        })
+    out["wall_s"] = round(time.time() - t_start, 1)
+    print(json.dumps(out))
+    if not identical:
+        sys.exit(1)
+
+
 def p50(xs):
     return sorted(xs)[len(xs) // 2]
 
@@ -2323,6 +2447,14 @@ def parse_args(argv=None):
              "verdict, writes no artifact",
     )
     ap.add_argument(
+        "--batch-window", type=int, default=0, metavar="W",
+        help="serve the synthetic headline precise arm through router "
+             "arrival windows of W requests, each window scored by one "
+             "Indexer.score_many call; always pins W=1 bit-identical to "
+             "the per-request path first. Prints the verdict, writes no "
+             "artifact",
+    )
+    ap.add_argument(
         "--replication", action="store_true",
         help="run the indexer kill-and-restart scenario (FaultPlan "
              "indexer_crash) over the ShareGPT replay: cold restart vs "
@@ -2336,6 +2468,8 @@ if __name__ == "__main__":
     _args = parse_args()
     if _args.placement:
         main_placement(_args)
+    elif _args.batch_window > 0:
+        main_batch_window(_args)
     elif _args.cluster_replicas > 1:
         main_cluster_check(_args)
     elif _args.replication:

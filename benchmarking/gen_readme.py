@@ -887,6 +887,64 @@ def micro_section() -> str:
     return "\n".join(out)
 
 
+def batch_section() -> str:
+    """Batched read path (`Indexer.score_many`) legs from
+    MICRO_BENCH.json — per-request amortized cost at router batch sizes
+    vs the sequential single-call baseline (ISSUE 9 acceptance: warm
+    < 50µs/request at batch 32)."""
+    path = os.path.join(HERE, "MICRO_BENCH.json")
+    if not os.path.exists(path):
+        return (
+            "_Not yet recorded — run `python benchmarking/micro_bench.py`._"
+        )
+    d = _load(path).get("score_many")
+    if not d:
+        return (
+            "_score_many legs not in the committed MICRO_BENCH.json — rerun "
+            "`python benchmarking/micro_bench.py`._"
+        )
+    sizes = d["batch_sizes"]
+    out = [
+        f"Per-request amortized cost of `Indexer.score_many` "
+        f"({d['pods']} pods, block size {d['block_size']}; `shared` = "
+        "every item extends one hot system prefix, `disjoint` = unrelated "
+        "prompts; warm = prefix store + chain memo steady state, cold = "
+        "full tokenization + from-scratch derivation; `single×32` = the "
+        "same 32 requests through sequential `get_pod_scores_ex` calls on "
+        "identical state):",
+        "",
+        "| Arm / mix | "
+        + " | ".join(f"batch {b} (µs/req)" for b in sizes)
+        + " | single×32 (µs/req) | speedup at 32 |",
+        "|---|" + "---:|" * (len(sizes) + 2),
+    ]
+    for arm in ("warm", "cold"):
+        for mix in ("shared", "disjoint"):
+            m = d[arm][mix]
+            cells = " | ".join(
+                str(m[f"batch_{b}"]["per_request_us"]) for b in sizes
+            )
+            out.append(
+                f"| {arm} {mix} | {cells} | "
+                f"{m['single_loop_32']['per_request_us']} | "
+                f"**{m['speedup_x_at_32']}×** |"
+            )
+    met = "met" if d["meets_50us_target"] else "NOT met"
+    out += [
+        "",
+        f"Acceptance (ROADMAP): warm per-request < 50µs at batch 32 — "
+        f"worst warm mix is **{d['warm_32_per_request_us']} µs** "
+        f"({met}). Batch ≡ N-single-calls bit-identity is pinned in "
+        "`tests/test_score_many.py` across all four index backends, LoRA "
+        "keyspaces, fleet-health states, a 2-replica scatter-gather, and "
+        "the gRPC streaming transport; `bench.py --batch-window 1` pins "
+        "window-1 routing bit-identical to per-request routing on the "
+        "fleet sim. `make bench-batch` reruns these legs. Source: "
+        "`MICRO_BENCH.json` (`score_many`).",
+    ]
+    return "\n".join(out)
+
+
 def obs_section() -> str:
     """Tracing-spine legs from MICRO_BENCH.json: per-stage attribution of
     the three planes + the enabled-tracing overhead on the warm read
@@ -974,6 +1032,7 @@ def regenerate(text: str) -> str:
         ("fleet-device", fleet_device_section()),
         ("device", device_section()),
         ("micro", micro_section()),
+        ("batch", batch_section()),
         ("obs", obs_section()),
     ):
         pattern = re.compile(

@@ -30,6 +30,12 @@ Legs (all through public APIs):
   derivation), chunk_hash_warm (chain memo + prefix-store boundary
   states), their ratio, the memo-insert overhead on a truly cold request,
   and the whole read path cold vs warm (get_pod_scores)
+- score_many: the batched read path (`Indexer.score_many`) at router
+  batch sizes 1/8/32/128 — shared-prefix vs disjoint mixes, warm (prefix
+  store + chain memo steady state) vs cold (full tokenization +
+  from-scratch derivation), whole-batch p50 and per-request amortized µs,
+  plus the same 32 requests through sequential single calls for the
+  batch-vs-loop speedup (acceptance: warm per-request < 50µs at 32)
 - obs_overhead: the tracing spine's tax on the warm read path — A/B/A
   (disabled/enabled/disabled) p50 over several trials, median overhead
   pct (acceptance: <5%), plus disabled-mode agreement with the untraced
@@ -45,7 +51,7 @@ The classic legs run with tracing DISABLED (obs/ ships enabled by
 default) so their numbers stay comparable with pre-obs rounds; the obs
 legs measure the enabled/disabled delta explicitly.
 
-Run: python benchmarking/micro_bench.py [--quick] [--legs all|read|obs]
+Run: python benchmarking/micro_bench.py [--quick] [--legs all|read|obs|batch]
 Writes MICRO_BENCH.json (full mode, all legs) and prints it.
 """
 
@@ -364,6 +370,154 @@ def read_path_replay(quick: bool) -> dict:
     return report
 
 
+def score_many_legs(quick: bool) -> dict:
+    """Batched read path (`Indexer.score_many`) at router batch sizes.
+
+    Two request mixes — `shared` (every item extends one hot system
+    prefix, the router's common case and where intra-batch dedup bites)
+    and `disjoint` (unrelated prompts: no sharing to exploit, the
+    conservative bound) — each measured warm (prefix store + chain memo
+    serving, the steady state) and cold (chain memo off, prefix store
+    defeated: every item pays full tokenization + from-scratch
+    derivation). Reported per batch size as whole-batch p50 plus the
+    per-request amortized cost; `single_loop` is the same 32 requests
+    through sequential `get_pod_scores_ex` calls on the same warm state,
+    so `speedup_x_at_32` is batch-vs-loop on identical work. Acceptance
+    (ROADMAP): warm per-request < 50µs at batch 32."""
+    from llm_d_kv_cache_manager_tpu.kvcache.indexer import (
+        Indexer,
+        IndexerConfig,
+        ScoreRequest,
+    )
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import PodEntry
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+        ChunkedTokenDatabase,
+        TokenProcessorConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+        TokenizationPool,
+        TokenizersPoolConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.workloads.synthetic import text
+
+    rng = random.Random(17)
+    batch_sizes = [1, 8, 32] if quick else [1, 8, 32, 128]
+    n_prompts = max(batch_sizes)
+
+    # Request mixes. Prompt lengths mirror the classic get_pod_scores leg
+    # (~1.9k tokens there); here the shared mix carries an 800-word system
+    # prefix + ~40-word user tails, the disjoint mix ~250 words apiece.
+    shared_prefix = text(rng, 800)
+    mixes = {
+        "shared": [
+            shared_prefix + " " + text(rng, 40) for _ in range(n_prompts)
+        ],
+        "disjoint": [text(rng, 250) for _ in range(n_prompts)],
+    }
+
+    report: dict = {
+        "batch_sizes": batch_sizes,
+        "block_size": 16,
+        "pods": 4,
+    }
+    pods = [PodEntry(f"pod-{i}", "hbm") for i in range(4)]
+    nomemo = ChunkedTokenDatabase(
+        TokenProcessorConfig(block_size=16, chain_memo=False)
+    )
+
+    def build_indexer(warm: bool) -> Indexer:
+        return Indexer(
+            config=IndexerConfig(
+                token_processor_config=TokenProcessorConfig(
+                    block_size=16, chain_memo=warm,
+                ),
+            ),
+            tokenization_pool=TokenizationPool(
+                TokenizersPoolConfig(
+                    workers=2,
+                    local_tokenizer_files={MODEL: FIXTURE},
+                    # Cold arm: defeat the prefix store so every item pays
+                    # full tokenization + from-scratch derivation.
+                    min_prefix_overlap_ratio=0.8 if warm else 1.1,
+                ),
+            ),
+        )
+
+    for arm, warm in (("warm", True), ("cold", False)):
+        arm_report: dict = {}
+        for mix_name, prompts in mixes.items():
+            indexer = build_indexer(warm)
+            indexer.run()
+            try:
+                # Populate: each prompt's full chain on one pod (scores
+                # are real, not all-miss).
+                for i, prompt in enumerate(prompts):
+                    toks = indexer.tokenizers_pool.tokenizer.encode(
+                        prompt, MODEL
+                    ).tokens
+                    keys = nomemo.tokens_to_kv_block_keys(None, toks, MODEL)
+                    if keys:
+                        indexer.kv_block_index.add(keys, keys, [pods[i % 4]])
+                if warm:  # store + memo learn every prompt (steady state)
+                    for _ in range(2):
+                        for prompt in prompts:
+                            indexer.get_pod_scores(prompt, MODEL, [])
+                # The warm arm is the acceptance gate (<50µs/req at 32):
+                # keep ≥30 samples at every batch size so its p50 is a
+                # real median, not a handful of timer draws. The cold arm
+                # is ms-scale — relative noise is small, fewer reps do.
+                if warm:
+                    floor, budget = (8, 40) if quick else (30, 400)
+                else:
+                    floor, budget = (3, 12) if quick else (5, 60)
+                mix_report: dict = {}
+                for bs in batch_sizes:
+                    reqs = [
+                        ScoreRequest(prompt=p, model_name=MODEL)
+                        for p in prompts[:bs]
+                    ]
+                    iters = max(floor, budget // bs)
+                    t = _timeit(lambda: indexer.score_many(reqs), iters)
+                    t["per_request_us"] = round(t["p50_us"] / bs, 1)
+                    mix_report[f"batch_{bs}"] = t
+                # Same 32 requests, sequential single calls, same state —
+                # the apples-to-apples amortization baseline.
+                loop_bs = 32 if 32 in batch_sizes else max(batch_sizes)
+                reqs = [
+                    ScoreRequest(prompt=p, model_name=MODEL)
+                    for p in prompts[:loop_bs]
+                ]
+                t = _timeit(
+                    lambda: [
+                        indexer.get_pod_scores_ex(
+                            r.prompt, r.model_name, r.pod_identifiers
+                        )
+                        for r in reqs
+                    ],
+                    max(floor, budget // loop_bs),
+                )
+                t["per_request_us"] = round(t["p50_us"] / loop_bs, 1)
+                mix_report["single_loop_32"] = t
+                mix_report["speedup_x_at_32"] = round(
+                    mix_report["single_loop_32"]["per_request_us"]
+                    / max(
+                        mix_report[f"batch_{loop_bs}"]["per_request_us"], 0.1
+                    ),
+                    2,
+                )
+                arm_report[mix_name] = mix_report
+            finally:
+                indexer.shutdown()
+        report[arm] = arm_report
+
+    report["warm_32_per_request_us"] = max(
+        report["warm"]["shared"]["batch_32"]["per_request_us"],
+        report["warm"]["disjoint"]["batch_32"]["per_request_us"],
+    )
+    report["meets_50us_target"] = report["warm_32_per_request_us"] < 50.0
+    return report
+
+
 def obs_legs(quick: bool) -> dict:
     """obs_overhead + stage_attribution (see module docstring).
 
@@ -617,10 +771,11 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="CI smoke sizes")
     ap.add_argument(
-        "--legs", choices=["all", "read", "obs"], default="all",
+        "--legs", choices=["all", "read", "obs", "batch"], default="all",
         help="'read' runs only the read_path_replay legs (make bench-read); "
         "'obs' runs only the tracing overhead + stage-attribution legs "
-        "(make bench-obs)",
+        "(make bench-obs); 'batch' runs only the score_many legs "
+        "(make bench-batch)",
     )
     args = ap.parse_args()
     iters = 30 if args.quick else 300
@@ -639,6 +794,11 @@ def main():
 
     if args.legs == "obs":
         report = obs_legs(args.quick)
+        print(json.dumps(report, indent=2))
+        return
+
+    if args.legs == "batch":
+        report = {"score_many": score_many_legs(args.quick)}
         print(json.dumps(report, indent=2))
         return
 
@@ -814,6 +974,9 @@ def main():
 
     # Incremental-derivation legs over a multi-turn ShareGPT-style replay.
     report["read_path_replay"] = read_path_replay(args.quick)
+
+    # Batched read path (score_many) at router batch sizes.
+    report["score_many"] = score_many_legs(args.quick)
 
     # Tracing-spine legs: enabled-mode overhead + three-plane attribution.
     report.update(obs_legs(args.quick))

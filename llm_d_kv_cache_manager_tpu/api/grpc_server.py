@@ -17,8 +17,12 @@ the serializer explicit anyway — the JSON body is the same document
 from __future__ import annotations
 
 import json
+import os
+import queue
+import threading
+import time
 from concurrent import futures
-from typing import Dict
+from typing import Dict, Iterator, List
 
 import grpc
 
@@ -32,9 +36,32 @@ METHOD_GET_POD_SCORES = "GetPodScores"
 METHOD_GET_POD_SCORES_EX = "GetPodScoresEx"
 METHOD_EXPLAIN_SCORES = "ExplainScores"
 METHOD_CLUSTER_STATUS = "ClusterStatus"
+METHOD_SCORE_PODS_BULK = "ScorePodsBulk"
+
+# Bulk-endpoint micro-batching defaults. `serve_grpc` callers can override
+# per instance; left unset, the SCORE_BATCH_MAX / SCORE_BATCH_WINDOW_MS
+# environment knobs (the same ones the HTTP batch endpoint reads) apply.
+DEFAULT_BULK_MAX_BATCH = 128
+DEFAULT_BULK_WINDOW_S = 0.0
 
 
-def _make_handler(indexer, cluster_status_fn=None):
+def _request_to_score_request(request: pb.GetPodScoresRequest):
+    from llm_d_kv_cache_manager_tpu.kvcache.indexer import ScoreRequest
+
+    return ScoreRequest(
+        prompt=request.prompt,
+        model_name=request.model_name,
+        pod_identifiers=list(request.pod_identifiers),
+        lora_id=request.lora_id if request.HasField("lora_id") else None,
+    )
+
+
+def _make_handler(
+    indexer,
+    cluster_status_fn=None,
+    bulk_max_batch: int = DEFAULT_BULK_MAX_BATCH,
+    bulk_window_s: float = DEFAULT_BULK_WINDOW_S,
+):
     def get_pod_scores(
         request: pb.GetPodScoresRequest, context: grpc.ServicerContext
     ) -> pb.GetPodScoresResponse:
@@ -108,7 +135,83 @@ def _make_handler(indexer, cluster_status_fn=None):
             context.abort(grpc.StatusCode.INTERNAL, str(e))
             return {}
 
+    def score_pods_bulk(
+        request_iterator, context: grpc.ServicerContext
+    ) -> Iterator[dict]:
+        """Streaming bulk read path: a stream of `GetPodScoresRequest`s
+        in, a stream of per-item results out, emitted as they complete.
+
+        A feeder thread drains the request stream into a queue; the
+        serving loop micro-batches whatever has arrived (up to
+        `bulk_max_batch` items, waiting at most `bulk_window_s` after the
+        first item of a window) and scores each window through
+        `Indexer.score_many` — so a router pushing 32 concurrent requests
+        pays ONE amortized read-path pass, while a trickle of singles
+        still gets per-request latency. Responses carry `index` (the
+        request's position in the stream) and stream back in order."""
+        feed: "queue.Queue" = queue.Queue()
+        _done = object()
+
+        def feeder():
+            try:
+                for req in request_iterator:
+                    feed.put(req)
+            except Exception as e:  # noqa: BLE001 - stream torn down
+                logger.debug("bulk request stream ended: %s", e)
+            finally:
+                feed.put(_done)
+
+        threading.Thread(
+            target=feeder, name="grpc-bulk-feeder", daemon=True
+        ).start()
+
+        index = 0
+        finished = False
+        while not finished:
+            first = feed.get()
+            if first is _done:
+                break
+            window = [first]
+            if bulk_window_s > 0:
+                deadline = time.perf_counter() + bulk_window_s
+            while len(window) < bulk_max_batch:
+                try:
+                    if bulk_window_s > 0:
+                        budget = deadline - time.perf_counter()
+                        if budget <= 0:
+                            break
+                        item = feed.get(timeout=budget)
+                    else:
+                        item = feed.get_nowait()
+                except queue.Empty:
+                    break
+                if item is _done:
+                    finished = True
+                    break
+                window.append(item)
+            try:
+                scored = indexer.score_many(
+                    [_request_to_score_request(r) for r in window]
+                )
+            except Exception as e:  # noqa: BLE001 - surface as gRPC status
+                logger.warning("ScorePodsBulk window failed: %s", e)
+                context.abort(grpc.StatusCode.INTERNAL, str(e))
+                return
+            for result in scored:
+                yield {
+                    "index": index,
+                    "scores": result.scores,
+                    "match_blocks": result.match_blocks,
+                    "block_hashes": result.block_hashes,
+                }
+                index += 1
+
     rpc_handlers = {
+        METHOD_SCORE_PODS_BULK: grpc.stream_stream_rpc_method_handler(
+            score_pods_bulk,
+            request_deserializer=pb.GetPodScoresRequest.FromString,
+            response_serializer=lambda d: json.dumps(d).encode("utf-8"),
+        ),
         METHOD_GET_POD_SCORES: grpc.unary_unary_rpc_method_handler(
             get_pod_scores,
             request_deserializer=pb.GetPodScoresRequest.FromString,
@@ -138,16 +241,38 @@ def serve_grpc(
     address: str = "[::]:50051",
     max_workers: int = 8,
     cluster_status_fn=None,
+    bulk_max_batch: int = None,
+    bulk_window_s: float = None,
 ) -> grpc.Server:
     """Start (non-blocking) a gRPC server wrapping the indexer.
 
     `cluster_status_fn` (optional zero-arg callable) backs the
     `ClusterStatus` method — pass `ClusterScorer.status` or a replica's
     readiness composition when this server fronts a replicated index.
+    `bulk_max_batch` / `bulk_window_s` shape the `ScorePodsBulk`
+    micro-batcher: at most that many stream items are folded into one
+    `score_many` window, waiting at most that long after a window's first
+    item (0 = score whatever has already arrived, never wait). Left None,
+    they resolve from SCORE_BATCH_MAX / SCORE_BATCH_WINDOW_MS — the same
+    environment knobs the HTTP `/score_completions/batch` cap reads.
     """
+    if bulk_max_batch is None:
+        bulk_max_batch = int(
+            os.environ.get("SCORE_BATCH_MAX", DEFAULT_BULK_MAX_BATCH)
+        )
+    if bulk_window_s is None:
+        bulk_window_s = (
+            float(os.environ.get("SCORE_BATCH_WINDOW_MS", 0))
+            / 1000.0
+        ) or DEFAULT_BULK_WINDOW_S
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     server.add_generic_rpc_handlers(
-        (_make_handler(indexer, cluster_status_fn=cluster_status_fn),)
+        (_make_handler(
+            indexer,
+            cluster_status_fn=cluster_status_fn,
+            bulk_max_batch=bulk_max_batch,
+            bulk_window_s=bulk_window_s,
+        ),)
     )
     server.add_insecure_port(address)
     server.start()
@@ -179,6 +304,11 @@ class IndexerGrpcClient:
         )
         self._status_call = self._channel.unary_unary(
             f"/{SERVICE_NAME}/{METHOD_CLUSTER_STATUS}",
+            request_serializer=pb.GetPodScoresRequest.SerializeToString,
+            response_deserializer=lambda b: json.loads(b.decode("utf-8")),
+        )
+        self._bulk_call = self._channel.stream_stream(
+            f"/{SERVICE_NAME}/{METHOD_SCORE_PODS_BULK}",
             request_serializer=pb.GetPodScoresRequest.SerializeToString,
             response_deserializer=lambda b: json.loads(b.decode("utf-8")),
         )
@@ -225,6 +355,30 @@ class IndexerGrpcClient:
         if lora_id is not None:
             request.lora_id = lora_id
         return self._ex_call(request, timeout=self._timeout)
+
+    def score_pods_bulk(self, requests) -> List[dict]:
+        """Streaming bulk scoring: `requests` is a sequence of dicts with
+        `prompt`, `model_name` and optional `pod_identifiers` / `lora_id`.
+        Streams every request up, collects the per-item JSON results
+        (emitted by the server as its micro-batches complete) and returns
+        them ordered by stream position — one
+        `{"index", "scores", "match_blocks", "block_hashes"}` payload per
+        request."""
+
+        def gen():
+            for r in requests:
+                request = pb.GetPodScoresRequest(
+                    prompt=r["prompt"],
+                    model_name=r["model_name"],
+                    pod_identifiers=list(r.get("pod_identifiers", ())),
+                )
+                if r.get("lora_id") is not None:
+                    request.lora_id = r["lora_id"]
+                yield request
+
+        results = list(self._bulk_call(gen(), timeout=self._timeout))
+        results.sort(key=lambda d: d["index"])
+        return results
 
     def cluster_status(self) -> dict:
         return self._status_call(

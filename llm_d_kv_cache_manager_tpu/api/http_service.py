@@ -5,6 +5,13 @@ reference's Dockerfile entrypoint): one process wiring the Indexer read path,
 the ZMQ KVEvents write plane, and Prometheus metrics behind HTTP:
 
   POST /score_completions       {"prompt", "model", "pods"?} -> {"podScores"}
+  POST /score_completions/batch {"requests": [{"prompt", "model", "pods"?,
+                                 "lora_id"?}, ...]} -> {"results":
+                                [{"podScores"}, ...]} — the whole batch
+                                runs through Indexer.score_many (one
+                                amortized read-path pass, per-item
+                                results bit-identical to N single calls);
+                                batch size capped by SCORE_BATCH_MAX
   POST /score_chat_completions  {"messages"/"conversations", "model",
                                  "chat_template"?, "pods"?}
                                 -> {"podScores", "templated_messages"}
@@ -93,6 +100,15 @@ def config_from_env() -> dict:
             os.environ.get("CHAIN_MEMO_CAPACITY", "131072")
         ),
         "http_port": int(os.environ.get("HTTP_PORT", "8080")),
+        # Batched read path (score_many): the largest batch one
+        # /score_completions/batch call (or one gRPC ScorePodsBulk
+        # micro-batch window) may score, and how long the gRPC stream's
+        # micro-batcher waits after a window's first item for stragglers
+        # (0 = score whatever has arrived, never wait).
+        "score_batch_max": int(os.environ.get("SCORE_BATCH_MAX", "128")),
+        "score_batch_window_ms": float(
+            os.environ.get("SCORE_BATCH_WINDOW_MS", "0")
+        ),
         "hf_token": os.environ.get("HF_TOKEN"),
         "enable_hf": os.environ.get("ENABLE_HF_TOKENIZER", "") == "1",
         "enable_metrics": os.environ.get("ENABLE_METRICS", "1") == "1",
@@ -303,6 +319,51 @@ class ScoringService:
         except Exception as e:  # noqa: BLE001
             return web.json_response({"error": str(e)}, status=500)
         return web.json_response({"podScores": scores})
+
+    async def handle_score_completions_batch(
+        self, request: web.Request
+    ) -> web.Response:
+        """Bulk scoring: the whole batch runs through `score_many` — one
+        amortized read-path pass, per-item results bit-identical to N
+        sequential /score_completions calls. Per-item overload
+        degradation applies (a shed item scores empty, the batch
+        survives)."""
+        from llm_d_kv_cache_manager_tpu.kvcache.indexer import ScoreRequest
+
+        try:
+            body = await request.json()
+            raw = body["requests"]
+            if not isinstance(raw, list):
+                raise TypeError("requests must be a list")
+            score_requests = [
+                ScoreRequest(
+                    prompt=item["prompt"],
+                    model_name=item["model"],
+                    pod_identifiers=item.get("pods", []),
+                    lora_id=item.get("lora_id"),
+                )
+                for item in raw
+            ]
+        except (json.JSONDecodeError, KeyError, TypeError) as e:
+            return web.json_response(
+                {"error": f"invalid request: {e}"}, status=400
+            )
+        max_batch = int(self.env.get("score_batch_max", 128))
+        if len(score_requests) > max_batch:
+            return web.json_response(
+                {"error": f"batch of {len(score_requests)} exceeds "
+                          f"SCORE_BATCH_MAX={max_batch}"},
+                status=400,
+            )
+        try:
+            results = await asyncio.to_thread(
+                self.indexer.score_many, score_requests
+            )
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"error": str(e)}, status=500)
+        return web.json_response(
+            {"results": [{"podScores": r.scores} for r in results]}
+        )
 
     async def handle_score_chat_completions(self, request: web.Request) -> web.Response:
         try:
@@ -526,6 +587,9 @@ class ScoringService:
     def make_app(self) -> web.Application:
         app = web.Application()
         app.router.add_post("/score_completions", self.handle_score_completions)
+        app.router.add_post(
+            "/score_completions/batch", self.handle_score_completions_batch
+        )
         app.router.add_post(
             "/score_chat_completions", self.handle_score_chat_completions
         )

@@ -69,6 +69,11 @@ class LocalReplicaTransport:
             prompt, model_name, pod_identifiers, lora_id=lora_id
         )
 
+    def score_many(self, requests) -> List[PodScores]:
+        """Batched read path against the wrapped indexer (one amortized
+        pass instead of N single calls)."""
+        return self.indexer.score_many(requests)
+
 
 class GrpcReplicaTransport:
     """Remote replica over `kvtpu.api.v1.IndexerService/GetPodScoresEx`.
@@ -112,6 +117,41 @@ class GrpcReplicaTransport:
             },
             block_hashes=[int(h) for h in payload.get("block_hashes", [])],
         )
+
+    def score_many(self, requests) -> List[PodScores]:
+        """Batched read path over the streaming `ScorePodsBulk` endpoint:
+        the whole batch rides one gRPC stream (the server micro-batches it
+        through `Indexer.score_many`), so a replica is crossed once per
+        BATCH, not once per request."""
+        import grpc
+
+        try:
+            payloads = self._ensure_client().score_pods_bulk([
+                {
+                    "prompt": r.prompt,
+                    "model_name": r.model_name,
+                    "pod_identifiers": list(r.pod_identifiers),
+                    "lora_id": r.lora_id,
+                }
+                for r in requests
+            ])
+        except (grpc.RpcError, json.JSONDecodeError, OSError) as e:
+            raise ReplicaUnavailable(f"{self.target}: {e}") from e
+        if len(payloads) != len(requests):
+            raise ReplicaUnavailable(
+                f"{self.target}: bulk stream returned {len(payloads)} "
+                f"results for {len(requests)} requests"
+            )
+        return [
+            PodScores(
+                scores=dict(p.get("scores", {})),
+                match_blocks={
+                    pod: int(n) for pod, n in p.get("match_blocks", {}).items()
+                },
+                block_hashes=[int(h) for h in p.get("block_hashes", [])],
+            )
+            for p in payloads
+        ]
 
     def close(self) -> None:
         if self._client is not None:
@@ -188,6 +228,67 @@ class ClusterScorer:
             return self._scatter_gather(
                 prompt, model_name, pod_identifiers, lora_id, trace
             )
+
+    def score_many(self, requests) -> List[PodScores]:
+        """Batched scatter-gather: ONE fan-out wave covers the whole
+        router batch — each live replica is crossed once per batch (its
+        transport's `score_many`), and every item's answer merges under
+        the same ownership-keyed rule as `get_pod_scores_ex`. Results are
+        bit-identical to per-request scatter-gather over the same state
+        (pinned by tests/test_score_many.py at N=2 replicas); a replica
+        that fails or misses the deadline contributes no signal to ANY
+        item of this batch — the per-partition no-signal degradation,
+        batch-scoped."""
+        if not requests:
+            return []
+        requests = list(requests)
+        with obs.request(
+            "cluster.score_many",
+            {"replicas": len(self.transports), "batch": len(requests)},
+        ) as trace:
+            self.scatter_calls += 1
+            targets = self._live_replicas()
+            t_fan = time.perf_counter()
+            futures = [
+                (
+                    rid,
+                    self._executor.submit(
+                        self.transports[rid].score_many, requests
+                    ),
+                )
+                for rid in targets
+            ]
+            deadline = time.perf_counter() + self.config.scatter_timeout_s
+            replies: List[Tuple[int, List[PodScores]]] = []
+            degraded: List[int] = []
+            for rid, fut in futures:
+                budget = max(0.0, deadline - time.perf_counter())
+                try:
+                    result = fut.result(timeout=budget)
+                except Exception as e:  # noqa: BLE001 - degrade per replica
+                    fut.cancel()
+                    self._observe_failure(rid, e)
+                    degraded.append(rid)
+                    continue
+                self._observe_success(rid)
+                replies.append((rid, result))
+            obs.record_into(trace, "cluster.fanout", t_fan, time.perf_counter())
+            if trace is not None and getattr(trace, "meta", None) is not None:
+                trace.meta["degraded_replicas"] = degraded
+
+            t_merge = time.perf_counter()
+            merged = [
+                self._merge([(rid, reply[i]) for rid, reply in replies])
+                for i in range(len(requests))
+            ]
+            obs.record_into(trace, "cluster.merge", t_merge, time.perf_counter())
+            if degraded:
+                kvlog.trace(
+                    logger,
+                    "batched scatter-gather degraded: replicas %s "
+                    "contributed no signal", degraded,
+                )
+            return merged
 
     def _scatter_gather(
         self, prompt, model_name, pod_identifiers, lora_id, trace

@@ -65,6 +65,19 @@ class IndexerConfig:
 
 
 @dataclass
+class ScoreRequest:
+    """One item of a `score_many` batch — the same argument set
+    `get_pod_scores_ex` takes, carried as data so a router can hand the
+    whole arrival window over in one call."""
+
+    prompt: str
+    model_name: str
+    pod_identifiers: Sequence[str] = ()
+    render_request: Optional[object] = None
+    lora_id: Optional[object] = None
+
+
+@dataclass
 class PodScores:
     """Read-path result carrying the routing signal AND the transfer-plane
     signal. `scores` is what `get_pod_scores` always returned (post
@@ -281,6 +294,183 @@ class Indexer:
             match_blocks=match_blocks,
             block_hashes=[k.chunk_hash for k in block_keys],
         )
+
+    def score_many(self, requests: Sequence[ScoreRequest]) -> List[PodScores]:
+        """Bulk read path: score a router batch in one call, amortizing
+        every stage across the batch — the tokenization pool chews all
+        items in parallel (batch latency is max-of-items, not
+        sum-of-items), derivation dedupes shared prefixes through one
+        chain-memo probe and at most two native hash crossings, the index
+        crosses each lock once per batch (`Index.lookup_many`), and the
+        scorer reuses per-block weight maps across items sharing a prefix.
+
+        Results are BIT-IDENTICAL to `[get_pod_scores_ex(r) for r in
+        requests]` over the same state — pinned by tests/test_score_many.py
+        across all four index backends, LoRA keyspaces, fleet-health
+        states, and the cluster scatter-gather front. Degradation is per
+        ITEM: a request shed by a saturated tokenization pool returns an
+        empty `PodScores` in its slot while the rest of the batch scores
+        normally (the single-call overload contract, item-scoped).
+
+        One root trace (`read.score_many`) covers the batch, with
+        `read.batch.*` stage spans plus the pool workers' per-item spans
+        recorded into it — stage attribution shows exactly where the
+        amortization lands."""
+        if not requests:
+            return []
+        with obs.request("read.score_many", {"batch": len(requests)}):
+            return self._score_many(requests)
+
+    def _score_many(self, requests: Sequence[ScoreRequest]) -> List[PodScores]:
+        n = len(requests)
+        results: List[Optional[PodScores]] = [None] * n
+
+        # Same per-item adapter-id validation as the single-call path.
+        loras: List[Optional[int]] = []
+        for r in requests:
+            lora_id = r.lora_id
+            if (
+                not isinstance(lora_id, int) or isinstance(lora_id, bool)
+                or lora_id < 0
+            ):
+                if lora_id is not None:
+                    kvlog.trace(logger, "ignoring invalid lora_id %r", lora_id)
+                lora_id = None
+            loras.append(lora_id)
+
+        with obs.stage("read.batch.tokenize", nested=True):
+            tokenized = self.tokenizers_pool.tokenize_many(
+                [(r.render_request, r.prompt, r.model_name) for r in requests]
+            )
+        live: List[int] = []
+        shed = 0
+        for i, t in enumerate(tokenized):
+            if isinstance(t, PoolOverloadedError):
+                # Per-item degradation: one shed item never degrades the
+                # batch — its slot carries the explicit no-signal answer.
+                results[i] = PodScores()
+                shed += 1
+            else:
+                live.append(i)
+        if shed:
+            logger.warning(
+                "tokenization pool overloaded; %d/%d batch item(s) "
+                "degraded to empty scores", shed, n,
+            )
+
+        with obs.stage("read.batch.derive"):
+            keys_per_item = self.token_processor.tokens_to_kv_block_keys_many([
+                (
+                    tokenized[i].tokens, requests[i].model_name, loras[i],
+                    tokenized[i].prefix_state,
+                )
+                for i in live
+            ])
+
+        # Relevant-pod sets are built ONCE per distinct pod list and reused
+        # across the batch (the single-call path rebuilds per call).
+        #
+        # Prefix-sharing plan: items whose chains share their FIRST key
+        # OBJECT under the same pod filter share a leading prefix — the
+        # chain memo hands requests over a common system prefix the same
+        # Key objects, so a zip-`is` scan finds the shared span at pointer
+        # speed. The first such item becomes the bucket's reference and is
+        # looked up (and walked by the scorer) in full; every later member
+        # looks up only its TAIL past the shared span and forks the
+        # reference's walk state at the divergence point
+        # (`scorer.score_plan`). Bit-identity: the shared span contributes
+        # the exact same entry lists and float additions either way —
+        # sharing only moves who performs the walk. Cold chains (distinct
+        # objects for equal hashes) simply never match: correct, just
+        # unamortized.
+        pod_sets: dict = {}
+        buckets: dict = {}          # (id(pod_set), id(keys[0])) -> plan pos
+        plan_specs: List[dict] = []  # one per scored item, plan order
+        lookup_reqs: List[tuple] = []
+        for pos, i in enumerate(live):
+            block_keys = keys_per_item[pos]
+            if not block_keys:
+                kvlog.trace(
+                    logger, "no block keys for batch item, empty scores"
+                )
+                results[i] = PodScores()
+                continue
+            if self.popularity is not None:
+                tp = tokenized[i]
+                self.popularity.observe_route(
+                    [k.chunk_hash for k in block_keys],
+                    tokens=tp.tokens,
+                    lora_id=loras[i],
+                    model_name=requests[i].model_name,
+                    block_size=self.token_processor.block_size,
+                )
+            pods = tuple(requests[i].pod_identifiers)
+            pod_set = pod_sets.get(pods)
+            if pod_set is None:
+                pod_set = pod_sets[pods] = set(pods)
+            bucket_key = (id(pod_set), id(block_keys[0]))
+            ref_pos = buckets.get(bucket_key)
+            if ref_pos is None:
+                buckets[bucket_key] = len(plan_specs)
+                lookup_idx = len(lookup_reqs)
+                lookup_reqs.append((block_keys, pod_set))
+                plan_specs.append({
+                    "item": i, "keys": block_keys, "lookup": lookup_idx,
+                    "ref": None,
+                })
+            else:
+                ref_keys = plan_specs[ref_pos]["keys"]
+                shared_blocks = 0
+                for a, b in zip(ref_keys, block_keys):
+                    if a is not b:
+                        break
+                    shared_blocks += 1
+                tail = block_keys[shared_blocks:]
+                lookup_idx = None
+                if tail:
+                    lookup_idx = len(lookup_reqs)
+                    lookup_reqs.append((tail, pod_set))
+                plan_specs.append({
+                    "item": i, "keys": block_keys, "lookup": lookup_idx,
+                    "ref": ref_pos, "shared": shared_blocks, "tail": tail,
+                })
+                plan_specs[ref_pos]["forked"] = True
+
+        if plan_specs:
+            with obs.stage("read.batch.lookup"):
+                lookup_many = getattr(self.kv_block_index, "lookup_many", None)
+                if lookup_many is not None:
+                    hits = lookup_many(lookup_reqs)
+                else:  # duck-typed test doubles without the batch API
+                    hits = [
+                        self.kv_block_index.lookup(keys, pod_set)
+                        for keys, pod_set in lookup_reqs
+                    ]
+            with obs.stage("read.batch.score"):
+                plan: List[tuple] = []
+                for spec in plan_specs:
+                    if spec["ref"] is None:
+                        plan.append((
+                            "solo", spec["keys"], hits[spec["lookup"]],
+                            spec.get("forked", False),
+                        ))
+                    else:
+                        plan.append((
+                            "fork", spec["ref"], spec["shared"], spec["tail"],
+                            hits[spec["lookup"]]
+                            if spec["lookup"] is not None else {},
+                        ))
+                scored = self.scorer.score_plan(plan)
+                fleet_health = self.fleet_health
+                for spec, (scores, match_blocks) in zip(plan_specs, scored):
+                    if fleet_health is not None:
+                        scores = fleet_health.filter_scores(scores)
+                    results[spec["item"]] = PodScores(
+                        scores=scores,
+                        match_blocks=match_blocks,
+                        block_hashes=[k.chunk_hash for k in spec["keys"]],
+                    )
+        return results
 
     def explain_scores(
         self,

@@ -180,6 +180,19 @@ class ChainMemo:
             self._blocks_reused += reused
             self._blocks_hashed += hashed
 
+    def _count_batch(
+        self, hits: int, misses: int, reused: int, hashed: int
+    ) -> None:
+        """One lock crossing for a whole batch's counters. Counts carry
+        per-ITEM semantics (what N single calls would have reported), so
+        hit-rate math stays comparable; intra-batch dedup means the actual
+        native hash work can be lower than `blocks_hashed` suggests."""
+        with self._mu:
+            self._hits += hits
+            self._misses += misses
+            self._blocks_reused += reused
+            self._blocks_hashed += hashed
+
     def last_family(self) -> Optional[str]:
         """Entry family that served this thread's last `derive_keys` call:
         "request" (whole-key-tuple probe), "boundary" (prefix-store
@@ -352,3 +365,319 @@ class ChainMemo:
             self._last.family = "segment"
         self._count(covered_segs > 0, covered_segs * sb, len(tail))
         return full
+
+    # -- batched derivation ------------------------------------------------
+
+    def derive_keys_many(
+        self, items: Sequence[tuple]
+    ) -> List[List[Key]]:
+        """Batched `derive_keys`: one request per router-batch item, every
+        memo probe folded into a single `get_many` (one LRU lock crossing
+        for the whole batch), intra-batch dedup of shared chains, and all
+        residual hashing done in at most two native crossings
+        (hashing.prefix_hashes_fast_many) instead of one per item.
+
+        `items` is a sequence of `(model_name, parent, tokens, block_size,
+        extra, algo, prefix_state)` tuples — `derive_keys`'s argument list.
+        Returns one Key list per item, bit-identical to calling
+        `derive_keys` per item (the batch only moves WHERE hashing happens
+        and shares chains that are already identical by fingerprint — the
+        same 64-bit-collision risk class every memo probe accepts).
+
+        Intra-batch sharing: a boundary key folds the cumulative token
+        fingerprint of everything before it, so under one derivation
+        identity two items sharing their FINAL boundary fingerprint share
+        the entire chain up to that boundary. Such items form a chain
+        group: the group's chain derives once (from the member with the
+        least memo coverage) and every member slices its span out of the
+        shared result — B requests over one hot system prefix cost one
+        derivation, not B. Identical residual tails (duplicate prompts)
+        dedupe the same way by content."""
+        n_items = len(items)
+        results: List[Optional[List[Key]]] = [None] * n_items
+        plans: List[Optional[dict]] = [None] * n_items
+        req_probe: List[int] = []
+
+        # -- phase 1: whole-request probe -----------------------------------
+        # The warm steady state is every item resolving on its request
+        # entry, so probe those FIRST (one small get_many) and only build
+        # boundary/segment probe keys for the items that miss — cold-path
+        # bookkeeping never taxes the warm batch.
+        for i, (model_name, parent, tokens, block_size, extra, algo,
+                prefix_state) in enumerate(items):
+            n_full = len(tokens) // block_size
+            if n_full == 0:
+                results[i] = []
+                continue
+            ident = self._ident(model_name, parent, block_size, extra, algo)
+            plan: dict = {"ident": ident, "n_full": n_full, "req_key": None}
+            if prefix_state:
+                n_tokens = len(tokens)
+                last_fp, last_n = prefix_state[-1]
+                if last_n == n_tokens:
+                    h = ((ident ^ _REQ_TAG) * _PRIME) & _M64
+                    h = ((h ^ last_fp) * _PRIME) & _M64
+                    req_key = ((h ^ n_tokens) * _PRIME) & _M64
+                    plan["req_key"] = req_key
+                    req_probe.append(req_key)
+            plans[i] = plan
+
+        found_req = self._cache.get_many(req_probe) if req_probe else {}
+        hits = misses = reused_total = 0
+        probe_keys: List[int] = []
+        for i, plan in enumerate(plans):
+            if plan is None:
+                continue
+            req_key = plan["req_key"]
+            if req_key is not None:
+                entry = found_req.get(req_key)
+                if entry is not None:
+                    keys = entry[0]
+                    results[i] = list(keys)
+                    plan["resolved"] = True
+                    hits += 1
+                    reused_total += len(keys)
+                    continue
+            (model_name, parent, tokens, block_size, extra, algo,
+             prefix_state) = items[i]
+            if prefix_state:
+                ident = plan["ident"]
+                bnd_root = ((ident ^ _BND_TAG) * _PRIME) & _M64
+                bnd_keys = [
+                    ((((bnd_root ^ fp) * _PRIME) & _M64) ^ n_tok)
+                    * _PRIME & _M64
+                    for fp, n_tok in prefix_state
+                ]
+                plan["kind"] = "bnd"
+                plan["bnd_keys"] = bnd_keys
+                plan["n_bnd"] = prefix_state[-1][1] // block_size
+                probe_keys.extend(bnd_keys)
+            else:
+                seg_tokens = self.config.segment_blocks * block_size
+                seg_root = ((plan["ident"] ^ _SEG_TAG) * _PRIME) & _M64
+                fps = hashing.token_fingerprints(seg_root, tokens, seg_tokens)
+                plan["kind"] = "seg"
+                plan["fps"] = fps
+                probe_keys.extend(fps)
+
+        found = self._cache.get_many(probe_keys) if probe_keys else {}
+
+        # -- phase 2: probe walk + work planning (no hashing yet) ----------
+        chain_groups: dict = {}   # (ident, final bnd key) -> group
+        wave1_specs: List[tuple] = []
+        direct_tasks: dict = {}   # (ident, parent_h, tail tuple) -> task
+
+        for i, plan in enumerate(plans):
+            if plan is None or plan.get("resolved"):
+                continue
+            (model_name, parent, tokens, block_size, extra, algo,
+             prefix_state) = items[i]
+            covered_keys: List[Key] = []
+            parent_h = parent
+            if plan["kind"] == "bnd":
+                covered = 0
+                hit_boundaries = 0
+                for bk in plan["bnd_keys"]:
+                    entry = found.get(bk)
+                    if (
+                        entry is not None and len(entry) == 4
+                        and entry[0] == covered
+                    ):
+                        _, delta, parent_after, n_blocks = entry
+                        covered_keys.extend(delta)
+                        parent_h = parent_after
+                        covered = n_blocks
+                        hit_boundaries += 1
+                plan["hit_boundaries"] = hit_boundaries
+                n_bnd = plan["n_bnd"]
+                if covered < n_bnd:
+                    # Chain group: everything up to the final boundary is
+                    # shared by fingerprint; derive it once per group.
+                    gk = (plan["ident"], plan["bnd_keys"][-1])
+                    grp = chain_groups.get(gk)
+                    if grp is None or covered < grp["covered"]:
+                        chain_groups[gk] = {
+                            "covered": covered, "parent_h": parent_h,
+                            "tokens": tokens, "block_size": block_size,
+                            "extra": extra, "algo": algo, "end": n_bnd,
+                            "model": model_name,
+                        }
+                    plan["chain"] = gk
+                elif covered < plan["n_full"]:
+                    # Memo reached (or passed) the final boundary; the
+                    # private tail derives directly.
+                    tail_tokens = tokens[covered * block_size:]
+                    dk = (plan["ident"], parent_h, tuple(tail_tokens))
+                    task = direct_tasks.get(dk)
+                    if task is None:
+                        task = direct_tasks[dk] = len(wave1_specs)
+                        wave1_specs.append((
+                            parent_h, tail_tokens, block_size, extra, algo,
+                        ))
+                    plan["direct"] = task
+            else:
+                fps = plan["fps"]
+                covered_segs = 0
+                for fp in fps:
+                    entry = found.get(fp)
+                    if entry is None:
+                        break
+                    delta, parent_after = entry
+                    covered_keys.extend(delta)
+                    parent_h = parent_after
+                    covered_segs += 1
+                covered = covered_segs * self.config.segment_blocks
+                plan["covered_segs"] = covered_segs
+                if covered < plan["n_full"]:
+                    tail_tokens = tokens[covered * block_size:]
+                    dk = (plan["ident"], parent_h, tuple(tail_tokens))
+                    task = direct_tasks.get(dk)
+                    if task is None:
+                        task = direct_tasks[dk] = len(wave1_specs)
+                        wave1_specs.append((
+                            parent_h, tail_tokens, block_size, extra, algo,
+                        ))
+                    plan["direct"] = task
+            plan["covered"] = covered
+            plan["parent_h"] = parent_h
+            plan["covered_keys"] = covered_keys
+
+        # -- wave 1: chain groups + direct tails, one native crossing ------
+        n_chain = len(chain_groups)
+        chain_list = list(chain_groups.items())
+        specs = [
+            (
+                grp["parent_h"],
+                grp["tokens"][
+                    grp["covered"] * grp["block_size"]:
+                    grp["end"] * grp["block_size"]
+                ],
+                grp["block_size"], grp["extra"], grp["algo"],
+            )
+            for _, grp in chain_list
+        ] + wave1_specs
+        wave1_out = hashing.prefix_hashes_fast_many(specs)
+        for idx, (_, grp) in enumerate(chain_list):
+            hashes = wave1_out[idx]
+            grp["keys"] = [Key(grp["model"], h) for h in hashes]
+            grp["end_parent"] = hashes[-1]
+        direct_keys: List[List[Key]] = []
+        for task in range(len(wave1_specs)):
+            direct_keys.append(None)  # filled below, model comes per item
+        # Direct-tail Key lists are shared across deduped items; build each
+        # once with the first referencing item's model name (the identity
+        # fold guarantees members share it).
+        for i, plan in enumerate(plans):
+            if plan is None or plan.get("resolved") or "direct" not in plan:
+                continue
+            task = plan["direct"]
+            if direct_keys[task] is None:
+                model_name = items[i][0]
+                direct_keys[task] = [
+                    Key(model_name, h) for h in wave1_out[n_chain + task]
+                ]
+
+        # -- wave 2: private tails past a chain group's final boundary -----
+        wave2_specs: List[tuple] = []
+        wave2_tasks: dict = {}
+        for i, plan in enumerate(plans):
+            if plan is None or plan.get("resolved") or "chain" not in plan:
+                continue
+            if plan["n_full"] <= plan["n_bnd"]:
+                continue
+            (model_name, parent, tokens, block_size, extra, algo,
+             prefix_state) = items[i]
+            grp = chain_groups[plan["chain"]]
+            tail_tokens = tokens[plan["n_bnd"] * block_size:]
+            wk = (plan["ident"], plan["chain"][1], tuple(tail_tokens))
+            task = wave2_tasks.get(wk)
+            if task is None:
+                task = wave2_tasks[wk] = len(wave2_specs)
+                wave2_specs.append((
+                    grp["end_parent"], tail_tokens, block_size, extra, algo,
+                ))
+            plan["wave2"] = task
+        wave2_out = (
+            hashing.prefix_hashes_fast_many(wave2_specs)
+            if wave2_specs else []
+        )
+        wave2_keys: List[Optional[List[Key]]] = [None] * len(wave2_specs)
+
+        # -- assembly + memo inserts ---------------------------------------
+        inserts: List[tuple] = []
+        for i, plan in enumerate(plans):
+            if plan is None or plan.get("resolved"):
+                continue
+            (model_name, parent, tokens, block_size, extra, algo,
+             prefix_state) = items[i]
+            covered = plan["covered"]
+            full = plan["covered_keys"]
+            if "chain" in plan:
+                grp = chain_groups[plan["chain"]]
+                full = full + grp["keys"][covered - grp["covered"]:]
+                if "wave2" in plan:
+                    task = plan["wave2"]
+                    if wave2_keys[task] is None:
+                        wave2_keys[task] = [
+                            Key(model_name, h) for h in wave2_out[task]
+                        ]
+                    full = full + wave2_keys[task]
+            elif "direct" in plan:
+                full = full + direct_keys[plan["direct"]]
+            results[i] = full
+            new_keys = len(full) - covered
+            if plan["kind"] == "bnd":
+                hit = plan["hit_boundaries"] > 0
+                bnd_keys = plan["bnd_keys"]
+                if new_keys and plan["hit_boundaries"] < len(prefix_state):
+                    # Same strided insert policy as the single-item path.
+                    stride = self.config.boundary_stride
+                    prev_blocks = covered
+                    last_j = len(prefix_state) - 1
+                    n_full = plan["n_full"]
+                    for j in range(len(prefix_state)):
+                        if j % stride != stride - 1 and j != last_j:
+                            continue
+                        n_blocks = min(
+                            prefix_state[j][1] // block_size, n_full
+                        )
+                        if n_blocks < prev_blocks:
+                            continue
+                        if bnd_keys[j] in found and n_blocks == prev_blocks:
+                            continue
+                        delta = tuple(full[prev_blocks:n_blocks])
+                        parent_after = (
+                            full[n_blocks - 1].chunk_hash
+                            if n_blocks else parent
+                        )
+                        inserts.append((
+                            bnd_keys[j],
+                            (prev_blocks, delta, parent_after, n_blocks),
+                        ))
+                        prev_blocks = n_blocks
+                if plan["req_key"] is not None:
+                    inserts.append((plan["req_key"], (tuple(full),)))
+            else:
+                hit = plan["covered_segs"] > 0
+                fps = plan["fps"]
+                sb = self.config.segment_blocks
+                if plan["covered_segs"] < len(fps):
+                    for s in range(plan["covered_segs"], len(fps)):
+                        delta = tuple(full[s * sb:(s + 1) * sb])
+                        inserts.append((fps[s], (delta, delta[-1].chunk_hash)))
+            if hit:
+                hits += 1
+            else:
+                misses += 1
+            reused_total += covered
+
+        if inserts:
+            self._cache.add_many(inserts)
+        hashed_total = sum(
+            len(r) - p["covered"]
+            for r, p in zip(results, plans)
+            if p is not None and not p.get("resolved")
+        )
+        self._last.family = "batch"
+        self._count_batch(hits, misses, reused_total, hashed_total)
+        return results

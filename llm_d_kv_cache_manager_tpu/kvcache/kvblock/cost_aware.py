@@ -126,6 +126,51 @@ class CostAwareMemoryIndex(Index):
                     pods_per_key[key] = entries
         return pods_per_key
 
+    def lookup_many(
+        self, requests: Sequence[tuple]
+    ) -> List[Dict[Key, List[PodEntry]]]:
+        """Batched `lookup` (Index.lookup_many): the global mutex is taken
+        ONCE for the whole batch instead of once per item; per-item walk
+        semantics (gap cut, filter, recency touch) are the single-call
+        path's exactly. Items sharing a key share the entry list object
+        (the scorer's batch path reuses weight maps through it)."""
+        if not requests:
+            return []
+        out: List[Dict[Key, List[PodEntry]]] = []
+        entries_cache: Dict[Key, list] = {}
+        shared: dict = {}
+        with self._mu:
+            for request_keys, pod_identifier_set in requests:
+                if not request_keys:
+                    raise ValueError("no request keys provided for lookup")
+                pods_per_key: Dict[Key, List[PodEntry]] = {}
+                for key in request_keys:
+                    pod_cache = self._data.get(key)
+                    if pod_cache is None:
+                        break  # gap: post-gap hits can't score
+                    self._data.move_to_end(key)
+                    entries = entries_cache.get(key)
+                    if entries is None:
+                        entries = entries_cache[key] = pod_cache.cache.keys()
+                    if not entries:
+                        break  # prefix chain breaks here
+                    if pod_identifier_set:
+                        sk = (id(pod_identifier_set), key)
+                        hits = shared.get(sk)
+                        if hits is None:
+                            hits = shared[sk] = [
+                                e for e in entries
+                                if pod_matches(
+                                    e.pod_identifier, pod_identifier_set
+                                )
+                            ]
+                        if hits:
+                            pods_per_key[key] = hits
+                    else:
+                        pods_per_key[key] = entries
+                out.append(pods_per_key)
+        return out
+
     def add(
         self,
         engine_keys: Sequence[Key],

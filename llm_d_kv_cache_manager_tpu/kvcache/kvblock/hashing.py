@@ -174,6 +174,7 @@ except ImportError:
 # A stale .so built before the batch API looks native but lacks the new
 # entry points; treat it as absent for the paths that need them.
 _native_batch = getattr(_native, "batch_prefix_hashes", None)
+_native_batch_many = getattr(_native, "batch_prefix_hashes_many", None)
 _native_fps = getattr(_native, "token_fingerprints", None)
 
 
@@ -259,3 +260,38 @@ def prefix_hashes_fast(
             hashes.append(h)
         return hashes
     raise ValueError(f"unknown hash algo: {algo!r}")
+
+
+def prefix_hashes_fast_many(
+    tasks: Sequence[tuple],
+) -> List[List[int]]:
+    """Batched `prefix_hashes_fast`: `tasks` is a sequence of
+    (parent, tokens, block_size, extra, algo) tuples and the result is one
+    hash list per task, bit-identical to calling `prefix_hashes_fast` per
+    task. When every task is fnv64_cbor and the batch-capable C core is
+    built, the whole batch derives in ONE Python↔C crossing with the GIL
+    released (native `batch_prefix_hashes_many`); any other shape — mixed
+    algorithms, sha256 tasks, exotic token types the C conversion rejects —
+    falls back to the per-task wrapper, which defines the behavior."""
+    if not tasks:
+        return []
+    if _native_batch_many is not None and all(
+        t[4] == "fnv64_cbor" for t in tasks
+    ):
+        try:
+            return [
+                list(hashes)
+                for hashes in _native_batch_many([
+                    (
+                        int(parent), tokens, block_size,
+                        None if extra is None else list(extra),
+                    )
+                    for parent, tokens, block_size, extra, _ in tasks
+                ])
+            ]
+        except (TypeError, OverflowError):
+            pass  # fall through: pure Python defines the behavior
+    return [
+        prefix_hashes_fast(parent, tokens, block_size, extra, algo=algo)
+        for parent, tokens, block_size, extra, algo in tasks
+    ]

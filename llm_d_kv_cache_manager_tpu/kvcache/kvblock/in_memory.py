@@ -84,6 +84,53 @@ class InMemoryIndex(Index):
                 pods_per_key[key] = entries
         return pods_per_key
 
+    def lookup_many(
+        self, requests: Sequence[tuple]
+    ) -> List[Dict[Key, List[PodEntry]]]:
+        """Batched `lookup` (Index.lookup_many): ONE `get_many` over the
+        union of every item's keys replaces the per-key lock acquisition
+        of N sequential lookups (recency refreshes once per batch instead
+        of once per item — an LRU-order difference only, never a score
+        difference). Items sharing a key share the materialized entry
+        list object, which is what lets the scorer's batch path reuse
+        per-key weight maps across items."""
+        if not requests:
+            return []
+        union: List[Key] = []
+        for keys, _ in requests:
+            if not keys:
+                raise ValueError("no request keys provided for lookup")
+            union.extend(keys)
+        fetched = self._data.get_many(union)
+        entries_cache: Dict[Key, list] = {}
+        shared: dict = {}
+        out: List[Dict[Key, List[PodEntry]]] = []
+        for request_keys, pod_identifier_set in requests:
+            pods_per_key: Dict[Key, List[PodEntry]] = {}
+            for key in request_keys:
+                pod_cache = fetched.get(key)
+                if pod_cache is None:
+                    break  # gap: chain cut for this item only
+                entries = entries_cache.get(key)
+                if entries is None:
+                    entries = entries_cache[key] = pod_cache.cache.keys()
+                if not entries:
+                    break
+                if pod_identifier_set:
+                    sk = (id(pod_identifier_set), key)
+                    hits = shared.get(sk)
+                    if hits is None:
+                        hits = shared[sk] = [
+                            e for e in entries
+                            if pod_matches(e.pod_identifier, pod_identifier_set)
+                        ]
+                    if hits:
+                        pods_per_key[key] = hits
+                else:
+                    pods_per_key[key] = entries
+            out.append(pods_per_key)
+        return out
+
     def add(
         self,
         engine_keys: Sequence[Key],

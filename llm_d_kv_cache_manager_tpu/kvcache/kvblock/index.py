@@ -32,6 +32,23 @@ class Index(abc.ABC):
         search (prefix chain broke there). Raises ValueError on empty input.
         """
 
+    def lookup_many(
+        self, requests: Sequence[Tuple[Sequence[Key], Set[str]]]
+    ) -> List[Dict[Key, Sequence[PodEntry]]]:
+        """Batched `lookup` (the `score_many` read path): one
+        `(request_keys, pod_identifier_set)` pair per router-batch item,
+        one result dict per item, each carrying the same entries in the
+        same order as a standalone `lookup` over the same state (per-item
+        cut semantics preserved; a backend may hand back immutable tuples
+        where `lookup` copies into fresh lists).
+
+        This default runs the per-item loop — correct on any backend.
+        Backends with a lock to amortize override it: the sharded index
+        crosses each touched segment lock at most once per BATCH, the
+        cost-aware index takes its global mutex once, and the Redis index
+        folds the whole batch into a single pipelined round trip."""
+        return [self.lookup(keys, pods) for keys, pods in requests]
+
     @abc.abstractmethod
     def add(
         self,

@@ -78,6 +78,48 @@ class InstrumentedIndex(Index):
                     )
         return result
 
+    def lookup_many(
+        self, requests: Sequence[tuple]
+    ) -> List[Dict[Key, List[PodEntry]]]:
+        """Batched `lookup` (Index.lookup_many): delegates to the wrapped
+        backend's batch path and observes ONE latency sample plus summed
+        hit counters for the whole batch (requests counted per item). The
+        max-pod-hit-count histogram samples at the same per-lookup stride,
+        counting each item as one lookup."""
+        start = time.perf_counter()
+        results = self.inner.lookup_many(requests)
+        elapsed = time.perf_counter() - start
+
+        if m.index_lookup_requests is not None:
+            m.index_lookup_requests.inc(len(requests))
+            m.index_lookup_latency.observe(elapsed)
+            m.index_lookup_hits.inc(sum(len(r) for r in results))
+            before = self._lookup_count
+            self._lookup_count = before + len(requests)
+            observe_hits = (
+                before // self.hit_count_stride
+                != self._lookup_count // self.hit_count_stride
+            )
+            if observe_hits or self.popularity is not None:
+                hit_counts: PyCounter = PyCounter()
+                looked_up = set()
+                for result in results:
+                    for key, entries in result.items():
+                        if key in looked_up:
+                            continue  # shared entry lists: count keys once
+                        looked_up.add(key)
+                        for entry in entries:
+                            hit_counts[entry.pod_identifier] += 1
+                if observe_hits:
+                    m.index_max_pod_hits.observe(
+                        max(hit_counts.values()) if hit_counts else 0
+                    )
+                if self.popularity is not None and looked_up:
+                    self.popularity.observe_lookup(
+                        [k.chunk_hash for k in looked_up]
+                    )
+        return results
+
     def add(
         self,
         engine_keys: Sequence[Key],

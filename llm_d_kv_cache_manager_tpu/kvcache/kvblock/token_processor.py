@@ -114,3 +114,42 @@ class ChunkedTokenDatabase:
             algo=self.config.hash_algo,
         )
         return [Key(model_name, h) for h in hashes]
+
+    def tokens_to_kv_block_keys_many(
+        self, requests: Sequence[tuple]
+    ) -> List[List[Key]]:
+        """Batched `tokens_to_kv_block_keys` for the `score_many` read
+        path: `requests` is a sequence of `(tokens, model_name, lora_id,
+        prefix_state)` tuples (parent is always the root hash — the read
+        path never continues an engine chain) and the result is one Key
+        list per request, bit-identical to per-request derivation.
+
+        With the chain memo enabled the whole batch derives through
+        `ChainMemo.derive_keys_many` (one memo probe, intra-batch
+        shared-prefix dedup, at most two native crossings); with it
+        disabled every request still derives in ONE native crossing via
+        `hashing.prefix_hashes_fast_many`."""
+        bs = self.config.block_size
+        algo = self.config.hash_algo
+        root = self._init_hash
+        if self.chain_memo is not None:
+            return self.chain_memo.derive_keys_many([
+                (
+                    model_name, root, tokens, bs,
+                    None if lora_id is None else [int(lora_id)],
+                    algo, prefix_state,
+                )
+                for tokens, model_name, lora_id, prefix_state in requests
+            ])
+        hashes_per_request = hashing.prefix_hashes_fast_many([
+            (
+                root, tokens, bs,
+                None if lora_id is None else [int(lora_id)], algo,
+            )
+            for tokens, model_name, lora_id, _ in requests
+        ])
+        return [
+            [Key(model_name, h) for h in hashes]
+            for (_, model_name, _, _), hashes
+            in zip(requests, hashes_per_request)
+        ]

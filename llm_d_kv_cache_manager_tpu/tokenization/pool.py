@@ -27,7 +27,7 @@ import threading
 import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 from llm_d_kv_cache_manager_tpu import obs
 from llm_d_kv_cache_manager_tpu.tokenization.prefixstore.indexer import (
@@ -227,6 +227,123 @@ class TokenizationPool:
                 "retry with backoff or shed the request"
             ) from None
         return fut.result(timeout=timeout)
+
+    def tokenize_many(
+        self, items: Sequence[tuple], timeout: Optional[float] = None
+    ) -> List[object]:
+        """Batched blocking tokenization (the `score_many` read path).
+
+        `items` is a sequence of `(render_request, prompt, model_name)`
+        tuples. EVERY task is enqueued before ANY future is waited on, so
+        batch latency is the max of the items' latencies (the workers chew
+        the batch in parallel), not their sum.
+
+        Overload degrades per ITEM, never per batch: an item whose enqueue
+        finds no queue slot within `enqueue_timeout_s` yields a
+        `PoolOverloadedError` INSTANCE in its result slot (counted like any
+        rejected submission) while the rest of the batch proceeds. The
+        returned list is aligned with `items`: `TokenizedPrompt` on
+        success, the error instance when that item was shed. Worker-side
+        exceptions (unknown model, tokenizer failure) still raise, exactly
+        as N sequential `tokenize_ex` calls would.
+
+        Two batch fast paths on top of the single-call semantics:
+
+        - Warm items resolve INLINE: plain-prompt items run a BATCHED
+          prefix-store walk first (`find_longest_with_state_many` — one
+          chunk-hash chain per distinct shared byte prefix, not one per
+          item); items the store covers at or above
+          `min_prefix_overlap_ratio` never touch the queue at all. Tokens
+          and prefix state are exactly what the worker path would return.
+        - The caller WORK-STEALS while it would otherwise block: after
+          enqueueing the remaining (cold / render-template) items, it
+          drains still-queued tasks and processes them inline (same
+          worker body, futures resolved identically), so a batch chews
+          with `workers + 1` threads and a pool whose workers are all
+          busy can never stall a batch that already holds queue slots."""
+        if not self._started:
+            self.run()
+        trace = obs.current_trace() if obs.enabled() else None
+        resolved: Dict[int, TokenizedPrompt] = {}
+        walk_many = getattr(
+            self.prefix_store, "find_longest_with_state_many", None
+        )
+        if walk_many is not None:
+            plain = [
+                i for i, (render_request, _, _) in enumerate(items)
+                if render_request is None
+            ]
+            if plain:
+                t0 = time.perf_counter() if trace is not None else 0.0
+                walked = walk_many([items[i][1] for i in plain])
+                if trace is not None:
+                    obs.record_into(
+                        trace, "read.prefix_store", t0, time.perf_counter()
+                    )
+                min_ratio = self.config.min_prefix_overlap_ratio
+                for i, (tokens, ratio, state) in zip(plain, walked):
+                    if ratio >= min_ratio:
+                        resolved[i] = TokenizedPrompt(
+                            tokens=tokens, prefix_state=tuple(state)
+                        )
+        futures: List[Optional[Future]] = []
+        for i, (render_request, prompt, model_name) in enumerate(items):
+            if i in resolved:
+                futures.append(None)
+                continue
+            fut: Future = Future()
+            task = _Task(render_request, prompt, model_name, fut)
+            if trace is not None:
+                task.obs_trace = trace
+                task.enqueue_t = time.perf_counter()
+            try:
+                self._queue.put(task, timeout=self.config.enqueue_timeout_s)
+            except queue.Full:
+                self._count_rejected()
+                futures.append(None)
+                continue
+            futures.append(fut)
+        # Steal: anything still queued (this batch's tasks or an earlier
+        # submitter's — either way it's ahead of our last item) runs on
+        # THIS thread instead of waiting for a worker.
+        while True:
+            try:
+                task = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if task is None:
+                # Shutdown sentinel: hand it back for a worker to consume.
+                self._queue.put(None)
+                self._queue.task_done()
+                break
+            try:
+                t = task.obs_trace
+                if task.enqueue_t:
+                    obs.record_into(
+                        t, "read.tokenize_queue_wait", task.enqueue_t,
+                        time.perf_counter(),
+                    )
+                result = self._process(task, t)
+                if task.future is not None:
+                    task.future.set_result(result)
+            except Exception as e:  # noqa: BLE001 - deliver errors to waiter
+                if task.future is not None:
+                    task.future.set_exception(e)
+                else:
+                    logger.warning("async tokenization task failed: %s", e)
+            finally:
+                self._queue.task_done()
+        results: List[object] = []
+        for i, fut in enumerate(futures):
+            if fut is None:
+                hit = resolved.get(i)
+                results.append(hit if hit is not None else PoolOverloadedError(
+                    f"tokenization queue full (depth "
+                    f"{self.config.max_queue_depth}); item shed from batch"
+                ))
+            else:
+                results.append(fut.result(timeout=timeout))
+        return results
 
     def enqueue_tokenization(self, render_request, prompt: str, model_name: str) -> None:
         """Fire-and-forget tokenization (cache warming). Dropped when full."""

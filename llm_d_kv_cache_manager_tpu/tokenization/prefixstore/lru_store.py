@@ -148,3 +148,131 @@ class LRUTokenStore(PrefixStore):
             else:
                 state_ok = False
         return contained, overlap_ratio, tuple(state)
+
+    def find_longest_with_state_many(
+        self, prompts: Sequence[str]
+    ) -> List[Tuple[List[int], float, Tuple[Tuple[int, int], ...]]]:
+        """Batched `find_longest_with_state` (the `score_many` read path).
+
+        Router batches share system prefixes, and a shared BYTE prefix is
+        a shared chunk-hash chain, so the walk amortizes two ways:
+
+        - The first item over a given leading chunk becomes that chunk's
+          REFERENCE walk: it records per-chunk hashes and cumulative
+          (token count, state) snapshots. Later items sharing its leading
+          chunk find their common chunk-aligned byte prefix by a binary
+          search of C-speed `memcmp`s and FORK the reference's snapshot
+          at the divergence chunk — one list slice replaces the whole
+          shared re-walk (hash, probe, token assembly, fingerprint fold
+          per chunk) — then walk only their own tail. Exactly-repeated
+          prompts fork whole.
+        - Each item's own tail probes the chunk cache in geometrically
+          growing WAVES (one `get_many` per wave): the chain hashes are
+          pure compute, so hashing a wave ahead trades at most a few
+          wasted hashes past a cut for one lock crossing per wave instead
+          of one per chunk.
+
+        Per-item results are exactly `find_longest_with_state`'s: byte
+        equality of the shared prefix means the same chunk chain, and the
+        snapshot carries the same cumulative tokens/fold — forking only
+        moves WHO does the identical work; waves only move WHEN a probe
+        happens, and the walk still consumes hits strictly in chain order
+        with the same first-miss cut. The only observable difference is
+        LRU recency: shared chunks are refreshed once per batch (not once
+        per item), and a wave may touch a few chunks past an item's cut."""
+        bs = self.block_size
+        get_many = self._cache.get_many
+        refs: dict = {}  # first chunk bytes -> reference walk record
+        out: List[Tuple[List[int], float, Tuple[Tuple[int, int], ...]]] = []
+        for prompt in prompts:
+            prompt_bytes = prompt.encode("utf-8")
+            n_chunks = len(prompt_bytes) // bs
+            if n_chunks == 0:
+                out.append(([], 0.0, ()))
+                continue
+            contained: List[int] = []
+            prev_hash = 0
+            state: List[Tuple[int, int]] = []
+            state_fp = _STATE_BASIS
+            state_ok = True
+            start_chunk = 0
+            record = None
+
+            first = prompt_bytes[:bs]
+            ref = refs.get(first)
+            if ref is None:
+                # Reference walk: record per-chunk hashes and cumulative
+                # snapshots so later batch-mates can fork mid-chain.
+                record = {
+                    "bytes": prompt_bytes, "hashes": [], "snaps": [],
+                    "contained": contained, "state": state, "cut": None,
+                }
+                refs[first] = record
+            else:
+                # The ref's recorded chunks are its HITS; a ref that cut
+                # offers a shorter shareable span, and the walk below
+                # re-probes the divergence chunk itself (an identical
+                # chunk repeats the identical miss on an unchanged cache).
+                ref_bytes = ref["bytes"]
+                hi = min(n_chunks, len(ref["hashes"]))
+                m = 0
+                if hi >= 1:
+                    # Largest m ≤ hi with identical first m chunks. Chunk
+                    # 0 matched byte-for-byte via the bucket probe: lo=1.
+                    lo = 1
+                    while lo < hi:
+                        mid = (lo + hi + 1) // 2
+                        if prompt_bytes[: mid * bs] == ref_bytes[: mid * bs]:
+                            lo = mid
+                        else:
+                            hi = mid - 1
+                    m = lo
+                if m > 0:
+                    ntok, nstate, state_fp, state_ok = ref["snaps"][m - 1]
+                    contained = ref["contained"][:ntok]
+                    state = ref["state"][:nstate]
+                    prev_hash = ref["hashes"][m - 1]
+                    start_chunk = m
+
+            covered = n_chunks
+            ci = start_chunk
+            wave = 2
+            while ci < n_chunks:
+                upto = min(ci + wave, n_chunks)
+                wave <<= 1
+                hashes: List[int] = []
+                h = prev_hash
+                for k in range(ci, upto):
+                    h = _chunk_hash(h, prompt_bytes[k * bs : (k + 1) * bs])
+                    hashes.append(h)
+                prev_hash = h
+                got = get_many(hashes)  # one lock crossing per wave
+                cut = False
+                for k, block_hash in enumerate(hashes):
+                    entry = got.get(block_hash)
+                    if entry is None:
+                        if record is not None:
+                            record["cut"] = ci + k
+                        covered = ci + k
+                        cut = True
+                        break
+                    block_tokens, tok_fp = entry
+                    contained.extend(block_tokens)
+                    if state_ok and tok_fp is not None:
+                        state_fp = fold64(state_fp, tok_fp)
+                        state.append((state_fp, len(contained)))
+                    else:
+                        state_ok = False
+                    if record is not None:
+                        record["hashes"].append(block_hash)
+                        record["snaps"].append(
+                            (len(contained), len(state), state_fp, state_ok)
+                        )
+                if cut:
+                    break
+                ci = upto
+            overlap_ratio = (
+                (covered * bs) / len(prompt_bytes) if covered else 0.0
+            )
+            out.append((contained, overlap_ratio, tuple(state)))
+        return out

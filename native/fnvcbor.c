@@ -285,6 +285,137 @@ static PyObject *batch_prefix_hashes(PyObject *self, PyObject *args) {
     return result;
 }
 
+/* batch_prefix_hashes_many(requests) -> list[list[int]]
+ * The batched-read-path entry: `requests` is a sequence of
+ * (parent, tokens, block_size, extra|None) tuples — one per router-batch
+ * item — and the whole batch is derived in ONE Python<->C crossing with the
+ * GIL released across every request's hash loop. Each item's result is
+ * exactly batch_prefix_hashes(parent, tokens, block_size, extra); items are
+ * independent chains (no cross-item state), so the only thing the batching
+ * changes is how often the GIL is taken. */
+struct _bp_req {
+    uint64_t parent;
+    uint64_t *toks;
+    Py_ssize_t n_tokens;
+    uint64_t *extra;
+    Py_ssize_t n_extra;
+    Py_ssize_t block_size;
+    Py_ssize_t n_blocks;
+    uint64_t *out;
+};
+
+static void _bp_free(struct _bp_req *reqs, Py_ssize_t n, uint8_t *buf) {
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyMem_Free(reqs[i].toks);
+        PyMem_Free(reqs[i].extra);
+        PyMem_Free(reqs[i].out);
+    }
+    PyMem_Free(reqs);
+    PyMem_Free(buf);
+}
+
+static PyObject *batch_prefix_hashes_many(PyObject *self, PyObject *args) {
+    PyObject *requests_obj;
+    if (!PyArg_ParseTuple(args, "O", &requests_obj)) return NULL;
+    PyObject *seq = PySequence_Fast(requests_obj,
+                                    "requests must be a sequence");
+    if (!seq) return NULL;
+    Py_ssize_t n_reqs = PySequence_Fast_GET_SIZE(seq);
+    struct _bp_req *reqs = (struct _bp_req *)PyMem_Malloc(
+        n_reqs ? n_reqs * sizeof(struct _bp_req) : 1);
+    if (!reqs) {
+        Py_DECREF(seq);
+        return PyErr_NoMemory();
+    }
+    memset(reqs, 0, n_reqs ? n_reqs * sizeof(struct _bp_req) : 1);
+
+    /* Phase 1 (GIL held): convert every request's Python objects. */
+    size_t buf_cap = 32;
+    for (Py_ssize_t i = 0; i < n_reqs; i++) {
+        PyObject *item = PySequence_Fast_GET_ITEM(seq, i);
+        unsigned long long parent;
+        PyObject *tokens_obj, *extra_obj = Py_None;
+        Py_ssize_t block_size;
+        if (!PyTuple_Check(item) ||
+            !PyArg_ParseTuple(item, "KOn|O:batch_prefix_hashes_many request",
+                              &parent, &tokens_obj, &block_size, &extra_obj))
+            goto fail;
+        if (block_size <= 0) {
+            PyErr_SetString(PyExc_ValueError, "block_size must be positive");
+            goto fail;
+        }
+        struct _bp_req *r = &reqs[i];
+        r->parent = (uint64_t)parent;
+        r->block_size = block_size;
+        r->toks = tokens_to_array(tokens_obj, &r->n_tokens);
+        if (!r->toks) goto fail;
+        if (extra_to_array(extra_obj, &r->extra, &r->n_extra) < 0) goto fail;
+        r->n_blocks = r->n_tokens / block_size;
+        r->out = (uint64_t *)PyMem_Malloc(
+            r->n_blocks ? r->n_blocks * sizeof(uint64_t) : 1);
+        if (!r->out) {
+            PyErr_NoMemory();
+            goto fail;
+        }
+        size_t need = 20 + 9 * (size_t)block_size + 9 * (size_t)(r->n_extra + 1);
+        if (need > buf_cap) buf_cap = need;
+    }
+    Py_DECREF(seq);
+    seq = NULL;
+
+    uint8_t *buf = (uint8_t *)PyMem_Malloc(buf_cap);
+    if (!buf) {
+        _bp_free(reqs, n_reqs, NULL);
+        return PyErr_NoMemory();
+    }
+
+    /* Phase 2: every chain in the batch, one GIL release. */
+    Py_BEGIN_ALLOW_THREADS
+    for (Py_ssize_t i = 0; i < n_reqs; i++) {
+        struct _bp_req *r = &reqs[i];
+        uint64_t h = r->parent;
+        for (Py_ssize_t b = 0; b < r->n_blocks; b++) {
+            h = hash_block(buf, h, r->toks + b * r->block_size,
+                           r->block_size, r->extra, r->n_extra);
+            r->out[b] = h;
+        }
+    }
+    Py_END_ALLOW_THREADS
+
+    /* Phase 3 (GIL held): box the results. */
+    PyObject *result = PyList_New(n_reqs);
+    if (result) {
+        for (Py_ssize_t i = 0; i < n_reqs; i++) {
+            struct _bp_req *r = &reqs[i];
+            PyObject *inner = PyList_New(r->n_blocks);
+            if (!inner) {
+                Py_CLEAR(result);
+                break;
+            }
+            for (Py_ssize_t b = 0; b < r->n_blocks; b++) {
+                PyObject *val = PyLong_FromUnsignedLongLong(r->out[b]);
+                if (!val) {
+                    Py_DECREF(inner);
+                    Py_CLEAR(result);
+                    break;
+                }
+                PyList_SET_ITEM(inner, b, val);
+            }
+            if (!result) break;
+            PyList_SET_ITEM(result, i, inner);
+        }
+    }
+    _bp_free(reqs, n_reqs, buf);
+    return result;
+
+fail:
+    /* Every entry was zeroed up front and fields are assigned as they are
+     * allocated, so freeing the whole array is safe mid-conversion. */
+    if (seq) Py_DECREF(seq);
+    _bp_free(reqs, n_reqs, NULL);
+    return NULL;
+}
+
 /* chunk_hash(parent, tokens, extra=None) -> int
  * Single chain link over the WHOLE token sequence (no chunking) -- the
  * native twin of hashing.chunk_hash and the differential-fuzz anchor for
@@ -385,6 +516,10 @@ static PyMethodDef methods[] = {
     {"batch_prefix_hashes", batch_prefix_hashes, METH_VARARGS,
      "Whole-request chained CBOR+FNV-64a block hashes in one crossing: "
      "extra-key (LoRA) support, __index__ token conversion, GIL released."},
+    {"batch_prefix_hashes_many", batch_prefix_hashes_many, METH_VARARGS,
+     "Whole-BATCH chained derivation: a sequence of (parent, tokens, "
+     "block_size, extra|None) requests hashed in one crossing, GIL "
+     "released across every chain."},
     {"chunk_hash", chunk_hash_py, METH_VARARGS,
      "Single CBOR+FNV-64a chain link over the whole token sequence."},
     {"token_fingerprints", token_fingerprints, METH_VARARGS,

@@ -168,3 +168,90 @@ class TestNativeDifferential:
         assert list(native.batch_prefix_hashes(3, tokens, 16)) == (
             _python_chunked(3, [int(t) for t in tokens], 16, None, "fnv64_cbor")
         )
+
+
+@pytest.mark.native
+class TestNativeBatchManyDifferential:
+    """The score_many read-path entry: batch_prefix_hashes_many ≡ N
+    per-request batch_prefix_hashes calls ≡ pure Python, on randomized
+    batches mixing block sizes, extra-key shapes, CBOR width edges, and
+    empty/sub-block token lists within one crossing."""
+
+    def test_many_vs_per_request_vs_python(self):
+        native = hashing._native
+        rng = random.Random(2024)
+        for trial in range(25):
+            reqs = []
+            for _ in range(rng.randrange(1, 12)):
+                bs = rng.choice(BLOCK_SIZES)
+                extra = rng.choice(EXTRA_SHAPES)
+                tokens = _random_stream(rng, rng.randrange(0, 6 * bs + 5))
+                parent = rng.randrange(2**64)
+                reqs.append((parent, tokens, bs, extra))
+            many = native.batch_prefix_hashes_many(reqs)
+            assert len(many) == len(reqs)
+            for (parent, tokens, bs, extra), got in zip(reqs, many):
+                want = list(
+                    native.batch_prefix_hashes(parent, tokens, bs, extra)
+                )
+                assert list(got) == want, f"trial {trial}: many != batch"
+                assert want == _python_chunked(
+                    parent, tokens, bs, extra, "fnv64_cbor"
+                ), f"trial {trial}: batch != python"
+
+    def test_empty_batch_and_edge_requests(self):
+        native = hashing._native
+        assert native.batch_prefix_hashes_many([]) == []
+        many = native.batch_prefix_hashes_many([
+            (0, [], 4, None),                 # no tokens
+            (1, CBOR_EDGES[:3], 4, None),     # under one block
+            (5, CBOR_EDGES, 1, [2**64 - 1]),  # every CBOR width, max extra
+        ])
+        assert [list(m) for m in many] == [
+            [],
+            [],
+            _python_chunked(5, CBOR_EDGES, 1, [2**64 - 1], "fnv64_cbor"),
+        ]
+
+    def test_rejects_what_per_request_rejects(self):
+        native = hashing._native
+        with pytest.raises(TypeError):
+            native.batch_prefix_hashes_many([(0, [1.5], 1, None)])
+        with pytest.raises((OverflowError, ValueError)):
+            native.batch_prefix_hashes_many([(0, [-1], 1, None)])
+        with pytest.raises(ValueError):
+            native.batch_prefix_hashes_many([(0, [1], 0, None)])
+        # A bad item anywhere in the batch fails the whole call (no
+        # partial results to mistake for success).
+        with pytest.raises(TypeError):
+            native.batch_prefix_hashes_many(
+                [(0, [1, 2], 2, None), (0, object(), 2, None)]
+            )
+
+
+class TestFastManyWrapper:
+    """prefix_hashes_fast_many ≡ per-task prefix_hashes_fast under BOTH
+    algorithms, mixed in one batch (the sha256 tasks force the wrapper's
+    per-task fallback while fnv tasks may ride the C fast lane)."""
+
+    def test_mixed_algo_batch_matches_per_task(self):
+        rng = random.Random(31337)
+        for _ in range(10):
+            tasks = []
+            for _ in range(rng.randrange(1, 9)):
+                bs = rng.choice(BLOCK_SIZES)
+                tasks.append((
+                    rng.randrange(2**64),
+                    _random_stream(rng, rng.randrange(0, 5 * bs + 3)),
+                    bs,
+                    rng.choice(EXTRA_SHAPES),
+                    rng.choice(ALGOS),
+                ))
+            want = [
+                hashing.prefix_hashes_fast(p, t, bs, e, algo=a)
+                for p, t, bs, e, a in tasks
+            ]
+            assert hashing.prefix_hashes_fast_many(tasks) == want
+
+    def test_empty(self):
+        assert hashing.prefix_hashes_fast_many([]) == []

@@ -1,0 +1,688 @@
+"""Batched read path (`Indexer.score_many`) — bit-identity and degradation.
+
+The tentpole invariant: `score_many(requests)` is BIT-IDENTICAL to
+`[get_pod_scores_ex(r) for r in requests]` over the same state — same
+scores (float-for-float), same matched-prefix lengths, same block-hash
+chains. Pinned here across:
+
+- all four index backends (in-memory, sharded, cost-aware, redis/fake),
+- LoRA keyspaces (base + two adapters + invalid-id degradation),
+- fleet-health states (healthy / suspect / stale),
+- the cluster scatter-gather front (N=2 replicas, one fan-out per batch),
+- pod-filtered and unfiltered requests, duplicates, and shared prefixes.
+
+Plus the per-item overload contract (one shed item degrades to an empty
+`PodScores`, never the batch), the streaming gRPC bulk round trip, and the
+`lookup_many`/`score_many_ex` building blocks on randomized state.
+"""
+
+import random
+import socket
+import threading
+import time
+from dataclasses import dataclass
+from typing import List
+
+import pytest
+
+from tests.conftest import TEST_MODEL_NAME, TEST_TOKENIZER_JSON
+from llm_d_kv_cache_manager_tpu.cluster import (
+    ClusterScorer,
+    LocalReplicaTransport,
+)
+from llm_d_kv_cache_manager_tpu.fleethealth import (
+    FleetHealthConfig,
+    FleetHealthTracker,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import (
+    Indexer,
+    IndexerConfig,
+    PodScores,
+    ScoreRequest,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.cost_aware import (
+    CostAwareIndexConfig,
+    CostAwareMemoryIndex,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+    InMemoryIndex,
+    InMemoryIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.sharded import (
+    ShardedIndex,
+    ShardedIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.scorer import (
+    KVBlockScorerConfig,
+    new_kv_block_scorer,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPool,
+    TokenizersPoolConfig,
+)
+
+BLOCK_SIZE = 4
+PODS = ["pod-0", "pod-1", "pod-2", "pod-3"]
+WORDS = (
+    "alpha bravo charlie delta echo foxtrot golf hotel india juliet "
+    "kilo lima mike november oscar papa quebec romeo sierra tango"
+).split()
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _backend_factories(fake_redis_url=None):
+    factories = {
+        "in_memory": lambda: InMemoryIndex(
+            InMemoryIndexConfig(size=4096, pod_cache_size=10)
+        ),
+        "sharded": lambda: ShardedIndex(
+            ShardedIndexConfig(size=4096, num_shards=8)
+        ),
+        "cost_aware": lambda: CostAwareMemoryIndex(
+            CostAwareIndexConfig(max_size_bytes="64MiB")
+        ),
+    }
+    if fake_redis_url is not None:
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import (
+            RedisIndex,
+            RedisIndexConfig,
+        )
+
+        factories["redis"] = lambda: RedisIndex(
+            RedisIndexConfig(url=fake_redis_url)
+        )
+    return factories
+
+
+@pytest.fixture(scope="module")
+def fake_redis():
+    from tests.fake_redis import FakeRedisServer
+
+    server = FakeRedisServer()
+    yield server
+    server.close()
+
+
+def _make_indexer(kv_block_index=None, fleet_health=None):
+    indexer = Indexer(
+        config=IndexerConfig(
+            token_processor_config=TokenProcessorConfig(block_size=BLOCK_SIZE),
+        ),
+        tokenization_pool=TokenizationPool(
+            TokenizersPoolConfig(
+                workers=2,
+                local_tokenizer_files={TEST_MODEL_NAME: TEST_TOKENIZER_JSON},
+            ),
+        ),
+        kv_block_index=kv_block_index,
+        fleet_health=fleet_health,
+    )
+    indexer.run()
+    return indexer
+
+
+def _text(rng, n):
+    return " ".join(rng.choice(WORDS) for _ in range(n))
+
+
+def _warm_tokenization(indexer, prompts):
+    """Drive every prompt through the pool until its token list is stable.
+
+    The prefix store's cold→warm transition changes the TOKENS themselves
+    (cold = full tokenization; warm = covered-chunk tokens, partial tail
+    chunk dropped — seed semantics, reference parity), so the only state
+    under which `score_many` ≡ sequential singles is checkable is the
+    warm fixed point. One cold pass learns the chunks; the second pass
+    confirms the fixed point was reached."""
+    for _ in range(2):
+        for p in prompts:
+            indexer.tokenizers_pool.tokenize_ex(None, p, TEST_MODEL_NAME)
+
+
+def _populate(indexer, rng, prompts, loras=(None,)):
+    """Each prompt's full chain lands on a random subset of PODS, each pod
+    holding a random prefix depth, under each of `loras` keyspaces."""
+    seq = 0
+    for prompt in prompts:
+        enc = indexer.tokenizers_pool.tokenizer.encode(prompt, TEST_MODEL_NAME)
+        for lora in loras:
+            keys = indexer.token_processor.tokens_to_kv_block_keys(
+                None, enc.tokens, TEST_MODEL_NAME, lora_id=lora
+            )
+            if not keys:
+                continue
+            engine_keys = [
+                Key(TEST_MODEL_NAME, 1_000_000 + seq * 1000 + i)
+                for i in range(len(keys))
+            ]
+            seq += 1
+            for pod in rng.sample(PODS, rng.randint(1, 3)):
+                depth = rng.randint(1, len(keys))
+                entry = PodEntry(pod, rng.choice(("hbm", "host")))
+                indexer.kv_block_index.add(
+                    engine_keys[:depth], keys[:depth], [entry]
+                )
+
+
+def _batch(rng, prompts):
+    """A router-shaped batch: shared prefixes, duplicates, filters, LoRA
+    scopes, an invalid adapter id, and a no-full-block prompt."""
+    reqs = [
+        ScoreRequest(prompt=p, model_name=TEST_MODEL_NAME) for p in prompts
+    ]
+    reqs.append(ScoreRequest(prompt=prompts[0], model_name=TEST_MODEL_NAME))
+    reqs.append(ScoreRequest(
+        prompt=prompts[0], model_name=TEST_MODEL_NAME,
+        pod_identifiers=["pod-0", "pod-2"],
+    ))
+    reqs.append(ScoreRequest(
+        prompt=prompts[1], model_name=TEST_MODEL_NAME, lora_id=1,
+    ))
+    reqs.append(ScoreRequest(
+        prompt=prompts[1], model_name=TEST_MODEL_NAME, lora_id=2,
+    ))
+    reqs.append(ScoreRequest(
+        prompt=prompts[2], model_name=TEST_MODEL_NAME, lora_id=-5,
+    ))  # invalid adapter id degrades to the base keyspace
+    reqs.append(ScoreRequest(prompt="x", model_name=TEST_MODEL_NAME))
+    rng.shuffle(reqs)
+    return reqs
+
+
+def _assert_identical(batch_results, single_results):
+    assert len(batch_results) == len(single_results)
+    for i, (b, s) in enumerate(zip(batch_results, single_results)):
+        assert b.scores == s.scores, f"item {i}: {b.scores} != {s.scores}"
+        assert b.match_blocks == s.match_blocks, f"item {i}"
+        assert b.block_hashes == s.block_hashes, f"item {i}"
+
+
+class TestBitIdentity:
+    @pytest.mark.parametrize(
+        "backend", ["in_memory", "sharded", "cost_aware", "redis"]
+    )
+    def test_score_many_equals_single_calls(self, backend, fake_redis):
+        rng = random.Random(42)
+        factory = _backend_factories(fake_redis.url)[backend]
+        indexer = _make_indexer(kv_block_index=factory())
+        try:
+            shared = _text(rng, 30)
+            prompts = [
+                shared + " " + _text(rng, 8),
+                shared + " " + _text(rng, 12),
+                _text(rng, 40),
+            ]
+            _populate(indexer, rng, prompts, loras=(None, 1, 2))
+            _warm_tokenization(indexer, prompts)
+            reqs = _batch(rng, prompts)
+            batch = indexer.score_many(reqs)
+            singles = [
+                indexer.get_pod_scores_ex(
+                    r.prompt, r.model_name, r.pod_identifiers,
+                    lora_id=r.lora_id,
+                )
+                for r in reqs
+            ]
+            _assert_identical(batch, singles)
+            # And again fully warm, in the other order.
+            _assert_identical(indexer.score_many(reqs), singles)
+        finally:
+            indexer.shutdown()
+
+    def test_randomized_property(self, fake_redis):
+        """Randomized batches across every backend: shared/disjoint mixes,
+        random filters and adapters, random batch sizes."""
+        for backend, factory in _backend_factories(fake_redis.url).items():
+            rng = random.Random(hash(backend) & 0xFFFF)
+            indexer = _make_indexer(kv_block_index=factory())
+            try:
+                shared = _text(rng, 25)
+                pool = [
+                    shared + " " + _text(rng, rng.randint(3, 15))
+                    for _ in range(4)
+                ] + [_text(rng, rng.randint(10, 30)) for _ in range(3)]
+                _populate(indexer, rng, pool, loras=(None, 1))
+                _warm_tokenization(indexer, pool)
+                for _ in range(5):
+                    reqs = []
+                    for _ in range(rng.randint(1, 12)):
+                        reqs.append(ScoreRequest(
+                            prompt=rng.choice(pool),
+                            model_name=TEST_MODEL_NAME,
+                            pod_identifiers=rng.choice(
+                                ([], [], PODS[:2], ["pod-3"], ["nope"])
+                            ),
+                            lora_id=rng.choice((None, None, 1, 2)),
+                        ))
+                    singles = [
+                        indexer.get_pod_scores_ex(
+                            r.prompt, r.model_name, r.pod_identifiers,
+                            lora_id=r.lora_id,
+                        )
+                        for r in reqs
+                    ]
+                    _assert_identical(indexer.score_many(reqs), singles)
+            finally:
+                indexer.shutdown()
+
+    def test_fleet_health_states(self):
+        """healthy / suspect / stale pods filter identically in batch and
+        single-call mode (same filter_scores, same demotion floats)."""
+        clock = Clock()
+        tracker = FleetHealthTracker(
+            FleetHealthConfig(suspect_after_s=10.0, stale_after_s=30.0),
+            clock=clock,
+        )
+        rng = random.Random(7)
+        indexer = _make_indexer(fleet_health=tracker)
+        try:
+            prompts = [_text(rng, 20), _text(rng, 25)]
+            _populate(indexer, rng, prompts)
+            _warm_tokenization(indexer, prompts)
+            # pod-0 fresh (healthy), pod-1 quiet 15s (suspect), pod-2
+            # quiet 35s (stale, excluded), pod-3 never seen (healthy).
+            # Liveness is stamped from the tracker's clock at observe time.
+            clock.t = 0.0
+            tracker.observe_batch("pod-2", "kv@pod-2@m", 0, ts=0.0)
+            clock.t = 20.0
+            tracker.observe_batch("pod-1", "kv@pod-1@m", 0, ts=20.0)
+            clock.t = 34.0
+            tracker.observe_batch("pod-0", "kv@pod-0@m", 0, ts=34.0)
+            clock.t = 35.0
+            reqs = [
+                ScoreRequest(prompt=p, model_name=TEST_MODEL_NAME)
+                for p in prompts
+            ] * 2
+            # Settle the one-shot state transition first: the first scored
+            # request DETECTS pod-2 as stale and purges its index entries
+            # (a deliberate mutation). Bit-identity is a statement about a
+            # settled fleet-health state, not about who triggers the purge.
+            for p in prompts:
+                indexer.get_pod_scores_ex(p, TEST_MODEL_NAME, [])
+            singles = [
+                indexer.get_pod_scores_ex(
+                    r.prompt, r.model_name, r.pod_identifiers,
+                    lora_id=r.lora_id,
+                )
+                for r in reqs
+            ]
+            _assert_identical(indexer.score_many(reqs), singles)
+            states = {
+                tracker.state_of(p) for p in ("pod-1", "pod-2")
+            }
+            assert states == {"suspect", "stale"}  # scenario actually bites
+        finally:
+            indexer.shutdown()
+
+    def test_cluster_two_replica_scatter_gather(self):
+        """ClusterScorer.score_many (one fan-out per batch) ≡ per-request
+        scatter-gather ≡ what the ownership merge promises."""
+        rng = random.Random(11)
+        a, b = _make_indexer(), _make_indexer()
+        try:
+            shared = _text(rng, 20)
+            prompts = [shared + " " + _text(rng, 6), _text(rng, 18)]
+            for ix in (a, b):
+                _populate(ix, random.Random(11), prompts)
+                _warm_tokenization(ix, prompts)
+            scorer = ClusterScorer(
+                [LocalReplicaTransport(a), LocalReplicaTransport(b)]
+            )
+            try:
+                reqs = [
+                    ScoreRequest(prompt=p, model_name=TEST_MODEL_NAME)
+                    for p in prompts + [prompts[0]]
+                ]
+                batch = scorer.score_many(reqs)
+                singles = [
+                    scorer.get_pod_scores_ex(
+                        r.prompt, r.model_name, r.pod_identifiers,
+                        lora_id=r.lora_id,
+                    )
+                    for r in reqs
+                ]
+                _assert_identical(batch, singles)
+            finally:
+                scorer.close()
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+    def test_cluster_dead_replica_degrades_batch(self):
+        """A dead replica's partition carries no signal for ANY item; the
+        live replica's partition still answers every item."""
+
+        class _DeadTransport:
+            def score_many(self, requests):
+                raise RuntimeError("replica down")
+
+            def get_pod_scores_ex(self, *a, **k):
+                raise RuntimeError("replica down")
+
+        rng = random.Random(13)
+        a = _make_indexer()
+        try:
+            prompts = [_text(rng, 20)]
+            _populate(a, rng, prompts)
+            scorer = ClusterScorer(
+                [LocalReplicaTransport(a), _DeadTransport()]
+            )
+            try:
+                part = scorer.partitioner
+                batch = scorer.score_many([
+                    ScoreRequest(prompt=prompts[0], model_name=TEST_MODEL_NAME)
+                ] * 2)
+                for ps in batch:
+                    assert all(
+                        part.replica_for(p) == 0 for p in ps.scores
+                    ), "dead replica's pods must carry no signal"
+            finally:
+                scorer.close()
+        finally:
+            a.shutdown()
+
+
+class TestLookupManyContract:
+    """`Index.lookup_many` ≡ N sequential `lookup` calls, per backend."""
+
+    def test_lookup_many_matches_lookup(self, fake_redis):
+        processor = ChunkedTokenDatabase(
+            TokenProcessorConfig(block_size=BLOCK_SIZE, chain_memo=False)
+        )
+        for backend, factory in _backend_factories(fake_redis.url).items():
+            rng = random.Random(len(backend))
+            index = factory()
+            chains = []
+            for c in range(6):
+                tokens = [
+                    rng.randrange(1, 30_000)
+                    for _ in range(BLOCK_SIZE * rng.randint(2, 8))
+                ]
+                keys = processor.tokens_to_kv_block_keys(
+                    None, tokens, TEST_MODEL_NAME
+                )
+                engine_keys = [
+                    Key(TEST_MODEL_NAME, 500_000 + c * 100 + i)
+                    for i in range(len(keys))
+                ]
+                for pod in rng.sample(PODS, rng.randint(1, 3)):
+                    depth = rng.randint(1, len(keys))
+                    index.add(
+                        engine_keys[:depth], keys[:depth],
+                        [PodEntry(pod, rng.choice(("hbm", "host")))],
+                    )
+                chains.append(keys)
+            for _ in range(10):
+                requests = []
+                for _ in range(rng.randint(1, 6)):
+                    chain = rng.choice(chains)
+                    # Sometimes probe a gapped chain (skip the head).
+                    keys = chain if rng.random() < 0.7 else chain[1:] + chain[:1]
+                    pods = rng.choice(
+                        ([], set(), {"pod-0"}, {"pod-1", "pod-2"}, {"nope"})
+                    )
+                    requests.append((keys, set(pods)))
+                want = [index.lookup(k, s) for k, s in requests]
+                got = index.lookup_many(requests)
+                # Entry CONTENT and order must match; the batch path may
+                # hand back immutable tuples where `lookup` copies lists.
+                norm = lambda ds: [  # noqa: E731
+                    {k: list(v) for k, v in d.items()} for d in ds
+                ]
+                assert norm(got) == norm(want), backend
+
+    def test_empty_batch_and_empty_keys(self):
+        index = ShardedIndex(ShardedIndexConfig(size=64))
+        assert index.lookup_many([]) == []
+        with pytest.raises(ValueError):
+            index.lookup_many([([], set())])
+
+
+class TestScorerBatch:
+    def test_score_many_ex_matches_score_ex(self):
+        rng = random.Random(3)
+        scorer = new_kv_block_scorer(KVBlockScorerConfig())
+        for _ in range(30):
+            n_keys = rng.randint(1, 20)
+            keys = [Key("m", rng.randrange(2**32)) for _ in range(n_keys)]
+            key_to_pods = {}
+            for k in keys[: rng.randint(0, n_keys)]:
+                key_to_pods[k] = [
+                    PodEntry(rng.choice(PODS), rng.choice(("hbm", "host")))
+                    for _ in range(rng.randint(1, 4))
+                ]
+            items = [(keys, key_to_pods), (keys[: max(1, n_keys // 2)], key_to_pods)]
+            want = [scorer.score_ex(k, m) for k, m in items]
+            assert scorer.score_many_ex(items) == want
+
+    def test_shared_entry_lists_share_weight_maps(self):
+        """Items sharing an entry-list OBJECT must still score exactly like
+        independent calls (the id-keyed cache is invisible in results)."""
+        scorer = new_kv_block_scorer(KVBlockScorerConfig())
+        keys = [Key("m", i) for i in range(4)]
+        shared_entries = [PodEntry("pod-0", "hbm"), PodEntry("pod-1", "host")]
+        hits = {k: shared_entries for k in keys}
+        items = [(keys, hits)] * 3
+        results = scorer.score_many_ex(items)
+        want = scorer.score_ex(keys, hits)
+        for got in results:
+            assert got == want
+        # The mutated per-item scores dicts must be independent objects.
+        assert results[0][0] is not results[1][0]
+
+
+class _GatedTokenizer:
+    """Deterministic overload rig: blocks on `gate` for prompts starting
+    with "slow"; everything else tokenizes instantly."""
+
+    def __init__(self, gate):
+        self.gate = gate
+
+    def encode(self, prompt: str, model_name: str):
+        from llm_d_kv_cache_manager_tpu.tokenization.tokenizer import (
+            TokenizationResult,
+        )
+
+        if prompt.startswith("slow"):
+            self.gate.wait(timeout=10.0)
+        return TokenizationResult(
+            tokens=[(ord(c) % 97) + 1 for c in prompt][:16] or [1],
+            offsets=[],
+        )
+
+    def render_chat_template(self, request) -> str:
+        raise NotImplementedError
+
+
+class TestPerItemOverloadDegradation:
+    def test_one_shed_item_never_degrades_the_batch(self):
+        gate = threading.Event()
+        pool = TokenizationPool(
+            TokenizersPoolConfig(
+                workers=1, max_queue_depth=1, enqueue_timeout_s=0.05,
+            ),
+            tokenizer=_GatedTokenizer(gate),
+        )
+        indexer = Indexer(
+            config=IndexerConfig(
+                token_processor_config=TokenProcessorConfig(
+                    block_size=BLOCK_SIZE
+                ),
+            ),
+            tokenization_pool=pool,
+        )
+        indexer.run()
+        try:
+            # Park the single worker on a gated prompt so the queue (depth
+            # 1) fills deterministically.
+            pool.enqueue_tokenization(None, "slow warm-up", TEST_MODEL_NAME)
+            deadline = time.time() + 5.0
+            while not pool._queue.empty() and time.time() < deadline:
+                time.sleep(0.005)
+            assert pool._queue.empty(), "worker never picked up the gate task"
+
+            fast = "abcdefgh"  # 8 tokens -> 2 full blocks
+            keys = indexer.token_processor.tokens_to_kv_block_keys(
+                None, _GatedTokenizer(gate).encode(fast, TEST_MODEL_NAME).tokens,
+                TEST_MODEL_NAME,
+            )
+            engine_keys = [
+                Key(TEST_MODEL_NAME, 77_000 + i) for i in range(len(keys))
+            ]
+            indexer.kv_block_index.add(
+                engine_keys, keys, [PodEntry("pod-x", "hbm")]
+            )
+
+            reqs = [
+                ScoreRequest(prompt=fast, model_name=TEST_MODEL_NAME),  # queued
+                ScoreRequest(prompt=fast, model_name=TEST_MODEL_NAME),  # shed
+                ScoreRequest(prompt=fast, model_name=TEST_MODEL_NAME),  # shed
+            ]
+            rejected_before = pool.rejected_tasks
+            timer = threading.Timer(0.5, gate.set)
+            timer.start()
+            try:
+                results = indexer.score_many(reqs)
+            finally:
+                timer.cancel()
+                gate.set()
+            assert len(results) == 3
+            assert all(isinstance(r, PodScores) for r in results)
+            assert pool.rejected_tasks - rejected_before == 2
+            # Exactly the first item (which got the queue slot) scored.
+            assert results[0].scores == {"pod-x": float(len(keys))}
+            assert results[1].scores == {} and results[1].block_hashes == []
+            assert results[2].scores == {} and results[2].block_hashes == []
+        finally:
+            indexer.shutdown()
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestGrpcBulkStream:
+    def test_streaming_round_trip_matches_score_many(self):
+        from llm_d_kv_cache_manager_tpu.api.grpc_server import (
+            IndexerGrpcClient,
+            serve_grpc,
+        )
+
+        rng = random.Random(5)
+        indexer = _make_indexer()
+        try:
+            shared = _text(rng, 20)
+            prompts = [shared + " " + _text(rng, 5), _text(rng, 15)]
+            _populate(indexer, rng, prompts, loras=(None, 3))
+            _warm_tokenization(indexer, prompts)
+            port = _free_port()
+            server = serve_grpc(
+                indexer, f"127.0.0.1:{port}", bulk_max_batch=2,
+            )
+            try:
+                client = IndexerGrpcClient(f"127.0.0.1:{port}")
+                requests = [
+                    {"prompt": prompts[0], "model_name": TEST_MODEL_NAME},
+                    {"prompt": prompts[1], "model_name": TEST_MODEL_NAME,
+                     "lora_id": 3},
+                    {"prompt": prompts[0], "model_name": TEST_MODEL_NAME,
+                     "pod_identifiers": ["pod-0"]},
+                    {"prompt": prompts[1], "model_name": TEST_MODEL_NAME},
+                ]
+                payloads = client.score_pods_bulk(requests)
+                assert [p["index"] for p in payloads] == [0, 1, 2, 3]
+                direct = indexer.score_many([
+                    ScoreRequest(
+                        prompt=r["prompt"], model_name=r["model_name"],
+                        pod_identifiers=r.get("pod_identifiers", ()),
+                        lora_id=r.get("lora_id"),
+                    )
+                    for r in requests
+                ])
+                for p, want in zip(payloads, direct):
+                    assert p["scores"] == want.scores
+                    assert {
+                        k: int(v) for k, v in p["match_blocks"].items()
+                    } == want.match_blocks
+                    assert [int(h) for h in p["block_hashes"]] == (
+                        want.block_hashes
+                    )
+                client.close()
+            finally:
+                server.stop(grace=0)
+        finally:
+            indexer.shutdown()
+
+
+class TestHttpBatch:
+    def test_batch_endpoint_matches_single_endpoint(self):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from llm_d_kv_cache_manager_tpu.api.http_service import (
+            ScoringService,
+            config_from_env,
+        )
+
+        indexer = _make_indexer()
+        rng = random.Random(9)
+        prompts = [_text(rng, 15), _text(rng, 20)]
+        _populate(indexer, rng, prompts)
+        _warm_tokenization(indexer, prompts)
+        env = config_from_env()
+        env["score_batch_max"] = 8
+        service = ScoringService(env=env, indexer=indexer)
+        service.start(with_subscriber=False)
+
+        async def run():
+            client = TestClient(TestServer(service.make_app()))
+            await client.start_server()
+            try:
+                singles = []
+                for p in prompts:
+                    resp = await client.post(
+                        "/score_completions",
+                        json={"prompt": p, "model": TEST_MODEL_NAME},
+                    )
+                    assert resp.status == 200
+                    singles.append((await resp.json())["podScores"])
+                resp = await client.post(
+                    "/score_completions/batch",
+                    json={"requests": [
+                        {"prompt": p, "model": TEST_MODEL_NAME}
+                        for p in prompts
+                    ]},
+                )
+                assert resp.status == 200
+                body = await resp.json()
+                assert [r["podScores"] for r in body["results"]] == singles
+                # Oversized batches are refused, not truncated.
+                resp = await client.post(
+                    "/score_completions/batch",
+                    json={"requests": [
+                        {"prompt": "p", "model": TEST_MODEL_NAME}
+                    ] * 9},
+                )
+                assert resp.status == 400
+            finally:
+                await client.close()
+
+        try:
+            asyncio.run(run())
+        finally:
+            service.stop()
