@@ -4,7 +4,8 @@
 
 .PHONY: native kvtransfer test bench bench-micro bench-read bench-obs \
 	bench-batch bench-faults bench-replication bench-placement \
-	bench-transfer clean proto lint precommit-install image-build image-push
+	bench-autoscale bench-transfer clean proto lint precommit-install \
+	image-build image-push
 
 # Container image coordinates (override per environment/registry). The
 # release workflow (.github/workflows/ci-release.yaml) builds the same
@@ -100,6 +101,14 @@ bench-replication:
 # Headless; rewrites benchmarking/FLEET_BENCH_PLACEMENT.json.
 bench-placement: kvtransfer
 	JAX_PLATFORMS=cpu python bench.py --placement
+
+# Saturation-resilience scenario (kvcache/routing.py + cluster/membership.py):
+# the qps ladder's collapse row under load-aware routing + elastic membership
+# (pods join warm-before-serve / leave drained mid-run) plus the live
+# partition-reassignment audit. Headless; rewrites
+# benchmarking/FLEET_BENCH_AUTOSCALE.json.
+bench-autoscale: kvtransfer
+	JAX_PLATFORMS=cpu python bench.py --autoscale
 
 # Transfer-plane legs (CI-smoke sizes, printed only): async-offload
 # dispatch vs sync stage, batched-vs-serial multi-block DCN fetch, inflight

@@ -134,7 +134,7 @@ class DeviceFleet:
 
     def __init__(self, strategy: str, n_pods: int, model_config, n_pages: int,
                  decode_steps: int, use_kernel: bool,
-                 max_pages_per_seq: int = 256):
+                 max_pages_per_seq: int = 256, cluster_replicas: int = 0):
         from llm_d_kv_cache_manager_tpu.engine.engine import (
             EnginePod,
             EnginePodConfig,
@@ -168,6 +168,53 @@ class DeviceFleet:
             self.indexer.token_processor,
         )
         self.event_pool.start(with_subscriber=False)
+
+        # Replicated read path (--cluster-replicas; cluster/): the precise
+        # arm scores through a ClusterScorer scatter-gather over N
+        # partition-gated replicas — the same wiring bench.py's check uses,
+        # now over the DEVICE fleet's real event streams. Bit-identical to
+        # the monolithic indexer on full answers (pinned at N=1 and above
+        # by --cluster-replicas' routing/hit equivalence check).
+        self.cluster_scorer = None
+        self.replica_pools = []
+        self._replica_indexers = []
+        self.route_choices = []
+        if cluster_replicas > 0:
+            from llm_d_kv_cache_manager_tpu.cluster import (
+                ClusterConfig,
+                ClusterScorer,
+                LocalReplicaTransport,
+                ReplicaPartitioner,
+            )
+
+            transports = []
+            for rid in range(cluster_replicas):
+                part = ReplicaPartitioner(cluster_replicas, replica_id=rid)
+                ridx = Indexer(
+                    config=IndexerConfig(
+                        token_processor_config=TokenProcessorConfig(
+                            block_size=PAGE_SIZE
+                        ),
+                    ),
+                    tokenization_pool=self.indexer.tokenizers_pool,
+                )
+                rpool = EventPool(
+                    EventPoolConfig(concurrency=2),
+                    ridx.kv_block_index,
+                    ridx.token_processor,
+                    message_filter=(
+                        part.accepts if cluster_replicas > 1 else None
+                    ),
+                )
+                rpool.start(with_subscriber=False)
+                self._replica_indexers.append(ridx)
+                self.replica_pools.append(rpool)
+                transports.append(LocalReplicaTransport(ridx))
+            self.cluster_scorer = ClusterScorer(
+                transports,
+                partitioner=ReplicaPartitioner(cluster_replicas),
+                config=ClusterConfig(num_replicas=cluster_replicas),
+            )
 
         # One weight init shared across pods: a fleet serves ONE model.
         import jax
@@ -209,15 +256,18 @@ class DeviceFleet:
 
     def _sink_for(self, pod_id: str):
         def sink(batch):
-            self.event_pool.add_task(
-                self._message(
-                    topic=f"kv@{pod_id}@{MODEL}",
-                    payload=batch.to_msgpack(),
-                    seq=0,
-                    pod_identifier=pod_id,
-                    model_name=MODEL,
-                )
+            msg = self._message(
+                topic=f"kv@{pod_id}@{MODEL}",
+                payload=batch.to_msgpack(),
+                seq=0,
+                pod_identifier=pod_id,
+                model_name=MODEL,
             )
+            self.event_pool.add_task(msg)
+            for rpool in self.replica_pools:
+                # Every replica is offered every message; the partition
+                # ownership gate (message_filter) keeps exactly one.
+                rpool.add_task(msg)
 
         return sink
 
@@ -231,7 +281,10 @@ class DeviceFleet:
             # Fail loud: an unknown strategy silently measuring the precise
             # scorer under another label would corrupt the comparison.
             raise ValueError(f"unknown routing strategy: {self.strategy!r}")
-        scores = self.indexer.get_pod_scores(prompt, MODEL, [])
+        if self.cluster_scorer is not None:
+            scores = self.cluster_scorer.get_pod_scores(prompt, MODEL, [])
+        else:
+            scores = self.indexer.get_pod_scores(prompt, MODEL, [])
         if not scores:
             self.rr += 1
             return (self.rr - 1) % len(self.pods)
@@ -266,10 +319,17 @@ class DeviceFleet:
         # zero-hit, zero-output serve rather than crashing the whole run.
         self.hit_tokens += req.num_cached_tokens if req else 0
         self.event_pool.drain()
+        for rpool in self.replica_pools:
+            rpool.drain()
         n_gen = len(req.generated) if req else 0
+        self.route_choices.append(pod_idx)
         return ttft if ttft is not None else total, total, n_gen, pod_idx
 
     def close(self):
+        if self.cluster_scorer is not None:
+            self.cluster_scorer.close()
+        for rpool in self.replica_pools:
+            rpool.shutdown()
         self.event_pool.shutdown()
         self.indexer.shutdown()
         for pod in self.pods:
@@ -332,7 +392,8 @@ def _pctl(xs, q):
 
 def run_fleet(strategy, model_config, workload, n_pods, n_pages,
               decode_steps, max_new, use_kernel, max_pages_per_seq=256,
-              limit=None, qps=None, trace=None):
+              limit=None, qps=None, trace=None, cluster_replicas=0,
+              collect_routes=False):
     """`limit` truncates the request stream — the warmup passes use it:
     XLA programs are keyed by power-of-2 shape buckets (prefill chunk
     length, table width, batch), and the bucket set saturates within the
@@ -367,7 +428,8 @@ def run_fleet(strategy, model_config, workload, n_pods, n_pages,
     conversations = dict(conversations)  # fresh copy per strategy
     fleet = DeviceFleet(strategy, n_pods, model_config, n_pages,
                         decode_steps, use_kernel,
-                        max_pages_per_seq=max_pages_per_seq)
+                        max_pages_per_seq=max_pages_per_seq,
+                        cluster_replicas=cluster_replicas)
     ttfts, totals, toks = [], [], 0
     compute_ttfts, waits = [], []
     free_at = [0.0] * n_pods
@@ -390,6 +452,9 @@ def run_fleet(strategy, model_config, workload, n_pods, n_pages,
             toks += n_gen
             conversations[cid] = prompt + " [assistant] " + _text(rng, q_words)
         hit_rate = fleet.hit_tokens / max(fleet.total_tokens, 1)
+        # getattr: test doubles for DeviceFleet predate route_choices.
+        routes = list(getattr(fleet, "route_choices", ()))
+        hit_tokens = fleet.hit_tokens
     finally:
         fleet.close()
     out = {
@@ -409,6 +474,12 @@ def run_fleet(strategy, model_config, workload, n_pods, n_pages,
             "service_mean_s": round(statistics.mean(totals), 4),
             "ttft_compute_p50_s": round(_pctl(compute_ttfts, 0.5), 4),
         })
+    if collect_routes:
+        # Equivalence-check plumbing only (--cluster-replicas): exact
+        # per-request routing decisions + raw hit tokens — never written
+        # into the committed artifact.
+        out["route_choices"] = routes
+        out["hit_tokens"] = hit_tokens
     return out
 
 
@@ -689,6 +760,15 @@ def main():
              "A/B) and merge the transfer_plane section into the existing "
              "FLEET_DEVICE_BENCH.json (with --quick: print only)",
     )
+    ap.add_argument(
+        "--cluster-replicas", type=int, default=0, metavar="N",
+        help="route the precise arm through a ClusterScorer scatter-gather "
+             "over N partition-gated replicas fed by the DEVICE fleet's "
+             "real event streams, and verify routing decisions + hit "
+             "tokens are bit-identical to the monolithic indexer (exact "
+             "at N=1 and on full answers at any N); prints the verdict, "
+             "writes no artifact",
+    )
     args = ap.parse_args()
 
     if os.environ.get("JAX_PLATFORMS"):
@@ -757,6 +837,38 @@ def main():
             fm["groups"], fm["users"], fm["turns"],
             sys_words=fm["sys_words"], q_words=fm["q_words"],
         )
+
+    if args.cluster_replicas > 0:
+        # Replicated-read-path pin (bench.py --cluster-replicas, on the
+        # device fleet): the precise arm's per-request routing decisions
+        # and raw hit-token count must be identical monolithic vs
+        # scatter-gathered — wall-clock timing is NOT compared (device
+        # timing is not bit-stable; routing and hits are).
+        mono = run_fleet("precise", cfg, workload, n_pods, n_pages,
+                         decode_steps, max_new, on_tpu,
+                         max_pages_per_seq=mpps, collect_routes=True)
+        clu = run_fleet("precise", cfg, workload, n_pods, n_pages,
+                        decode_steps, max_new, on_tpu,
+                        max_pages_per_seq=mpps,
+                        cluster_replicas=args.cluster_replicas,
+                        collect_routes=True)
+        identical = (
+            mono["route_choices"] == clu["route_choices"]
+            and mono["hit_tokens"] == clu["hit_tokens"]
+        )
+        print(json.dumps({
+            "metric": "device_cluster_precise_bit_identical",
+            "value": bool(identical),
+            "replicas": args.cluster_replicas,
+            "requests": mono["requests"],
+            "hit_tokens_monolithic": mono["hit_tokens"],
+            "hit_tokens_cluster": clu["hit_tokens"],
+            "prefix_hit_rate_monolithic": mono["prefix_hit_rate"],
+            "prefix_hit_rate_cluster": clu["prefix_hit_rate"],
+        }))
+        if not identical:
+            sys.exit(1)
+        return
 
     trace = None
     if args.workload == "sharegpt":

@@ -325,6 +325,76 @@ def fleet_replication_section() -> str:
     ])
 
 
+def fleet_autoscale_section() -> str:
+    """Saturation-resilience scenario (bench.py --autoscale: load-aware
+    routing policy + elastic fleet membership): what the control loop
+    buys at the qps ladder's collapse point."""
+    path = os.path.join(HERE, "FLEET_BENCH_AUTOSCALE.json")
+    if not os.path.exists(path):
+        raise SystemExit(
+            "benchmarking/FLEET_BENCH_AUTOSCALE.json missing — run "
+            "`python bench.py --autoscale`"
+        )
+    stats = _load(path)
+    cfg = stats["config"]
+    arms = stats["arms"]
+    rows = []
+    for name, label in (
+        ("unsaturated_baseline", "unsaturated baseline (qps 20)"),
+        ("precise_saturated", "precise only, saturated (qps 40)"),
+        ("load_blend", "+ load-blend policy"),
+        ("precise_autoscale", "+ scale-out (no policy)"),
+        ("load_blend_autoscale", "**+ policy + scale-out**"),
+    ):
+        a = arms[name]
+        rows.append(
+            f"| {label} | {a['ttft_p50_s']} | {a['ttft_p90_s']} "
+            f"| {a['prefix_hit_rate']:.1%} | {a.get('preemptions', '—')} |"
+        )
+    auto = arms["load_blend_autoscale"]
+    warm = auto.get("warm", {})
+    re = stats["reassignment"]
+    targets = stats["targets"]
+    return "\n".join([
+        f"Capacity-regime replay at qps {cfg['qps_saturated']:g} — the "
+        "committed qps ladder's collapse row (page pressure drives a "
+        "recompute-preemption cascade; the no-treatment arm below "
+        "reproduces the committed row bit-for-bit). Treatments: the "
+        "load-aware routing policy (`kvcache/routing.py`: prefix_frac "
+        "minus normalized load over every routable pod) and elastic "
+        f"membership (`cluster/membership.py`: {cfg['scale_out']['pods']} "
+        f"pods join at {cfg['scale_out']['at_s']}s — warm-before-serve "
+        "lands the hottest prefixes on each joiner BEFORE it takes "
+        f"traffic — and one pod leaves drained at "
+        f"{cfg['scale_in']['at_s']}s).",
+        "",
+        "| Arm | TTFT p50 (s) | TTFT p90 (s) | Hit rate | Preemptions |",
+        "|---|---:|---:|---:|---:|",
+        *rows,
+        "",
+        "Routing alone cannot un-saturate a page-bound fleet (the "
+        "load_blend row: diverting costs hits and buys nothing when "
+        "every pod is over capacity) — the policy's value is routing NEW "
+        "capacity well: policy + scale-out lands at "
+        f"**{stats['ttft_p50_vs_unsaturated_baseline']}x the unsaturated "
+        "baseline p50** (target ≤3x) with "
+        f"**{stats['hit_rate_retention_vs_precise_saturated']:.1%} "
+        "hit-rate retention** vs precise-only (target ≥80%), "
+        f"{auto['preemptions']} preemptions vs "
+        f"{arms['precise_saturated']['preemptions']} untreated, and "
+        f"{warm.get('blocks_landed', 0)} warm blocks landed on the "
+        "joiners before their first routed request. Live-reassignment "
+        f"audit: {re['verified_requests']} requests scored through a "
+        f"{re['replicas']}-replica partition-gated cluster with "
+        f"`{re['moved_pod']}`'s stream handed off mid-run (two-phase: "
+        "pause → watermark → entry move → seq-floor journal replay) — "
+        f"**{re['stale_partition_scores']} stale-partition scores** "
+        "(every merged answer matched the monolithic index). All "
+        f"targets met: {all(targets.values())}. Source: "
+        "`FLEET_BENCH_AUTOSCALE.json`.",
+    ])
+
+
 def fleet_placement_section() -> str:
     """Multi-tenant hotspot scenario (bench.py --placement / placement/
     subsystem): what proactive K-way hot-prefix replication buys over
@@ -1029,6 +1099,7 @@ def regenerate(text: str) -> str:
         ("fleet-faults", fleet_faults_section()),
         ("fleet-replication", fleet_replication_section()),
         ("fleet-placement", fleet_placement_section()),
+        ("fleet-autoscale", fleet_autoscale_section()),
         ("fleet-device", fleet_device_section()),
         ("device", device_section()),
         ("micro", micro_section()),

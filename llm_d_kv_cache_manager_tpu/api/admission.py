@@ -1,0 +1,208 @@
+"""Bounded admission control for the scoring surfaces.
+
+Under saturation an unbounded service queue converts overload into
+unbounded latency: every request eventually gets an answer, each one
+slower than the last, and the caller's own deadline has long expired by
+the time it arrives (the qps_40 row of FLEET_BENCH.json `qps_ladder` is
+this failure mode measured end-to-end). The admission controller makes
+overload an *explicit, bounded, observable* outcome instead:
+
+- at most ``max_concurrency`` requests score at once;
+- at most ``max_queue_depth`` more wait, for at most ``max_wait_s``;
+- everything past those bounds is SHED — HTTP 429 with a ``Retry-After``
+  hint, gRPC ``RESOURCE_EXHAUSTED`` with a ``retry-after-ms`` trailer —
+  never an unbounded queue, never a silent stall.
+
+Deadline propagation rides the same gate: a caller-supplied remaining
+budget (the gRPC context deadline, or the HTTP ``X-Request-Deadline-Ms``
+header) caps the queue wait, and a request whose budget expires while
+waiting is shed as ``deadline`` — the service refuses to compute a score
+the caller has already abandoned. This is the service-surface sibling of
+`TokenizationPool`'s ``PoolOverloadedError`` per-item degradation: both
+turn pressure into an explicit, counted signal at the earliest seam that
+can see it.
+
+Every shed is counted in ``kvcache_admission_shed_total{kind}`` (kind one
+of the fixed `SHED_*` constants below) and every queued-then-served
+request in ``kvcache_admission_queued_total``, so dashboards can tell
+"at capacity and shedding correctly" from "mysteriously slow".
+
+The controller is transport-neutral sync code (Condition under one lock,
+injectable clock): the aiohttp handlers call it through
+``asyncio.to_thread`` alongside the scoring work itself, the gRPC
+servicer calls it on its worker thread.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterator, Optional
+
+from llm_d_kv_cache_manager_tpu.metrics import collector as metrics
+from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
+
+logger = kvlog.get_logger("api.admission")
+
+# Fixed shed-kind vocabulary (the `kind` label of
+# kvcache_admission_shed_total — bounded by construction, enforced by
+# tests/test_metrics_hygiene.py):
+SHED_QUEUE_FULL = "queue_full"  # waiting line at max_queue_depth
+SHED_DEADLINE = "deadline"      # caller's propagated budget expired
+SHED_TIMEOUT = "timeout"        # waited max_wait_s without a slot
+SHED_KINDS = (SHED_QUEUE_FULL, SHED_DEADLINE, SHED_TIMEOUT)
+
+
+@dataclass
+class AdmissionConfig:
+    """Env mapping (api/http_service.py): ADMISSION_MAX_CONCURRENCY,
+    ADMISSION_QUEUE_DEPTH, ADMISSION_MAX_WAIT_MS, ADMISSION_RETRY_AFTER_MS;
+    ADMISSION=0 disables the gate entirely."""
+
+    # Requests scoring concurrently before arrivals start queueing. Sized
+    # to the scoring thread pool, not the listener: admitting more than
+    # can run just moves the queue somewhere invisible.
+    max_concurrency: int = 8
+    # Bounded waiting line past the concurrency slots; arrival #
+    # (max_concurrency + max_queue_depth + 1) is shed immediately.
+    max_queue_depth: int = 64
+    # Hard cap on time spent in the waiting line (sheds as "timeout").
+    max_wait_s: float = 1.0
+    # Retry-After hint attached to every shed response. Deliberately a
+    # fixed config value, not a queue-derived estimate: under overload an
+    # estimate computed from the thing that is overloaded is noise.
+    retry_after_s: float = 1.0
+
+    def __post_init__(self):
+        if self.max_concurrency <= 0:
+            raise ValueError("max_concurrency must be positive")
+        if self.max_queue_depth < 0:
+            raise ValueError("max_queue_depth must be >= 0")
+        if self.max_wait_s <= 0:
+            raise ValueError("max_wait_s must be positive")
+        if self.retry_after_s < 0:
+            raise ValueError("retry_after_s must be >= 0")
+
+
+class AdmissionRejected(Exception):
+    """Explicit shed: HTTP maps it to 429, gRPC to RESOURCE_EXHAUSTED."""
+
+    def __init__(self, kind: str, retry_after_s: float, detail: str = ""):
+        self.kind = kind
+        self.retry_after_s = retry_after_s
+        super().__init__(
+            detail or f"admission shed ({kind}); retry after "
+                      f"{retry_after_s:g}s"
+        )
+
+
+class AdmissionController:
+    """Bounded concurrency + bounded waiting line + deadline-capped waits."""
+
+    def __init__(
+        self,
+        config: Optional[AdmissionConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.config = config or AdmissionConfig()
+        self.clock = clock
+        self._cond = threading.Condition()
+        self._active = 0
+        self._waiting = 0
+        self.stats: Dict[str, int] = {
+            "admitted": 0,
+            "queued": 0,
+            "shed_queue_full": 0,
+            "shed_deadline": 0,
+            "shed_timeout": 0,
+        }
+
+    # -- gate --------------------------------------------------------------
+
+    def _shed(self, kind: str) -> AdmissionRejected:
+        self.stats[f"shed_{kind}"] += 1
+        metrics.count_admission_shed(kind)
+        return AdmissionRejected(kind, self.config.retry_after_s)
+
+    def try_acquire(self, budget_s: Optional[float] = None) -> None:
+        """Take a slot or raise `AdmissionRejected`. `budget_s` is the
+        caller's remaining deadline budget (None = no deadline): it caps
+        the queue wait, and a request that cannot possibly be served
+        inside it is shed as ``deadline`` rather than parked."""
+        cfg = self.config
+        with self._cond:
+            if budget_s is not None and budget_s <= 0:
+                # The caller is already out of time: scoring would be
+                # work nobody is waiting for.
+                raise self._shed(SHED_DEADLINE)
+            if self._active < cfg.max_concurrency and self._waiting == 0:
+                self._active += 1
+                self.stats["admitted"] += 1
+                return
+            if self._waiting >= cfg.max_queue_depth:
+                raise self._shed(SHED_QUEUE_FULL)
+            wait_cap = cfg.max_wait_s
+            capped_by_deadline = False
+            if budget_s is not None and budget_s < wait_cap:
+                wait_cap = budget_s
+                capped_by_deadline = True
+            self._waiting += 1
+            self.stats["queued"] += 1
+            metrics.count_admission_queued()
+            deadline_at = self.clock() + wait_cap
+            try:
+                while self._active >= cfg.max_concurrency:
+                    remaining = deadline_at - self.clock()
+                    if remaining <= 0:
+                        raise self._shed(
+                            SHED_DEADLINE if capped_by_deadline
+                            else SHED_TIMEOUT
+                        )
+                    self._cond.wait(timeout=remaining)
+                self._active += 1
+                self.stats["admitted"] += 1
+            finally:
+                self._waiting -= 1
+
+    def release(self) -> None:
+        with self._cond:
+            self._active -= 1
+            self._cond.notify()
+
+    @contextlib.contextmanager
+    def admit(self, budget_s: Optional[float] = None) -> Iterator[None]:
+        """`with controller.admit(budget):` — the serving surfaces' gate."""
+        self.try_acquire(budget_s)
+        try:
+            yield
+        finally:
+            self.release()
+
+    # -- introspection -----------------------------------------------------
+
+    def depth(self) -> Dict[str, int]:
+        with self._cond:
+            return {"active": self._active, "waiting": self._waiting}
+
+    def shed_total(self) -> int:
+        return (
+            self.stats["shed_queue_full"]
+            + self.stats["shed_deadline"]
+            + self.stats["shed_timeout"]
+        )
+
+    def status(self) -> dict:
+        cfg = self.config
+        with self._cond:
+            stats = dict(self.stats)
+            depth = {"active": self._active, "waiting": self._waiting}
+        return {
+            "max_concurrency": cfg.max_concurrency,
+            "max_queue_depth": cfg.max_queue_depth,
+            "max_wait_s": cfg.max_wait_s,
+            "retry_after_s": cfg.retry_after_s,
+            "depth": depth,
+            "stats": stats,
+        }

@@ -16,6 +16,7 @@ the serializer explicit anyway — the JSON body is the same document
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import queue
@@ -27,6 +28,12 @@ from typing import Dict, Iterator, List
 import grpc
 
 from llm_d_kv_cache_manager_tpu.api import indexer_pb2 as pb
+from llm_d_kv_cache_manager_tpu.api.admission import (
+    SHED_DEADLINE,
+    AdmissionController,
+    AdmissionRejected,
+)
+from llm_d_kv_cache_manager_tpu.metrics import collector as metrics
 from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
 
 logger = kvlog.get_logger("api.grpc")
@@ -45,6 +52,12 @@ DEFAULT_BULK_MAX_BATCH = 128
 DEFAULT_BULK_WINDOW_S = 0.0
 
 
+@contextlib.contextmanager
+def _noop_admit(budget_s=None):
+    """Admission disabled: the gate is identity (deadline checks remain)."""
+    yield
+
+
 def _request_to_score_request(request: pb.GetPodScoresRequest):
     from llm_d_kv_cache_manager_tpu.kvcache.indexer import ScoreRequest
 
@@ -56,22 +69,52 @@ def _request_to_score_request(request: pb.GetPodScoresRequest):
     )
 
 
+def _shed_abort(context: grpc.ServicerContext, e: AdmissionRejected) -> None:
+    """Map an admission shed to RESOURCE_EXHAUSTED + retry-after trailer
+    (the gRPC sibling of HTTP 429 + Retry-After)."""
+    context.set_trailing_metadata(
+        (("retry-after-ms", str(int(e.retry_after_s * 1000))),)
+    )
+    context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+
+
+def _deadline_expired(context: grpc.ServicerContext) -> bool:
+    """True when the CLIENT's propagated deadline has already passed —
+    any score computed now is work nobody is waiting for. Counted as a
+    `deadline` shed (the caller abandoned us; we abort the work, not the
+    connection)."""
+    remaining = context.time_remaining()
+    if remaining is not None and remaining <= 0:
+        metrics.count_admission_shed(SHED_DEADLINE)
+        return True
+    return False
+
+
 def _make_handler(
     indexer,
     cluster_status_fn=None,
     bulk_max_batch: int = DEFAULT_BULK_MAX_BATCH,
     bulk_window_s: float = DEFAULT_BULK_WINDOW_S,
+    admission: AdmissionController = None,
 ):
+    admit = admission.admit if admission is not None else _noop_admit
+
     def get_pod_scores(
         request: pb.GetPodScoresRequest, context: grpc.ServicerContext
     ) -> pb.GetPodScoresResponse:
         try:
-            scores: Dict[str, float] = indexer.get_pod_scores(
-                request.prompt,
-                request.model_name,
-                list(request.pod_identifiers),
-                lora_id=request.lora_id if request.HasField("lora_id") else None,
-            )
+            with admit(context.time_remaining()):
+                scores: Dict[str, float] = indexer.get_pod_scores(
+                    request.prompt,
+                    request.model_name,
+                    list(request.pod_identifiers),
+                    lora_id=(
+                        request.lora_id if request.HasField("lora_id") else None
+                    ),
+                )
+        except AdmissionRejected as e:
+            _shed_abort(context, e)
+            return pb.GetPodScoresResponse()
         except Exception as e:  # noqa: BLE001 - surface as gRPC status
             logger.warning("GetPodScores failed: %s", e)
             context.abort(grpc.StatusCode.INTERNAL, str(e))
@@ -88,13 +131,27 @@ def _make_handler(
         PLUS per-pod matched-prefix lengths and the prompt's block-hash
         chain — everything the partition-ownership merge needs. JSON
         payload, same no-protoc rationale as ExplainScores."""
+        if _deadline_expired(context):
+            # Explicit no-signal, the same degraded shape a missing
+            # partition produces in the scatter-gather merge — never a
+            # stall, never wasted scoring.
+            return {
+                "scores": {}, "match_blocks": {}, "block_hashes": [],
+                "degraded": "deadline",
+            }
         try:
-            result = indexer.get_pod_scores_ex(
-                request.prompt,
-                request.model_name,
-                list(request.pod_identifiers),
-                lora_id=request.lora_id if request.HasField("lora_id") else None,
-            )
+            with admit(context.time_remaining()):
+                result = indexer.get_pod_scores_ex(
+                    request.prompt,
+                    request.model_name,
+                    list(request.pod_identifiers),
+                    lora_id=(
+                        request.lora_id if request.HasField("lora_id") else None
+                    ),
+                )
+        except AdmissionRejected as e:
+            _shed_abort(context, e)
+            return {}
         except Exception as e:  # noqa: BLE001 - surface as gRPC status
             logger.warning("GetPodScoresEx failed: %s", e)
             context.abort(grpc.StatusCode.INTERNAL, str(e))
@@ -189,10 +246,28 @@ def _make_handler(
                     finished = True
                     break
                 window.append(item)
+            if context.time_remaining() is not None and (
+                context.time_remaining() <= 0
+            ):
+                # Client deadline expired mid-stream: every remaining
+                # window item is abandoned work. Count each as a deadline
+                # shed and stop — no score is computed for a caller that
+                # is no longer listening.
+                for _ in window:
+                    metrics.count_admission_shed(SHED_DEADLINE)
+                return
             try:
-                scored = indexer.score_many(
-                    [_request_to_score_request(r) for r in window]
-                )
+                with admit(context.time_remaining()):
+                    scored = indexer.score_many(
+                        [_request_to_score_request(r) for r in window]
+                    )
+            except AdmissionRejected as e:
+                # Count the whole window (one stream-level shed would hide
+                # the per-item volume) and surface the explicit status.
+                for _ in window[1:]:
+                    metrics.count_admission_shed(e.kind)
+                _shed_abort(context, e)
+                return
             except Exception as e:  # noqa: BLE001 - surface as gRPC status
                 logger.warning("ScorePodsBulk window failed: %s", e)
                 context.abort(grpc.StatusCode.INTERNAL, str(e))
@@ -243,6 +318,7 @@ def serve_grpc(
     cluster_status_fn=None,
     bulk_max_batch: int = None,
     bulk_window_s: float = None,
+    admission: AdmissionController = None,
 ) -> grpc.Server:
     """Start (non-blocking) a gRPC server wrapping the indexer.
 
@@ -255,6 +331,12 @@ def serve_grpc(
     item (0 = score whatever has already arrived, never wait). Left None,
     they resolve from SCORE_BATCH_MAX / SCORE_BATCH_WINDOW_MS — the same
     environment knobs the HTTP `/score_completions/batch` cap reads.
+    `admission` (optional AdmissionController, typically the SAME instance
+    the HTTP surface uses so the two fronts share one bounded budget)
+    gates every scoring method: sheds surface as RESOURCE_EXHAUSTED with a
+    `retry-after-ms` trailer; client deadlines propagate into the gate and
+    an expired deadline aborts the scoring work (counted) instead of
+    computing an abandoned score.
     """
     if bulk_max_batch is None:
         bulk_max_batch = int(
@@ -272,6 +354,7 @@ def serve_grpc(
             cluster_status_fn=cluster_status_fn,
             bulk_max_batch=bulk_max_batch,
             bulk_window_s=bulk_window_s,
+            admission=admission,
         ),)
     )
     server.add_insecure_port(address)

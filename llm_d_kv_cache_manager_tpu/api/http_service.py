@@ -28,6 +28,14 @@ the ZMQ KVEvents write plane, and Prometheus metrics behind HTTP:
                                 partition + readiness state and (when a
                                 scatter-gather front is wired) per-replica
                                 health
+  GET  /routing/status          saturation-resilience introspection:
+                                routing policy config/override stats +
+                                per-pod load snapshot, admission gate
+                                depth and shed counters
+  POST /pod_load                pod-load reporter seam: {"pod",
+                                "queue_depth"?, "inflight"?, "busy_s"?}
+                                feeds the load_blend routing policy
+                                (400 unless ROUTING_POLICY=load_blend)
   POST /cluster/snapshot        drain + write this replica's index
                                 snapshot (view + seq watermarks) to
                                 CLUSTER_SNAPSHOT_PATH
@@ -45,8 +53,15 @@ the ZMQ KVEvents write plane, and Prometheus metrics behind HTTP:
 Env config mirrors the reference's variable set (online/main.go:41-58):
 ZMQ_ENDPOINT, ZMQ_TOPIC, POOL_CONCURRENCY, PYTHONHASHSEED (hash seed!),
 BLOCK_SIZE, BLOCK_HASH_ALGO, HTTP_PORT, HF_TOKEN, LOCAL_TOKENIZER_DIR,
-the fleet-health windows SUSPECT_AFTER_S / STALE_AFTER_S, and the tracing
-spine knobs KVTPU_TRACE / KVTPU_TRACE_RING / KVTPU_TRACE_SLOW_MS.
+the fleet-health windows SUSPECT_AFTER_S / STALE_AFTER_S, the tracing
+spine knobs KVTPU_TRACE / KVTPU_TRACE_RING / KVTPU_TRACE_SLOW_MS, the
+admission gate ADMISSION / ADMISSION_MAX_CONCURRENCY /
+ADMISSION_QUEUE_DEPTH / ADMISSION_MAX_WAIT_MS / ADMISSION_RETRY_AFTER_MS
+(scoring endpoints shed with 429 + Retry-After past the bounds; the
+client's remaining budget propagates via the X-Request-Deadline-Ms
+header), and the load-aware routing policy ROUTING_POLICY /
+ROUTING_LOAD_WEIGHT / ROUTING_QUEUE_NORM / ROUTING_BUSY_NORM_S /
+ROUTING_PREEMPTION_NORM.
 
 Run: python -m llm_d_kv_cache_manager_tpu.api.http_service
 """
@@ -61,6 +76,7 @@ from typing import Optional
 from aiohttp import web
 
 from llm_d_kv_cache_manager_tpu import obs
+from llm_d_kv_cache_manager_tpu.api.admission import AdmissionRejected
 from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.chain_memo import ChainMemoConfig
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import IndexConfig
@@ -144,6 +160,41 @@ def config_from_env() -> dict:
         "placement_hotness": float(
             os.environ.get("PLACEMENT_HOTNESS", "30")
         ),
+        # Admission control (api/admission.py): bounded concurrency +
+        # bounded waiting line on the scoring endpoints; past the bounds
+        # requests are shed with 429 + Retry-After instead of queueing
+        # without limit. ADMISSION=0 removes the gate entirely.
+        "admission": os.environ.get("ADMISSION", "1") == "1",
+        "admission_max_concurrency": int(
+            os.environ.get("ADMISSION_MAX_CONCURRENCY", "8")
+        ),
+        "admission_queue_depth": int(
+            os.environ.get("ADMISSION_QUEUE_DEPTH", "64")
+        ),
+        "admission_max_wait_ms": float(
+            os.environ.get("ADMISSION_MAX_WAIT_MS", "1000")
+        ),
+        "admission_retry_after_ms": float(
+            os.environ.get("ADMISSION_RETRY_AFTER_MS", "1000")
+        ),
+        # Load-aware routing policy (kvcache/routing.py): prefix_only
+        # (default) is pinned bit-identical to the pure prefix read path;
+        # load_blend divides each pod's prefix score by its normalized
+        # load (queue depth / busy seconds / decayed preemption rate, fed
+        # by POST /pod_load reports and the kvevents BlockRemoved stream).
+        "routing_policy": os.environ.get("ROUTING_POLICY", "prefix_only"),
+        "routing_load_weight": float(
+            os.environ.get("ROUTING_LOAD_WEIGHT", "1.0")
+        ),
+        "routing_queue_norm": float(
+            os.environ.get("ROUTING_QUEUE_NORM", "4.0")
+        ),
+        "routing_busy_norm_s": float(
+            os.environ.get("ROUTING_BUSY_NORM_S", "1.0")
+        ),
+        "routing_preemption_norm": float(
+            os.environ.get("ROUTING_PREEMPTION_NORM", "8.0")
+        ),
     }
 
 
@@ -173,6 +224,56 @@ class ScoringService:
             stale_after_s=float(env.get("stale_after_s", 120.0)),
         ))
         self._started = False
+
+        # Admission gate (api/admission.py): one controller shared by
+        # every scoring endpoint (and handed to serve_grpc when a gRPC
+        # front is started next to this service), so the process has ONE
+        # bounded budget rather than per-transport invisible queues.
+        self.admission = None
+        if env.get("admission", True):
+            from llm_d_kv_cache_manager_tpu.api.admission import (
+                AdmissionConfig,
+                AdmissionController,
+            )
+
+            self.admission = AdmissionController(AdmissionConfig(
+                max_concurrency=int(env.get("admission_max_concurrency", 8)),
+                max_queue_depth=int(env.get("admission_queue_depth", 64)),
+                max_wait_s=float(env.get("admission_max_wait_ms", 1000)) / 1e3,
+                retry_after_s=(
+                    float(env.get("admission_retry_after_ms", 1000)) / 1e3
+                ),
+            ))
+
+        # Load-aware routing policy (kvcache/routing.py +
+        # fleethealth/load.py). The load tracker exists whenever the
+        # policy does — load_blend without signals degrades to the
+        # identity, so wiring order can't flip routing.
+        self.load_tracker = None
+        self.routing_policy = None
+        policy_name = env.get("routing_policy", "prefix_only")
+        if policy_name != "prefix_only":
+            from llm_d_kv_cache_manager_tpu.fleethealth import PodLoadTracker
+            from llm_d_kv_cache_manager_tpu.kvcache.routing import (
+                RoutingPolicy,
+                RoutingPolicyConfig,
+            )
+
+            self.load_tracker = PodLoadTracker()
+            self.routing_policy = RoutingPolicy(
+                RoutingPolicyConfig(
+                    policy=policy_name,
+                    load_weight=float(env.get("routing_load_weight", 1.0)),
+                    queue_depth_norm=float(
+                        env.get("routing_queue_norm", 4.0)
+                    ),
+                    busy_norm_s=float(env.get("routing_busy_norm_s", 1.0)),
+                    preemption_norm=float(
+                        env.get("routing_preemption_norm", 8.0)
+                    ),
+                ),
+                load_tracker=self.load_tracker,
+            )
 
         if indexer is not None:  # injected (tests / embedding)
             self.indexer = indexer
@@ -217,6 +318,12 @@ class ScoringService:
             self.indexer.fleet_health = self.fleet_health
         if self.fleet_health.index is None:
             self.fleet_health.bind_index(self.indexer.kv_block_index)
+        # Routing policy rides AFTER fleet-health filtering in the read
+        # path (kvcache/indexer.py): health decides what is trustworthy,
+        # the policy decides what is affordable. Injected indexers get the
+        # same treatment unless they brought their own.
+        if self.routing_policy is not None and self.indexer.routing_policy is None:
+            self.indexer.routing_policy = self.routing_policy
 
         # Replicated deployments wrap the event pool in an IndexerReplica:
         # the pool gains the partition-ownership gate, and the service
@@ -259,6 +366,12 @@ class ScoringService:
                 self.indexer.token_processor,
                 health_tracker=self.fleet_health,
             )
+        # The kvevents write plane feeds the load tracker's preemption-
+        # pressure signal: per-pod BlockRemoved volume is the wire-visible
+        # trace of page-pool churn (observation only — digestion and
+        # scores are untouched).
+        if self.load_tracker is not None:
+            self.event_pool.load_tracker = self.load_tracker
         # Optional scatter-gather front (embedders wire a ClusterScorer
         # over peer replicas); surfaces through /cluster/status only.
         self.cluster_scorer = None
@@ -299,6 +412,50 @@ class ScoringService:
         self.event_pool.shutdown()
         self.indexer.shutdown()
 
+    # -- admission plumbing --------------------------------------------------
+
+    @staticmethod
+    def _deadline_budget(request: web.Request):
+        """Client-propagated deadline: the `X-Request-Deadline-Ms` header
+        carries the caller's REMAINING budget in milliseconds (the HTTP
+        sibling of the gRPC context deadline). Absent/garbled = no
+        deadline."""
+        raw = request.headers.get("X-Request-Deadline-Ms")
+        if raw is None:
+            return None
+        try:
+            return max(0.0, float(raw) / 1e3)
+        except ValueError:
+            return None
+
+    @staticmethod
+    def _shed_response(e: AdmissionRejected) -> web.Response:
+        """429 + Retry-After: the explicit, bounded overload answer."""
+        return web.json_response(
+            {
+                "error": str(e),
+                "shed": e.kind,
+                "retry_after_s": e.retry_after_s,
+            },
+            status=429,
+            headers={"Retry-After": str(max(1, round(e.retry_after_s)))},
+        )
+
+    async def _admitted(self, request: web.Request, fn):
+        """Run sync scoring work on a worker thread under the admission
+        gate (when one is configured), with the client's deadline budget
+        capping the queue wait. Raises `AdmissionRejected` on shed."""
+        if self.admission is None:
+            return await asyncio.to_thread(fn)
+        budget = self._deadline_budget(request)
+        admission = self.admission
+
+        def gated():
+            with admission.admit(budget):
+                return fn()
+
+        return await asyncio.to_thread(gated)
+
     # -- handlers ------------------------------------------------------------
 
     async def handle_score_completions(self, request: web.Request) -> web.Response:
@@ -313,9 +470,14 @@ class ScoringService:
         pods = body.get("pods", [])
         lora_id = body.get("lora_id")
         try:
-            scores = await asyncio.to_thread(
-                self.indexer.get_pod_scores, prompt, model, pods, lora_id=lora_id
+            scores = await self._admitted(
+                request,
+                lambda: self.indexer.get_pod_scores(
+                    prompt, model, pods, lora_id=lora_id
+                ),
             )
+        except AdmissionRejected as e:
+            return self._shed_response(e)
         except Exception as e:  # noqa: BLE001
             return web.json_response({"error": str(e)}, status=500)
         return web.json_response({"podScores": scores})
@@ -356,9 +518,11 @@ class ScoringService:
                 status=400,
             )
         try:
-            results = await asyncio.to_thread(
-                self.indexer.score_many, score_requests
+            results = await self._admitted(
+                request, lambda: self.indexer.score_many(score_requests)
             )
+        except AdmissionRejected as e:
+            return self._shed_response(e)
         except Exception as e:  # noqa: BLE001
             return web.json_response({"error": str(e)}, status=500)
         return web.json_response(
@@ -374,13 +538,17 @@ class ScoringService:
             return web.json_response({"error": f"invalid request: {e}"}, status=400)
         try:
             rendered = await asyncio.to_thread(self.templating.render, render_request)
-            scores = await asyncio.to_thread(
-                self.indexer.get_pod_scores,
-                rendered,
-                model,
-                body.get("pods", []),
-                lora_id=body.get("lora_id"),
+            scores = await self._admitted(
+                request,
+                lambda: self.indexer.get_pod_scores(
+                    rendered,
+                    model,
+                    body.get("pods", []),
+                    lora_id=body.get("lora_id"),
+                ),
             )
+        except AdmissionRejected as e:
+            return self._shed_response(e)
         except Exception as e:  # noqa: BLE001
             return web.json_response({"error": str(e)}, status=500)
         return web.json_response(
@@ -506,6 +674,13 @@ class ScoringService:
             # slowest recent stage): degraded observability is itself
             # observable, but never gates readiness.
             "obs": obs.get_recorder().stats(),
+            # Admission gate occupancy + shed counters: a service AT
+            # capacity and shedding correctly is still ready (shedding is
+            # the designed overload behavior, not a failure).
+            "admission": (
+                self.admission.status() if self.admission is not None
+                else None
+            ),
         }
 
     async def handle_readyz(self, request: web.Request) -> web.Response:
@@ -567,6 +742,55 @@ class ScoringService:
 
         return web.json_response(await asyncio.to_thread(build))
 
+    async def handle_pod_load(self, request: web.Request) -> web.Response:
+        """POST: one pod-load report (the lightweight reporter seam —
+        pods, or a sidecar scraping them, push their own queue depth /
+        inflight / busy horizon here; the kvevents stream feeds the
+        preemption-pressure signal independently). 400 when no load
+        tracker is wired (ROUTING_POLICY=prefix_only needs no signals)."""
+        if self.load_tracker is None:
+            return web.json_response(
+                {"error": "no load tracker (set ROUTING_POLICY=load_blend)"},
+                status=400,
+            )
+        try:
+            body = await request.json()
+            pod = body["pod"]
+            queue_depth = float(body.get("queue_depth", 0.0))
+            inflight = float(body.get("inflight", 0.0))
+            busy_s = body.get("busy_s")
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+            return web.json_response(
+                {"error": f"invalid request: {e}"}, status=400
+            )
+        busy_until = None
+        if busy_s is not None:
+            busy_until = self.load_tracker.clock() + max(0.0, float(busy_s))
+        self.load_tracker.report(
+            pod, queue_depth=queue_depth, inflight=inflight,
+            busy_until=busy_until,
+        )
+        return web.json_response({"status": "ok"})
+
+    async def handle_routing_status(self, request: web.Request) -> web.Response:
+        """Saturation-resilience introspection: routing policy config +
+        override stats + per-pod load snapshot, and the admission gate's
+        depth/shed counters."""
+        def build():
+            return {
+                "routing_policy": (
+                    self.routing_policy.status()
+                    if self.routing_policy is not None
+                    else {"policy": "prefix_only"}
+                ),
+                "admission": (
+                    self.admission.status()
+                    if self.admission is not None else None
+                ),
+            }
+
+        return web.json_response(await asyncio.to_thread(build))
+
     async def handle_cluster_snapshot(self, request: web.Request) -> web.Response:
         """POST: drain the event pool and write this replica's snapshot
         (view + seq watermarks) to the configured path."""
@@ -597,6 +821,8 @@ class ScoringService:
         app.router.add_get("/health", self.handle_health)
         app.router.add_get("/readyz", self.handle_readyz)
         app.router.add_get("/cluster/status", self.handle_cluster_status)
+        app.router.add_get("/routing/status", self.handle_routing_status)
+        app.router.add_post("/pod_load", self.handle_pod_load)
         app.router.add_get("/placement/status", self.handle_placement_status)
         app.router.add_post("/cluster/snapshot", self.handle_cluster_snapshot)
         app.router.add_get("/debug/traces", self.handle_debug_traces)

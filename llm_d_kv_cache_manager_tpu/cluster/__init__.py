@@ -30,6 +30,19 @@ from llm_d_kv_cache_manager_tpu.cluster.replica import (  # noqa: F401
     REPLAYING,
     IndexerReplica,
 )
+from llm_d_kv_cache_manager_tpu.cluster.membership import (  # noqa: F401
+    DRAINING,
+    JOINING,
+    LEFT,
+    REASSIGNING,
+    SERVING,
+    WARMING,
+    FleetMembership,
+    MembershipConfig,
+    PartitionTable,
+    ReplicaBinding,
+    export_pod_view,
+)
 from llm_d_kv_cache_manager_tpu.cluster.scorer import (  # noqa: F401
     ClusterScorer,
     GrpcReplicaTransport,
@@ -49,13 +62,24 @@ from llm_d_kv_cache_manager_tpu.cluster.snapshot import (  # noqa: F401
 __all__ = [
     "ClusterConfig",
     "ClusterScorer",
+    "DRAINING",
+    "FleetMembership",
     "GrpcReplicaTransport",
     "IndexerReplica",
+    "JOINING",
+    "LEFT",
     "LocalReplicaTransport",
+    "MembershipConfig",
+    "PartitionTable",
     "READY",
+    "REASSIGNING",
     "REPLAYING",
+    "ReplicaBinding",
     "ReplicaPartitioner",
     "ReplicaUnavailable",
+    "SERVING",
+    "WARMING",
+    "export_pod_view",
     "SNAPSHOT_VERSION",
     "Snapshot",
     "SnapshotFormatError",

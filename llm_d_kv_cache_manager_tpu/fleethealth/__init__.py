@@ -3,6 +3,11 @@ from llm_d_kv_cache_manager_tpu.fleethealth.faults import (
     FaultPlan,
     PodFaults,
 )
+from llm_d_kv_cache_manager_tpu.fleethealth.load import (
+    PodLoad,
+    PodLoadConfig,
+    PodLoadTracker,
+)
 from llm_d_kv_cache_manager_tpu.fleethealth.tracker import (
     HEALTHY,
     STALE,
@@ -18,6 +23,9 @@ __all__ = [
     "FleetHealthTracker",
     "HEALTHY",
     "PodFaults",
+    "PodLoad",
+    "PodLoadConfig",
+    "PodLoadTracker",
     "STALE",
     "SUSPECT",
 ]

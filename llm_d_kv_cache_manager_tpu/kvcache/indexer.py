@@ -109,6 +109,7 @@ class Indexer:
         chat_templating=None,
         fleet_health=None,
         popularity=None,
+        routing_policy=None,
     ):
         self.config = config or IndexerConfig()
         # Optional fleethealth.FleetHealthTracker: when wired, scores pass
@@ -117,6 +118,12 @@ class Indexer:
         # fleet passes through untouched, so enabling the subsystem is
         # bit-identical on the no-fault path.
         self.fleet_health = fleet_health
+        # Optional kvcache.routing.RoutingPolicy: the saturation-regime
+        # load blend, applied AFTER fleet-health filtering (health decides
+        # what is trustworthy; the policy decides what is affordable). The
+        # prefix_only policy — and None, the default — return the scores
+        # dict unchanged, pinning the pure-prefix path bit-identical.
+        self.routing_policy = routing_policy
         # Optional placement.ChainPopularityTracker: every scored request
         # reports its chain head + tenant/LoRA extra to the hot-prefix
         # detector (placement/popularity.py). Observation only — scores are
@@ -288,6 +295,8 @@ class Indexer:
                 # answer — the caller's load/round-robin fallback takes over
                 # instead of routing to phantom placements.
                 scores = self.fleet_health.filter_scores(scores)
+            if self.routing_policy is not None:
+                scores = self.routing_policy.adjust(scores, _explain=_explain)
         kvlog.trace(logger, "pod scores: %s", scores)
         return PodScores(
             scores=scores,
@@ -462,9 +471,12 @@ class Indexer:
                         ))
                 scored = self.scorer.score_plan(plan)
                 fleet_health = self.fleet_health
+                routing_policy = self.routing_policy
                 for spec, (scores, match_blocks) in zip(plan_specs, scored):
                     if fleet_health is not None:
                         scores = fleet_health.filter_scores(scores)
+                    if routing_policy is not None:
+                        scores = routing_policy.adjust(scores)
                     results[spec["item"]] = PodScores(
                         scores=scores,
                         match_blocks=match_blocks,

@@ -125,6 +125,7 @@ class EventPool:
         health_tracker=None,
         message_filter=None,
         popularity=None,
+        load_tracker=None,
     ):
         self.config = config or EventPoolConfig()
         self.index = index
@@ -155,6 +156,11 @@ class EventPool:
         # sketch — fleet-wide re-store traffic is reuse evidence the
         # cost-aware eviction weighs. Observation only; None costs one check.
         self.popularity = popularity
+        # Optional fleethealth.load.PodLoadTracker (duck-typed): per-pod
+        # BlockRemoved volume feeds the decayed preemption/eviction-pressure
+        # signal the load-blend routing policy reads — the wire-visible
+        # trace of page-pool churn. Observation only; None costs one check.
+        self.load_tracker = load_tracker
         depth = max(0, self.config.max_queue_depth)
         self._queues: List["queue.Queue[Optional[Message]]"] = [
             queue.Queue(maxsize=depth) for _ in range(self.config.concurrency)
@@ -513,6 +519,10 @@ class EventPool:
             if isinstance(event, BlockStored):
                 self._digest_block_stored(pod_identifier, model_name, event)
             elif isinstance(event, BlockRemoved):
+                if self.load_tracker is not None and event.block_hashes:
+                    self.load_tracker.observe_removed_blocks(
+                        pod_identifier, len(event.block_hashes)
+                    )
                 self._digest_block_removed(pod_identifier, model_name, event)
             elif isinstance(event, AllBlocksCleared):
                 continue  # engines emit per-block removals as well

@@ -90,6 +90,17 @@ placement_replicated_blocks: Optional[Counter] = None
 placement_drops: Optional[Counter] = None
 placement_skipped_unhealthy: Optional[Counter] = None
 
+# Saturation resilience (admission + routing policy + membership):
+# explicit sheds at the serving surface (kind ∈ {queue_full, deadline,
+# timeout} — fixed in api/admission.py), requests that waited in the
+# bounded admission queue, load-blend routing decisions that overrode the
+# pure prefix argmax (kvcache/routing.py), and fleet-membership lifecycle
+# transitions (phase ∈ the fixed state set in cluster/membership.py).
+admission_shed: Optional[Counter] = None
+admission_queued: Optional[Counter] = None
+routing_policy_overrides: Optional[Counter] = None
+membership_transitions: Optional[Counter] = None
+
 _APPLY_DELAY_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
     5.0, 10.0, 30.0, 60.0,
@@ -117,6 +128,8 @@ def register_metrics(registry=None) -> None:
     global placement_hot_chains, placement_replications
     global placement_replicated_blocks, placement_drops
     global placement_skipped_unhealthy
+    global admission_shed, admission_queued
+    global routing_policy_overrides, membership_transitions
 
     with _register_lock:
         if _registered:
@@ -303,6 +316,32 @@ def register_metrics(registry=None) -> None:
             "them suspect or stale",
             registry=reg,
         )
+        admission_shed = Counter(
+            "kvcache_admission_shed_total",
+            "Requests explicitly shed at the serving surface (429 / "
+            "RESOURCE_EXHAUSTED), labeled by the bounded shed kind",
+            labelnames=("kind",),
+            registry=reg,
+        )
+        admission_queued = Counter(
+            "kvcache_admission_queued_total",
+            "Requests that waited in a bounded admission queue before "
+            "being served (admitted-after-wait, not sheds)",
+            registry=reg,
+        )
+        routing_policy_overrides = Counter(
+            "kvcache_routing_policy_overrides_total",
+            "Scoring calls where the load-blend routing policy changed "
+            "the deterministic prefix argmax (kvcache/routing.py)",
+            registry=reg,
+        )
+        membership_transitions = Counter(
+            "kvcache_membership_transitions_total",
+            "Fleet-membership lifecycle transitions, labeled by the phase "
+            "entered (cluster/membership.py fixed state set)",
+            labelnames=("phase",),
+            registry=reg,
+        )
         _registered = True
 
 
@@ -428,6 +467,26 @@ def count_placement_drop() -> None:
 def count_placement_skip_unhealthy() -> None:
     if placement_skipped_unhealthy is not None:
         placement_skipped_unhealthy.inc()
+
+
+def count_admission_shed(kind: str) -> None:
+    if admission_shed is not None:
+        admission_shed.labels(kind=kind).inc()
+
+
+def count_admission_queued() -> None:
+    if admission_queued is not None:
+        admission_queued.inc()
+
+
+def count_routing_override() -> None:
+    if routing_policy_overrides is not None:
+        routing_policy_overrides.inc()
+
+
+def count_membership_transition(phase: str) -> None:
+    if membership_transitions is not None:
+        membership_transitions.labels(phase=phase).inc()
 
 
 def counter_value(c: Optional[Counter]) -> float:

@@ -57,6 +57,12 @@ def pytest_collection_modifyitems(config, items):
         for item in items:
             if "placement" in item.keywords:
                 item.add_marker(skip)
+        # `membership`-marked tests warm joining pods through the same
+        # transfer plane (warm-before-serve e2e); the lifecycle/handoff/
+        # reassignment tests are unmarked and always run.
+        for item in items:
+            if "membership" in item.keywords:
+                item.add_marker(skip)
 
     # `cluster`-marked tests exercise the gRPC scatter-gather transport;
     # the local-transport cluster tests are unmarked and always run.

@@ -1,0 +1,390 @@
+"""Admission control tests (api/admission.py + the serving surfaces).
+
+The load-bearing pins:
+
+- Overload is EXPLICIT and bounded: past max_concurrency +
+  max_queue_depth a request is shed (429 / RESOURCE_EXHAUSTED with a
+  retry-after hint), never parked in an unbounded queue, never silently
+  dropped — and every shed is counted by kind.
+- Deadline propagation: a caller whose budget is already exhausted is
+  shed as `deadline` without any scoring work; the gRPC surfaces read
+  the client deadline from context and return no-signal (counted)
+  instead of computing an abandoned score.
+"""
+
+import asyncio
+import socket
+import threading
+import time
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.api.admission import (
+    SHED_DEADLINE,
+    SHED_QUEUE_FULL,
+    SHED_TIMEOUT,
+    AdmissionConfig,
+    AdmissionController,
+    AdmissionRejected,
+)
+from llm_d_kv_cache_manager_tpu.metrics import collector as metrics
+
+from tests.conftest import TEST_MODEL_NAME, TEST_TOKENIZER_JSON
+
+PROMPT = "the quick brown fox jumps over the lazy dog"
+
+
+# -- controller unit ----------------------------------------------------------
+
+
+class TestController:
+    def test_fast_path_admits(self):
+        c = AdmissionController(AdmissionConfig(max_concurrency=2))
+        with c.admit():
+            with c.admit():
+                assert c.depth() == {"active": 2, "waiting": 0}
+        assert c.depth() == {"active": 0, "waiting": 0}
+        assert c.stats["admitted"] == 2
+        assert c.stats["queued"] == 0
+
+    def test_queue_full_sheds_immediately(self):
+        c = AdmissionController(
+            AdmissionConfig(max_concurrency=1, max_queue_depth=0)
+        )
+        with c.admit():
+            with pytest.raises(AdmissionRejected) as err:
+                c.try_acquire()
+        assert err.value.kind == SHED_QUEUE_FULL
+        assert c.stats["shed_queue_full"] == 1
+        assert c.shed_total() == 1
+
+    def test_wait_timeout_sheds_as_timeout(self):
+        c = AdmissionController(AdmissionConfig(
+            max_concurrency=1, max_queue_depth=4, max_wait_s=0.02
+        ))
+        with c.admit():
+            t0 = time.monotonic()
+            with pytest.raises(AdmissionRejected) as err:
+                c.try_acquire()
+            assert time.monotonic() - t0 < 1.0
+        assert err.value.kind == SHED_TIMEOUT
+        assert c.stats["queued"] == 1  # it did wait in the line
+
+    def test_exhausted_budget_sheds_as_deadline_without_queueing(self):
+        c = AdmissionController(AdmissionConfig(max_concurrency=1))
+        with pytest.raises(AdmissionRejected) as err:
+            c.try_acquire(budget_s=0.0)
+        assert err.value.kind == SHED_DEADLINE
+        assert c.stats["queued"] == 0  # never parked
+
+    def test_budget_caps_the_wait_and_sheds_as_deadline(self):
+        c = AdmissionController(AdmissionConfig(
+            max_concurrency=1, max_queue_depth=4, max_wait_s=30.0
+        ))
+        with c.admit():
+            t0 = time.monotonic()
+            with pytest.raises(AdmissionRejected) as err:
+                c.try_acquire(budget_s=0.02)
+            assert time.monotonic() - t0 < 1.0
+        assert err.value.kind == SHED_DEADLINE
+
+    def test_release_admits_a_waiter(self):
+        c = AdmissionController(AdmissionConfig(
+            max_concurrency=1, max_queue_depth=4, max_wait_s=5.0
+        ))
+        c.try_acquire()
+        admitted = threading.Event()
+
+        def waiter():
+            with c.admit():
+                admitted.set()
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        try:
+            # The waiter is parked, not shed.
+            deadline = time.monotonic() + 2.0
+            while c.depth()["waiting"] != 1:
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            c.release()
+            assert admitted.wait(timeout=2.0)
+        finally:
+            t.join(timeout=5.0)
+        assert c.shed_total() == 0
+        assert c.stats["queued"] == 1
+        assert c.stats["admitted"] == 2
+
+    def test_exception_inside_admit_releases_the_slot(self):
+        c = AdmissionController(AdmissionConfig(max_concurrency=1))
+        with pytest.raises(RuntimeError):
+            with c.admit():
+                raise RuntimeError("scoring blew up")
+        assert c.depth() == {"active": 0, "waiting": 0}
+
+    def test_sheds_are_counted_in_metrics(self):
+        metrics.register_metrics()
+        c = AdmissionController(
+            AdmissionConfig(max_concurrency=1, max_queue_depth=0)
+        )
+        before = metrics.counter_value(metrics.admission_shed)
+        with c.admit():
+            with pytest.raises(AdmissionRejected):
+                c.try_acquire()
+        assert metrics.counter_value(metrics.admission_shed) == before + 1
+
+    def test_retry_after_rides_the_exception(self):
+        c = AdmissionController(AdmissionConfig(
+            max_concurrency=1, max_queue_depth=0, retry_after_s=2.5
+        ))
+        with c.admit():
+            with pytest.raises(AdmissionRejected) as err:
+                c.try_acquire()
+        assert err.value.retry_after_s == 2.5
+        assert "2.5" in str(err.value)
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            AdmissionConfig(max_concurrency=0)
+        with pytest.raises(ValueError):
+            AdmissionConfig(max_queue_depth=-1)
+        with pytest.raises(ValueError):
+            AdmissionConfig(max_wait_s=0)
+        with pytest.raises(ValueError):
+            AdmissionConfig(retry_after_s=-1)
+
+    def test_status_shape(self):
+        c = AdmissionController()
+        status = c.status()
+        assert set(status) >= {
+            "max_concurrency", "max_queue_depth", "max_wait_s",
+            "retry_after_s", "depth", "stats",
+        }
+
+
+# -- HTTP surface -------------------------------------------------------------
+
+
+def _make_indexer():
+    from llm_d_kv_cache_manager_tpu.kvcache.indexer import (
+        Indexer,
+        IndexerConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+        TokenProcessorConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+        TokenizationPool,
+        TokenizersPoolConfig,
+    )
+
+    indexer = Indexer(
+        config=IndexerConfig(
+            token_processor_config=TokenProcessorConfig(block_size=4),
+        ),
+        tokenization_pool=TokenizationPool(
+            TokenizersPoolConfig(
+                workers=2,
+                local_tokenizer_files={TEST_MODEL_NAME: TEST_TOKENIZER_JSON},
+            ),
+        ),
+    )
+    indexer.run()
+    return indexer
+
+
+class TestHttpSurface:
+    def _service(self, **admission_cfg):
+        from llm_d_kv_cache_manager_tpu.api.http_service import (
+            ScoringService,
+        )
+
+        env = {
+            "zmq_endpoint": "tcp://*:0",
+            "zmq_topic": "kv@",
+            "pool_concurrency": 1,
+            "hash_seed": "",
+            "block_size": 4,
+            "http_port": 0,
+            "enable_metrics": False,
+        }
+        service = ScoringService(env, indexer=_make_indexer())
+        service.admission = AdmissionController(
+            AdmissionConfig(**admission_cfg)
+        )
+        return service
+
+    def test_shed_returns_429_with_retry_after(self):
+        service = self._service(
+            max_concurrency=1, max_queue_depth=0, retry_after_s=3.0
+        )
+        # Fill the only slot out-of-band: the next request must shed.
+        service.admission.try_acquire()
+
+        async def run():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            async with TestClient(TestServer(service.make_app())) as client:
+                resp = await client.post("/score_completions", json={
+                    "prompt": PROMPT, "model": TEST_MODEL_NAME,
+                })
+                assert resp.status == 429
+                assert resp.headers["Retry-After"] == "3"
+                body = await resp.json()
+                assert body["shed"] == SHED_QUEUE_FULL
+                assert body["retry_after_s"] == 3.0
+                # The batch endpoint sheds the same way.
+                resp = await client.post("/score_completions/batch", json={
+                    "requests": [
+                        {"prompt": PROMPT, "model": TEST_MODEL_NAME}
+                    ],
+                })
+                assert resp.status == 429
+
+        try:
+            asyncio.run(run())
+        finally:
+            service.admission.release()
+            service.indexer.shutdown()
+
+    def test_expired_deadline_header_sheds_as_deadline(self):
+        service = self._service(max_concurrency=4)
+
+        async def run():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            async with TestClient(TestServer(service.make_app())) as client:
+                resp = await client.post(
+                    "/score_completions",
+                    json={"prompt": PROMPT, "model": TEST_MODEL_NAME},
+                    headers={"X-Request-Deadline-Ms": "0"},
+                )
+                assert resp.status == 429
+                assert (await resp.json())["shed"] == SHED_DEADLINE
+
+        try:
+            asyncio.run(run())
+        finally:
+            service.indexer.shutdown()
+
+    def test_admitted_request_scores_normally(self):
+        service = self._service(max_concurrency=4)
+
+        async def run():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            async with TestClient(TestServer(service.make_app())) as client:
+                resp = await client.post("/score_completions", json={
+                    "prompt": PROMPT, "model": TEST_MODEL_NAME,
+                })
+                assert resp.status == 200
+                assert "podScores" in await resp.json()
+                # The gate's occupancy shows up in /readyz and
+                # /routing/status.
+                resp = await client.get("/routing/status")
+                body = await resp.json()
+                assert body["admission"]["stats"]["admitted"] == 1
+
+        try:
+            asyncio.run(run())
+        finally:
+            service.indexer.shutdown()
+
+
+# -- gRPC surface -------------------------------------------------------------
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestGrpcSurface:
+    def test_shed_is_resource_exhausted_with_retry_after_trailer(self):
+        import grpc
+
+        from llm_d_kv_cache_manager_tpu.api.grpc_server import (
+            IndexerGrpcClient,
+            serve_grpc,
+        )
+
+        indexer = _make_indexer()
+        admission = AdmissionController(AdmissionConfig(
+            max_concurrency=1, max_queue_depth=0, retry_after_s=1.5
+        ))
+        admission.try_acquire()  # fill the slot: every call sheds
+        port = _free_port()
+        server = serve_grpc(
+            indexer, f"127.0.0.1:{port}", admission=admission
+        )
+        client = IndexerGrpcClient(f"127.0.0.1:{port}")
+        try:
+            with pytest.raises(grpc.RpcError) as err:
+                client.get_pod_scores(PROMPT, TEST_MODEL_NAME)
+            assert err.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+            trailers = dict(err.value.trailing_metadata() or ())
+            assert trailers.get("retry-after-ms") == "1500"
+        finally:
+            client.close()
+            server.stop(0)
+            admission.release()
+            indexer.shutdown()
+
+    def test_deadline_expired_returns_no_signal_counted(self):
+        """The satellite pin: GetPodScoresEx aborts the scoring WORK on
+        an already-expired client deadline — no-signal out, shed counted
+        — exercised through the real deadline-check helper."""
+        from llm_d_kv_cache_manager_tpu.api import grpc_server
+
+        metrics.register_metrics()
+
+        class _ExpiredContext:
+            def time_remaining(self):
+                return 0.0
+
+        class _LiveContext:
+            def time_remaining(self):
+                return 5.0
+
+        class _NoDeadlineContext:
+            def time_remaining(self):
+                return None
+
+        before = metrics.counter_value(metrics.admission_shed)
+        assert grpc_server._deadline_expired(_ExpiredContext()) is True
+        assert metrics.counter_value(metrics.admission_shed) == before + 1
+        assert grpc_server._deadline_expired(_LiveContext()) is False
+        assert grpc_server._deadline_expired(_NoDeadlineContext()) is False
+        assert metrics.counter_value(metrics.admission_shed) == before + 1
+
+    def test_bulk_stream_sheds_surface_as_resource_exhausted(self):
+        import grpc
+
+        from llm_d_kv_cache_manager_tpu.api.grpc_server import (
+            IndexerGrpcClient,
+            serve_grpc,
+        )
+
+        indexer = _make_indexer()
+        admission = AdmissionController(AdmissionConfig(
+            max_concurrency=1, max_queue_depth=0
+        ))
+        admission.try_acquire()
+        port = _free_port()
+        server = serve_grpc(
+            indexer, f"127.0.0.1:{port}", admission=admission
+        )
+        client = IndexerGrpcClient(f"127.0.0.1:{port}")
+        try:
+            with pytest.raises(grpc.RpcError) as err:
+                client.score_pods_bulk([
+                    {"prompt": PROMPT, "model_name": TEST_MODEL_NAME},
+                    {"prompt": PROMPT, "model_name": TEST_MODEL_NAME},
+                ])
+            assert err.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        finally:
+            client.close()
+            server.stop(0)
+            admission.release()
+            indexer.shutdown()

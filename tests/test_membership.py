@@ -1,0 +1,608 @@
+"""Elastic fleet membership tests (cluster/membership.py).
+
+The load-bearing pins:
+
+- **Reassignment property (the PR's correctness core):** an event stream
+  replayed across a live partition handoff (old owner → new owner, with
+  seq floors and journal replay) yields an index bit-identical to a run
+  that was NEVER reassigned — across all four index backends. The stream
+  interleaves BlockStored and BlockRemoved, so a floor failure
+  (double-apply) would resurrect removed entries and a journal failure
+  (loss) would drop stored ones; either diverges the comparison.
+- **Warm-before-serve is structural:** a joining pod is absent from
+  `serving_pods()` until `finish_join` — the router cannot route to it
+  no matter what the index already knows.
+- **Drained departure:** `leave` quarantines the pod's index entries
+  through the fleethealth `remove_pod` path and the pod is unroutable
+  from the moment draining starts.
+"""
+
+import random
+import threading
+from types import SimpleNamespace
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.cluster import (
+    DRAINING,
+    JOINING,
+    LEFT,
+    SERVING,
+    WARMING,
+    FleetMembership,
+    MembershipConfig,
+    PartitionTable,
+    ReplicaBinding,
+    ReplicaPartitioner,
+    export_pod_view,
+)
+from llm_d_kv_cache_manager_tpu.fleethealth import (
+    FleetHealthConfig,
+    FleetHealthTracker,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.cost_aware import (
+    CostAwareIndexConfig,
+    CostAwareMemoryIndex,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+    InMemoryIndex,
+    InMemoryIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.sharded import (
+    ShardedIndex,
+    ShardedIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.events import (
+    BlockRemoved,
+    BlockStored,
+    EventBatch,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.pool import (
+    EventPool,
+    EventPoolConfig,
+    Message,
+)
+
+MODEL = "membership-model"
+BLOCK_SIZE = 4
+PODS = [f"pod-{i}" for i in range(6)]
+
+
+# -- partition table ----------------------------------------------------------
+
+
+class TestPartitionTable:
+    def test_hash_default_matches_partitioner(self):
+        table = PartitionTable(4)
+        ref = ReplicaPartitioner(4)
+        for pod in PODS + ["pod-3@dp2"]:
+            assert table.replica_for(pod) == ref.replica_for(pod)
+
+    def test_override_pause_and_clear(self):
+        table = PartitionTable(3)
+        home = table.replica_for("pod-0")
+        table.set_owner("pod-0", (home + 1) % 3)
+        assert table.replica_for("pod-0") == (home + 1) % 3
+        # DP ranks follow the base pod through overrides too.
+        assert table.replica_for("pod-0@dp1") == (home + 1) % 3
+        table.set_owner("pod-0", None)  # paused mid-handoff
+        assert table.replica_for("pod-0") is None
+        table.clear_override("pod-0")
+        assert table.replica_for("pod-0") == home
+
+    def test_gate_tracks_live_ownership(self):
+        table = PartitionTable(2)
+        msg = SimpleNamespace(pod_identifier="pod-1")
+        home = table.replica_for("pod-1")
+        assert table.gate(home)(msg)
+        assert not table.gate(1 - home)(msg)
+        table.set_owner("pod-1", 1 - home)
+        assert not table.gate(home)(msg)
+        assert table.gate(1 - home)(msg)
+        table.set_owner("pod-1", None)  # paused: NOBODY applies
+        assert not table.gate(0)(msg)
+        assert not table.gate(1)(msg)
+
+    def test_topic_filters_follow_overrides(self):
+        table = PartitionTable(2)
+        home = table.replica_for("pod-2")
+        assert "kv@pod-2@" in table.topic_filters(home, PODS)
+        table.set_owner("pod-2", 1 - home)
+        assert "kv@pod-2@" not in table.topic_filters(home, PODS)
+        assert "kv@pod-2@" in table.topic_filters(1 - home, PODS)
+
+    def test_invalid_owner_rejected(self):
+        table = PartitionTable(2)
+        with pytest.raises(ValueError):
+            table.set_owner("pod-0", 2)
+
+
+# -- membership lifecycle -----------------------------------------------------
+
+
+def _chain(head, tokens, extra=()):
+    return SimpleNamespace(
+        head=head, prefix_tokens=list(tokens), extra=tuple(extra),
+        prefix_hashes=[head], score=100.0, model_name=MODEL,
+        observations=1,
+    )
+
+
+class _FakePopularity:
+    def __init__(self, chains):
+        self._chains = chains
+
+    def hot_chains(self, threshold):
+        return [c for c in self._chains if c.score >= threshold]
+
+
+class TestLifecycle:
+    def test_warm_before_serve_gate(self):
+        warmed = []
+        mem = FleetMembership(
+            MembershipConfig(warm_top_k=2),
+            popularity=_FakePopularity(
+                [_chain(h, range(8)) for h in (1, 2, 3)]
+            ),
+            warm_submit=lambda pod, chain: warmed.append(
+                (pod, chain.head)
+            ) or True,
+        )
+        stats = mem.begin_join("pod-9")
+        # Warming: top-K jobs submitted, pod NOT routable.
+        assert stats["warm_jobs"] == 2
+        assert warmed == [("pod-9", 1), ("pod-9", 2)]
+        assert mem.phase_of("pod-9") == WARMING
+        assert "pod-9" not in mem.serving_pods()
+        mem.finish_join("pod-9")
+        assert mem.phase_of("pod-9") == SERVING
+        assert mem.serving_pods() == ["pod-9"]
+
+    def test_join_without_warm_plane_still_gates(self):
+        mem = FleetMembership(MembershipConfig(require_warm=True))
+        mem.begin_join("pod-1")
+        assert mem.phase_of("pod-1") == WARMING
+        assert mem.serving_pods() == []
+        mem.finish_join("pod-1")
+        assert mem.serving_pods() == ["pod-1"]
+
+    def test_double_join_rejected_but_rejoin_after_leave_ok(self):
+        mem = FleetMembership()
+        mem.join("pod-1")
+        with pytest.raises(ValueError):
+            mem.begin_join("pod-1")
+        mem.leave("pod-1")
+        assert mem.phase_of("pod-1") == LEFT
+        mem.join("pod-1")  # departed identities may return
+        assert mem.phase_of("pod-1") == SERVING
+
+    def test_finish_join_requires_join_in_progress(self):
+        mem = FleetMembership()
+        with pytest.raises(ValueError):
+            mem.finish_join("pod-7")
+
+    def test_bootstrap_registers_serving(self):
+        mem = FleetMembership()
+        mem.bootstrap(PODS)
+        assert mem.serving_pods() == sorted(PODS)
+
+    def test_leave_quarantines_through_fleethealth(self):
+        idx = InMemoryIndex(InMemoryIndexConfig(size=256, pod_cache_size=4))
+        processor = ChunkedTokenDatabase(
+            TokenProcessorConfig(block_size=BLOCK_SIZE)
+        )
+        pool = EventPool(
+            EventPoolConfig(concurrency=1), idx, processor
+        )
+        pool.start(with_subscriber=False)
+        try:
+            pool.add_task(_store_message("pod-1", list(range(8)), 100, 0))
+            pool.drain()
+            tracker = FleetHealthTracker(
+                FleetHealthConfig(), index=idx, clock=lambda: 0.0
+            )
+            mem = FleetMembership(fleet_health=tracker)
+            mem.bootstrap(["pod-1"])
+            out = mem.leave("pod-1")
+            assert out["purged_entries"] > 0
+            assert mem.phase_of("pod-1") == LEFT
+            assert mem.serving_pods() == []
+            # The quarantine really emptied the index of the pod.
+            view = export_pod_view(idx, "pod-1")
+            assert view.entries == []
+        finally:
+            pool.shutdown()
+
+    def test_leave_requires_serving(self):
+        mem = FleetMembership()
+        with pytest.raises(ValueError):
+            mem.leave("pod-1")
+
+    def test_phase_vocabulary_is_fixed(self):
+        # The metrics label comes from this set; a new phase must be a
+        # deliberate, reviewed change (metrics hygiene depends on it).
+        from llm_d_kv_cache_manager_tpu.cluster.membership import PHASES
+
+        assert PHASES == (
+            JOINING, WARMING, "reassigning", SERVING, DRAINING, LEFT
+        )
+
+
+# -- reassignment property (x4 backends) --------------------------------------
+
+
+def _backend_factories(fake_redis_url=None):
+    factories = {
+        "in_memory": lambda: InMemoryIndex(
+            InMemoryIndexConfig(size=4096, pod_cache_size=10)
+        ),
+        "sharded": lambda: ShardedIndex(
+            ShardedIndexConfig(size=4096, num_shards=8)
+        ),
+        "cost_aware": lambda: CostAwareMemoryIndex(
+            CostAwareIndexConfig(max_size_bytes="64MiB")
+        ),
+    }
+    if fake_redis_url is not None:
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import (
+            RedisIndex,
+            RedisIndexConfig,
+        )
+
+        factories["redis"] = lambda: RedisIndex(
+            RedisIndexConfig(url=fake_redis_url)
+        )
+    return factories
+
+
+@pytest.fixture
+def fresh_redis_factory():
+    """A factory of FRESH fake-redis servers: the reassigned run and the
+    never-reassigned reference must not share a keyspace."""
+    from tests.fake_redis import FakeRedisServer
+
+    servers = []
+
+    def make():
+        server = FakeRedisServer()
+        servers.append(server)
+        return server
+
+    yield make
+    for server in servers:
+        server.close()
+
+
+def _store_message(pod, tokens, first_engine_hash, seq, parent=None):
+    batch = EventBatch(
+        ts=0.0,
+        events=[BlockStored(
+            block_hashes=list(range(
+                first_engine_hash,
+                first_engine_hash + len(tokens) // BLOCK_SIZE,
+            )),
+            parent_block_hash=parent,
+            token_ids=list(tokens),
+            block_size=BLOCK_SIZE,
+        )],
+    )
+    return Message(
+        topic=f"kv@{pod}@{MODEL}",
+        payload=batch.to_msgpack(),
+        seq=seq,
+        pod_identifier=pod,
+        model_name=MODEL,
+    )
+
+
+def _remove_message(pod, engine_hashes, seq):
+    batch = EventBatch(
+        ts=0.0,
+        events=[BlockRemoved(block_hashes=list(engine_hashes))],
+    )
+    return Message(
+        topic=f"kv@{pod}@{MODEL}",
+        payload=batch.to_msgpack(),
+        seq=seq,
+        pod_identifier=pod,
+        model_name=MODEL,
+    )
+
+
+def _entry_set(index, pod=None):
+    """Order-free projection of an index's content: {(model, hash, pod,
+    tier)}. Recency order across differently-partitioned digestion
+    histories is not meaningful; entry content is."""
+    out = set()
+    for model_name, chunk_hash, pods in index.export_view().entries:
+        for p, tier in pods:
+            if pod is None or p.split("@")[0] == pod:
+                out.add((model_name, chunk_hash, p, tier))
+    return out
+
+
+class _Harness:
+    """Two partition-gated replicas + a journaling delivery seam."""
+
+    def __init__(self, factory, n_replicas=2):
+        self.table = PartitionTable(n_replicas)
+        self.processor = ChunkedTokenDatabase(
+            TokenProcessorConfig(block_size=BLOCK_SIZE)
+        )
+        self.indexes = [factory() for _ in range(n_replicas)]
+        self.pools = []
+        for rid in range(n_replicas):
+            pool = EventPool(
+                EventPoolConfig(concurrency=2),
+                self.indexes[rid],
+                self.processor,
+                message_filter=self.table.gate(rid),
+            )
+            pool.start(with_subscriber=False)
+            self.pools.append(pool)
+        self.journal = []
+        self.applied = {}
+        self.membership = FleetMembership(
+            table=self.table,
+            replicas=[
+                ReplicaBinding(rid, self.pools[rid], self.indexes[rid])
+                for rid in range(n_replicas)
+            ],
+            watermark_fn=lambda pod: {
+                k: v for k, v in self.applied.items() if k[0] == pod
+            },
+            journal_fn=lambda: list(self.journal),
+        )
+
+    def deliver(self, msg):
+        self.journal.append(msg)
+        self.applied[(msg.pod_identifier, msg.topic)] = msg.seq
+        for pool in self.pools:
+            pool.add_task(msg)
+
+    def drain(self):
+        for pool in self.pools:
+            pool.drain()
+
+    def shutdown(self):
+        for pool in self.pools:
+            pool.shutdown()
+
+
+def _random_stream(rng, n_messages):
+    """Interleaved BlockStored/BlockRemoved messages across PODS with
+    per-pod monotonic seqs. Removals target earlier stores on the same
+    pod — the poison for any double-apply (a replayed store would
+    resurrect them)."""
+    seqs = {pod: 0 for pod in PODS}
+    stored = {pod: [] for pod in PODS}  # engine-hash chains per pod
+    next_hash = 1000
+    out = []
+    for _ in range(n_messages):
+        pod = rng.choice(PODS)
+        seq = seqs[pod]
+        seqs[pod] += 1
+        if stored[pod] and rng.random() < 0.3:
+            chain = rng.choice(stored[pod])
+            out.append(_remove_message(pod, chain[-1:], seq))
+            chain.pop()
+            if not chain:
+                stored[pod].remove(chain)
+        else:
+            n_blocks = rng.randint(1, 5)
+            tokens = [
+                rng.randrange(1, 30_000)
+                for _ in range(BLOCK_SIZE * n_blocks)
+            ]
+            hashes = list(range(next_hash, next_hash + n_blocks))
+            next_hash += n_blocks + 10
+            out.append(_store_message(pod, tokens, hashes[0], seq))
+            stored[pod].append(hashes)
+    return out
+
+
+@pytest.mark.parametrize(
+    "backend", ["in_memory", "sharded", "cost_aware", "redis"]
+)
+def test_reassignment_bit_identical_across_backends(
+    backend, fresh_redis_factory
+):
+    """THE satellite pin: a stream replayed across a live old→new owner
+    handoff yields the same index content as a never-reassigned run."""
+    def factory():
+        if backend == "redis":
+            return _backend_factories(fresh_redis_factory().url)["redis"]()
+        return _backend_factories()[backend]()
+
+    moved = "pod-2"
+
+    rng = random.Random(1234)
+    stream = _random_stream(rng, 120)
+    cut = len(stream) // 2
+
+    # Run A (reference): ownership of `moved` sits at its FINAL home from
+    # the start; no handoff ever happens.
+    ref = _Harness(factory)
+    old_owner = ref.table.replica_for(moved)
+    new_owner = (old_owner + 1) % 2
+    ref.table.set_owner(moved, new_owner)
+    for msg in stream:
+        ref.deliver(msg)
+    ref.drain()
+
+    # Run B: hash-home ownership, handoff mid-stream.
+    b = _Harness(factory)
+    try:
+        for msg in stream[:cut]:
+            b.deliver(msg)
+        b.drain()
+        stats = b.membership.reassign_pod(moved, new_owner)
+        assert stats["from"] == old_owner and stats["to"] == new_owner
+        # The journal covered everything already applied: every replayed
+        # message for the moved pod must hit its floor.
+        assert stats["journal_replayed"] > 0
+        assert stats["replay_skipped"] == stats["journal_replayed"]
+        for msg in stream[cut:]:
+            b.deliver(msg)
+        b.drain()
+
+        # The moved pod's entries live ONLY on the new owner, and match
+        # the never-reassigned reference exactly.
+        assert _entry_set(b.indexes[old_owner], moved) == set()
+        assert _entry_set(b.indexes[new_owner], moved) == _entry_set(
+            ref.indexes[new_owner], moved
+        )
+        # Everything else is untouched by the handoff.
+        for rid in range(2):
+            assert _entry_set(b.indexes[rid]) - _entry_set(
+                b.indexes[rid], moved
+            ) == _entry_set(ref.indexes[rid]) - _entry_set(
+                ref.indexes[rid], moved
+            )
+        # Ownership table agrees with where the data is.
+        assert b.table.replica_for(moved) == new_owner
+    finally:
+        b.shutdown()
+        ref.shutdown()
+
+
+def test_reassignment_pauses_scoring_ownership():
+    """Mid-handoff the table answers None for the moved pod, so the
+    scatter-gather merge (which keys on replica_for) trusts NO replica's
+    answer — the no-signal window that makes stale scores impossible."""
+    factories = _backend_factories()
+    h = _Harness(factories["in_memory"])
+    try:
+        h.deliver(_store_message("pod-2", list(range(8)), 500, 0))
+        h.drain()
+        observed = []
+        orig_set_owner = h.table.set_owner
+
+        def spy(pod, rid):
+            observed.append(rid)
+            orig_set_owner(pod, rid)
+
+        h.table.set_owner = spy
+        h.membership.reassign_pod("pod-2", 1 - h.table.replica_for("pod-2"))
+        # Phase 1 pauses (None) strictly before phase 2 commits.
+        assert observed[0] is None
+        assert observed[-1] is not None
+    finally:
+        h.shutdown()
+
+
+def test_reassignment_counts_transitions():
+    from llm_d_kv_cache_manager_tpu.metrics import collector as metrics
+
+    metrics.register_metrics()
+    factories = _backend_factories()
+    h = _Harness(factories["in_memory"])
+    try:
+        before = metrics.counter_value(metrics.membership_transitions)
+        h.deliver(_store_message("pod-1", list(range(8)), 700, 0))
+        h.drain()
+        h.membership.reassign_pod(
+            "pod-1", 1 - h.table.replica_for("pod-1")
+        )
+        after = metrics.counter_value(metrics.membership_transitions)
+        assert after > before
+    finally:
+        h.shutdown()
+
+
+# -- warm-before-serve through the real transfer plane ------------------------
+
+
+@pytest.mark.membership
+def test_join_warms_through_data_plane_e2e():
+    """E2E warm-before-serve: a joining pod's hot prefixes land through
+    the REAL transfer plane (ready buffer / DCN peers via warm_chain)
+    before the pod enters the serving set — never by burning serving-path
+    compute on the donors."""
+    import importlib.util
+    import pathlib
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod_membership", repo / "bench.py"
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    alpha, gamma, delta, _src = bench._winning_regime_constants()
+    sim = bench.FleetSim(
+        "precise",
+        pages_per_pod=512,
+        host_tier=True,
+        host_capacity=2048,
+        alpha=alpha, gamma=gamma, delta=delta,
+        membership={"warm_top_k": 2, "warm_hotness": 0.1},
+    )
+    try:
+        rng = random.Random(9)
+        conversations = {
+            "g0-u0": " ".join(rng.choice(["alpha", "beta", "gamma", "delta"])
+                              for _ in range(400)),
+        }
+        arrival = 0.0
+        # Serve the same shared prefix a few times: the popularity
+        # tracker learns a hot chain homed on some existing pod.
+        for _ in range(4):
+            arrival += 0.2
+            prompt = conversations["g0-u0"] + " [user] question here"
+            sim.serve(arrival, prompt)
+        sim.now = arrival
+        onboarded_before = sum(
+            pod.tier_store.stats["onboards"] for pod in sim.pods
+            if pod.tier_store is not None
+        )
+        joins = sim.scale_out(1)
+        (join_stats,) = joins.values()
+        assert join_stats["warm_jobs"] >= 1
+        assert sim.warm_stats["blocks_landed"] > 0
+        # The landed blocks moved through the data plane (peer DCN
+        # onboards), not the serving path.
+        onboarded_after = sum(
+            pod.tier_store.stats["onboards"] for pod in sim.pods
+            if pod.tier_store is not None
+        )
+        assert onboarded_after > onboarded_before
+        assert sim.membership.serving_pods()[-1] == f"pod-{sim.n_pods - 1}"
+    finally:
+        sim.shutdown()
+
+
+# -- concurrency smoke --------------------------------------------------------
+
+
+def test_serving_pods_thread_safe_under_churn():
+    mem = FleetMembership()
+    mem.bootstrap(PODS)
+    stop = threading.Event()
+    errors = []
+
+    def reader():
+        while not stop.is_set():
+            try:
+                pods = mem.serving_pods()
+                assert all(isinstance(p, str) for p in pods)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for i in range(20):
+            mem.join(f"extra-{i}")
+            mem.leave(f"extra-{i}")
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+    assert not errors

@@ -27,7 +27,10 @@ from llm_d_kv_cache_manager_tpu.obs import spans as obs_spans
 #   op      — tokenizer operations (encode/render)
 #   plane   — tracing planes (read/write/transfer/other)
 #   stage   — tracing stage names (fixed by the instrumentation sites)
-ALLOWED_LABELS = {"state", "kind", "backend", "op", "plane", "stage"}
+#   phase   — fleet-membership lifecycle phases (cluster/membership.py
+#             PHASES tuple: joining/warming/reassigning/serving/
+#             draining/left)
+ALLOWED_LABELS = {"state", "kind", "backend", "op", "plane", "stage", "phase"}
 ALLOWED_PLANES = {"read", "write", "transfer", "cluster", "other"}
 
 
@@ -63,6 +66,43 @@ def test_collectors_exist():
     assert "replica_replay_lag" in collectors
     assert "replica_state_transitions" in collectors
     assert "replica_scatter_errors" in collectors
+    # Saturation resilience (admission + routing policy + membership):
+    # explicit sheds by bounded kind, queued-then-served requests, policy
+    # argmax overrides, and membership phase transitions — all inside the
+    # walk so their label bounds stay enforced.
+    assert "admission_shed" in collectors
+    assert "admission_queued" in collectors
+    assert "routing_policy_overrides" in collectors
+    assert "membership_transitions" in collectors
+
+
+def test_membership_phase_label_values_are_code_defined():
+    """The membership_transitions `phase` label must only ever carry
+    values from the fixed PHASES vocabulary (same contract as the
+    stage-label check: labels never carry traffic-derived values)."""
+    from llm_d_kv_cache_manager_tpu.cluster.membership import PHASES
+
+    metrics.register_metrics()
+    for metric in REGISTRY.collect():
+        if metric.name != "kvcache_membership_transitions":
+            continue
+        for sample in metric.samples:
+            phase = sample.labels.get("phase")
+            if phase is not None:
+                assert phase in PHASES, f"unexpected phase {phase!r}"
+
+
+def test_admission_shed_kind_values_are_code_defined():
+    from llm_d_kv_cache_manager_tpu.api.admission import SHED_KINDS
+
+    metrics.register_metrics()
+    for metric in REGISTRY.collect():
+        if metric.name != "kvcache_admission_shed":
+            continue
+        for sample in metric.samples:
+            kind = sample.labels.get("kind")
+            if kind is not None:
+                assert kind in SHED_KINDS, f"unexpected shed kind {kind!r}"
 
 
 def test_all_metrics_in_kvcache_namespace():

@@ -1,0 +1,445 @@
+"""Load-aware routing policy tests (kvcache/routing.py +
+fleethealth/load.py).
+
+The load-bearing pins:
+
+- `prefix_only` (and every degraded form: no tracker, zero weight, empty
+  map) is the IDENTITY — `adjust` returns the SAME dict object and
+  `select` returns None, so wiring the policy into the read path is
+  bit-identical to not having one.
+- `load_blend` demotes but never drops or invents score entries in
+  `adjust`; in `select` a saturated perfect-prefix pod genuinely loses
+  to an idle no-cache candidate once load crosses the blend threshold.
+- The load tracker's signals age out (stale reports) and decay
+  (preemption half-life); unknown pods read idle.
+- The kvevents seam: BlockRemoved volume digested by the event pool
+  feeds the preemption-pressure signal, observation-only.
+"""
+
+import math
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.fleethealth import (
+    PodLoad,
+    PodLoadConfig,
+    PodLoadTracker,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.routing import (
+    LOAD_BLEND,
+    PREFIX_ONLY,
+    RoutingPolicy,
+    RoutingPolicyConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.events import (
+    BlockRemoved,
+    BlockStored,
+    EventBatch,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.pool import (
+    EventPool,
+    EventPoolConfig,
+    Message,
+)
+from llm_d_kv_cache_manager_tpu.metrics import collector as metrics
+from tests.conftest import TEST_MODEL_NAME, TEST_TOKENIZER_JSON
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# -- PodLoadTracker -----------------------------------------------------------
+
+
+class TestPodLoadTracker:
+    def test_unknown_pod_reads_idle(self):
+        tracker = PodLoadTracker(clock=_Clock())
+        load = tracker.load_of("never-seen")
+        assert load == PodLoad()
+
+    def test_reports_age_out(self):
+        clock = _Clock()
+        tracker = PodLoadTracker(
+            PodLoadConfig(stale_report_after_s=10.0), clock=clock
+        )
+        tracker.report("pod-1", queue_depth=5, inflight=3, busy_until=4.0)
+        load = tracker.load_of("pod-1")
+        assert load.queue_depth == 5 and load.inflight == 3
+        assert load.busy_s == pytest.approx(4.0)
+        clock.t = 9.0
+        assert tracker.load_of("pod-1").queue_depth == 5
+        assert tracker.load_of("pod-1").busy_s == 0.0  # horizon drained
+        clock.t = 11.0
+        # The reporter went quiet: frozen load must not repel traffic.
+        assert tracker.load_of("pod-1") == PodLoad()
+
+    def test_busy_horizon_drains_by_itself(self):
+        clock = _Clock()
+        tracker = PodLoadTracker(clock=clock)
+        tracker.report("pod-1", busy_until=3.0)
+        clock.t = 2.0
+        assert tracker.load_of("pod-1").busy_s == pytest.approx(1.0)
+
+    def test_preemption_half_life_decay(self):
+        clock = _Clock()
+        tracker = PodLoadTracker(
+            PodLoadConfig(preemption_half_life_s=30.0), clock=clock
+        )
+        tracker.observe_preemption("pod-1", 8.0)
+        assert tracker.load_of("pod-1").preemption_rate == pytest.approx(8.0)
+        clock.t = 30.0
+        assert tracker.load_of("pod-1").preemption_rate == pytest.approx(
+            4.0, rel=1e-6
+        )
+        clock.t = 90.0
+        assert tracker.load_of("pod-1").preemption_rate == pytest.approx(
+            1.0, rel=1e-6
+        )
+
+    def test_removed_blocks_convert_to_preemption_equivalents(self):
+        tracker = PodLoadTracker(
+            PodLoadConfig(removed_blocks_per_preemption=64.0),
+            clock=_Clock(),
+        )
+        tracker.observe_removed_blocks("pod-1", 128)
+        assert tracker.load_of("pod-1").preemption_rate == pytest.approx(2.0)
+
+    def test_dp_ranks_fold_to_base_pod(self):
+        tracker = PodLoadTracker(clock=_Clock())
+        tracker.observe_preemption("pod-1@dp3", 2.0)
+        tracker.observe_preemption("pod-1", 1.0)
+        assert tracker.load_of("pod-1@dp0").preemption_rate == pytest.approx(
+            3.0
+        )
+
+    def test_snapshot_shape(self):
+        tracker = PodLoadTracker(clock=_Clock())
+        tracker.report("pod-2", queue_depth=1)
+        snap = tracker.snapshot()
+        assert set(snap) == {"pod-2"}
+        assert set(snap["pod-2"]) == {
+            "queue_depth", "inflight", "busy_s", "preemption_rate",
+        }
+
+    def test_invalid_half_life_rejected(self):
+        with pytest.raises(ValueError):
+            PodLoadTracker(PodLoadConfig(preemption_half_life_s=0))
+
+
+# -- policy config ------------------------------------------------------------
+
+
+class TestConfig:
+    def test_unknown_policy_rejected(self):
+        with pytest.raises(ValueError):
+            RoutingPolicyConfig(policy="weighted_coinflip")
+
+    def test_negative_weight_and_norms_rejected(self):
+        with pytest.raises(ValueError):
+            RoutingPolicyConfig(load_weight=-1)
+        with pytest.raises(ValueError):
+            RoutingPolicyConfig(queue_depth_norm=0)
+        with pytest.raises(ValueError):
+            RoutingPolicyConfig(busy_norm_s=-2)
+
+
+# -- adjust (score-map surface) -----------------------------------------------
+
+
+def _blend_policy(clock, **cfg):
+    tracker = PodLoadTracker(clock=clock)
+    defaults = dict(policy=LOAD_BLEND, load_weight=1.0)
+    defaults.update(cfg)
+    return RoutingPolicy(
+        RoutingPolicyConfig(**defaults), load_tracker=tracker
+    ), tracker
+
+
+class TestAdjust:
+    def test_prefix_only_is_identity_same_object(self):
+        policy = RoutingPolicy(RoutingPolicyConfig(policy=PREFIX_ONLY))
+        scores = {"pod-0": 3.0, "pod-1": 1.0}
+        assert policy.adjust(scores) is scores
+        assert policy.select(scores, ["pod-0", "pod-1"]) is None
+
+    def test_no_tracker_and_zero_weight_are_identity(self):
+        scores = {"pod-0": 3.0}
+        no_tracker = RoutingPolicy(RoutingPolicyConfig(policy=LOAD_BLEND))
+        assert no_tracker.adjust(scores) is scores
+        policy, _ = _blend_policy(_Clock(), load_weight=0.0)
+        assert policy.adjust(scores) is scores
+        empty = {}
+        policy2, _ = _blend_policy(_Clock())
+        assert policy2.adjust(empty) is empty
+
+    def test_demotes_loaded_never_drops(self):
+        clock = _Clock()
+        policy, tracker = _blend_policy(clock, busy_norm_s=1.0)
+        tracker.report("pod-0", busy_until=3.0)  # 3 load units
+        scores = {"pod-0": 4.0, "pod-1": 2.0}
+        out = policy.adjust(scores)
+        assert set(out) == {"pod-0", "pod-1"}  # nothing dropped
+        assert out["pod-0"] == pytest.approx(1.0)  # 4 / (1 + 3)
+        assert out["pod-1"] == pytest.approx(2.0)  # idle untouched
+        assert policy.stats["overrides"] == 1  # argmax flipped
+
+    def test_idle_fleet_changes_nothing_numerically(self):
+        policy, _ = _blend_policy(_Clock())
+        scores = {"pod-0": 4.0, "pod-1": 2.0}
+        out = policy.adjust(scores)
+        assert out == scores
+        assert policy.stats["overrides"] == 0
+
+    def test_explain_section(self):
+        clock = _Clock()
+        policy, tracker = _blend_policy(clock)
+        tracker.report("pod-0", busy_until=5.0)
+        detail = {}
+        policy.adjust({"pod-0": 4.0, "pod-1": 2.0}, _explain=detail)
+        section = detail["routing_policy"]
+        assert section["policy"] == LOAD_BLEND
+        assert section["override"] is True
+        assert section["prefix_choice"] == "pod-0"
+        assert section["blended_choice"] == "pod-1"
+
+
+# -- select (router decision) -------------------------------------------------
+
+
+class TestSelect:
+    def test_saturated_perfect_prefix_loses_to_idle_no_cache(self):
+        clock = _Clock()
+        policy, tracker = _blend_policy(clock, load_weight=0.25)
+        # pod-0 has the whole prefix but is 8 committed-seconds deep;
+        # pod-7 has nothing cached and is idle.
+        tracker.report("pod-0", busy_until=8.0)
+        chosen = policy.select(
+            {"pod-0": 10.0}, [f"pod-{i}" for i in range(8)]
+        )
+        assert chosen != "pod-0"
+        assert policy.stats["overrides"] == 1
+
+    def test_mild_load_keeps_the_cache_win(self):
+        clock = _Clock()
+        policy, tracker = _blend_policy(clock, load_weight=0.25)
+        tracker.report("pod-0", busy_until=0.5)  # 0.5 load units
+        chosen = policy.select(
+            {"pod-0": 10.0}, [f"pod-{i}" for i in range(8)]
+        )
+        assert chosen == "pod-0"
+        assert policy.stats["overrides"] == 0
+
+    def test_all_idle_reduces_to_prefix_argmax(self):
+        policy, _ = _blend_policy(_Clock())
+        chosen = policy.select(
+            {"pod-2": 5.0, "pod-1": 5.0, "pod-0": 1.0},
+            ["pod-0", "pod-1", "pod-2", "pod-3"],
+        )
+        assert chosen == "pod-1"  # max score, lexicographic-min tie-break
+
+    def test_empty_scores_selects_least_loaded(self):
+        clock = _Clock()
+        policy, tracker = _blend_policy(clock)
+        tracker.report("pod-0", busy_until=2.0)
+        tracker.report("pod-1", busy_until=1.0)
+        assert policy.select({}, ["pod-0", "pod-1"]) == "pod-1"
+
+    def test_prefix_only_returns_none(self):
+        policy = RoutingPolicy(RoutingPolicyConfig(policy=PREFIX_ONLY))
+        assert policy.select({"pod-0": 1.0}, ["pod-0"]) is None
+
+    def test_override_metric_counts(self):
+        metrics.register_metrics()
+        clock = _Clock()
+        policy, tracker = _blend_policy(clock, load_weight=1.0)
+        tracker.report("pod-0", busy_until=50.0)
+        before = metrics.counter_value(metrics.routing_policy_overrides)
+        policy.select({"pod-0": 10.0}, ["pod-0", "pod-1"])
+        after = metrics.counter_value(metrics.routing_policy_overrides)
+        assert after == before + 1
+
+
+# -- kvevents seam ------------------------------------------------------------
+
+
+MODEL = "routing-model"
+BLOCK_SIZE = 4
+
+
+def _msg(pod, events, seq):
+    return Message(
+        topic=f"kv@{pod}@{MODEL}",
+        payload=EventBatch(ts=0.0, events=events).to_msgpack(),
+        seq=seq,
+        pod_identifier=pod,
+        model_name=MODEL,
+    )
+
+
+def test_event_pool_feeds_removed_block_pressure():
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+        InMemoryIndex,
+        InMemoryIndexConfig,
+    )
+
+    clock = _Clock()
+    tracker = PodLoadTracker(
+        PodLoadConfig(removed_blocks_per_preemption=4.0), clock=clock
+    )
+    index = InMemoryIndex(InMemoryIndexConfig(size=256, pod_cache_size=4))
+    pool = EventPool(
+        EventPoolConfig(concurrency=1),
+        index,
+        ChunkedTokenDatabase(TokenProcessorConfig(block_size=BLOCK_SIZE)),
+        load_tracker=tracker,
+    )
+    pool.start(with_subscriber=False)
+    try:
+        store = BlockStored(
+            block_hashes=[1, 2], parent_block_hash=None,
+            token_ids=list(range(8)), block_size=BLOCK_SIZE,
+        )
+        pool.add_task(_msg("pod-1", [store], 0))
+        pool.add_task(_msg("pod-1", [BlockRemoved(block_hashes=[1, 2])], 1))
+        pool.drain()
+        # 2 removed blocks at 4 blocks/preemption = 0.5 equivalents.
+        assert tracker.load_of("pod-1").preemption_rate == pytest.approx(
+            0.5
+        )
+    finally:
+        pool.shutdown()
+
+
+# -- indexer integration ------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def scored_indexer_factory():
+    """An Indexer + digested events for two pods holding the same prefix
+    (pod-a the whole chain, pod-b a shorter prefix)."""
+    from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+        TokenizationPool,
+        TokenizersPoolConfig,
+    )
+
+    tok_pool = TokenizationPool(
+        TokenizersPoolConfig(
+            workers=2,
+            local_tokenizer_files={TEST_MODEL_NAME: TEST_TOKENIZER_JSON},
+        ),
+    )
+    tok_pool.run()
+
+    def make(routing_policy=None):
+        indexer = Indexer(
+            config=IndexerConfig(
+                token_processor_config=TokenProcessorConfig(
+                    block_size=BLOCK_SIZE
+                ),
+            ),
+            tokenization_pool=tok_pool,
+            routing_policy=routing_policy,
+        )
+        pool = EventPool(
+            EventPoolConfig(concurrency=1),
+            indexer.kv_block_index,
+            indexer.token_processor,
+        )
+        pool.start(with_subscriber=False)
+        prompt = "alpha bravo charlie delta echo foxtrot golf hotel"
+        tokens = indexer.tokenizers_pool.tokenize(
+            None, prompt, TEST_MODEL_NAME
+        )
+        n_blocks = len(tokens) // BLOCK_SIZE
+
+        def store(pod, depth, seq):
+            ev = BlockStored(
+                block_hashes=list(range(1, depth + 1)),
+                parent_block_hash=None,
+                token_ids=list(tokens[: depth * BLOCK_SIZE]),
+                block_size=BLOCK_SIZE,
+            )
+            pool.add_task(Message(
+                topic=f"kv@{pod}@{TEST_MODEL_NAME}",
+                payload=EventBatch(ts=0.0, events=[ev]).to_msgpack(),
+                seq=seq,
+                pod_identifier=pod,
+                model_name=TEST_MODEL_NAME,
+            ))
+
+        store("pod-a", n_blocks, 0)
+        store("pod-b", max(1, n_blocks // 2), 0)
+        pool.drain()
+        pool.shutdown()
+        return indexer, prompt
+
+    yield make
+    tok_pool.shutdown()
+
+
+def test_indexer_prefix_only_bit_identical(scored_indexer_factory):
+    bare, prompt = scored_indexer_factory(None)
+    pinned, _ = scored_indexer_factory(
+        RoutingPolicy(RoutingPolicyConfig(policy=PREFIX_ONLY))
+    )
+    s_bare = bare.get_pod_scores(prompt, TEST_MODEL_NAME, [])
+    s_pinned = pinned.get_pod_scores(prompt, TEST_MODEL_NAME, [])
+    assert s_bare == s_pinned
+    assert s_bare  # the comparison is not vacuous
+
+
+def test_indexer_load_blend_demotes_through_read_path(
+    scored_indexer_factory,
+):
+    clock = _Clock()
+    tracker = PodLoadTracker(clock=clock)
+    policy = RoutingPolicy(
+        RoutingPolicyConfig(policy=LOAD_BLEND, load_weight=1.0),
+        load_tracker=tracker,
+    )
+    indexer, prompt = scored_indexer_factory(policy)
+    baseline = dict(indexer.get_pod_scores(prompt, TEST_MODEL_NAME, []))
+    tracker.report("pod-a", busy_until=4.0)  # 4 load units
+    blended = indexer.get_pod_scores(prompt, TEST_MODEL_NAME, [])
+    assert blended["pod-a"] == pytest.approx(baseline["pod-a"] / 5.0)
+    assert blended["pod-b"] == pytest.approx(baseline["pod-b"])
+
+
+def test_explain_scores_carries_routing_section(scored_indexer_factory):
+    clock = _Clock()
+    tracker = PodLoadTracker(clock=clock)
+    policy = RoutingPolicy(
+        RoutingPolicyConfig(policy=LOAD_BLEND), load_tracker=tracker
+    )
+    indexer, prompt = scored_indexer_factory(policy)
+    tracker.report("pod-a", busy_until=9.0)
+    report = indexer.explain_scores(prompt, TEST_MODEL_NAME, [])
+    assert "routing_policy" in report
+    assert report["routing_policy"]["policy"] == LOAD_BLEND
+
+
+def test_status_surface():
+    clock = _Clock()
+    policy, tracker = _blend_policy(clock)
+    tracker.report("pod-9", queue_depth=2)
+    status = policy.status()
+    assert status["policy"] == LOAD_BLEND
+    assert "pod-9" in status["loads"]
+    assert status["stats"] == {"adjusted_requests": 0, "overrides": 0}
+
+
+def test_decay_math_is_half_life():
+    # The λ the tracker derives must BE ln2/half_life (a silent formula
+    # drift would skew every preemption signal).
+    tracker = PodLoadTracker(PodLoadConfig(preemption_half_life_s=10.0))
+    assert tracker._lambda == pytest.approx(math.log(2.0) / 10.0)
