@@ -4,8 +4,8 @@
 
 .PHONY: native kvtransfer test bench bench-micro bench-read bench-obs \
 	bench-batch bench-faults bench-replication bench-placement \
-	bench-autoscale bench-transfer clean proto lint precommit-install \
-	image-build image-push
+	bench-autoscale bench-geo bench-transfer clean proto lint \
+	precommit-install image-build image-push
 
 # Container image coordinates (override per environment/registry). The
 # release workflow (.github/workflows/ci-release.yaml) builds the same
@@ -109,6 +109,14 @@ bench-placement: kvtransfer
 # benchmarking/FLEET_BENCH_AUTOSCALE.json.
 bench-autoscale: kvtransfer
 	JAX_PLATFORMS=cpu python bench.py --autoscale
+
+# Hierarchical-federation geo scenario (federation/): home-pinned sessions
+# with diurnal skew across regions, one region lost mid-replay; flat global
+# fleet vs two-level federated routing (digest shipping, staleness failover,
+# cross-region hot-prefix warming). Headless; rewrites
+# benchmarking/FLEET_BENCH_GEO.json.
+bench-geo: kvtransfer
+	JAX_PLATFORMS=cpu python bench.py --geo
 
 # Transfer-plane legs (CI-smoke sizes, printed only): async-offload
 # dispatch vs sync stage, batched-vs-serial multi-block DCN fetch, inflight

@@ -2239,6 +2239,611 @@ def main_placement(args):
     }))
 
 
+# -- hierarchical federation scenario (--geo; federation/) --------------------
+# A geo-distributed fleet: GEO_REGIONS regions × GEO_PODS_PER_REGION pods,
+# sessions home-pinned with diurnal skew (workloads/geo.py), one region
+# lost mid-replay. Two arms over the SAME trace:
+#
+# - "flat_global": one fleet of all pods behind one precise index — the
+#   deployment today's control plane would run. Routing ignores geography,
+#   so session prefixes migrate between regions and every peer onboard
+#   that crosses a region boundary is WAN traffic (attributed at the peer-
+#   resolver seam); region loss leaves phantom placements the router must
+#   discover by retry.
+# - "federation": region-local precise fleets under a GlobalRouter
+#   (federation/): region pick by sketch affinity over shipped digests,
+#   precise scoring inside the region, hot prefixes replicated cross-
+#   region through the warm_chain admission seam, digest staleness
+#   detecting the loss and rendezvous failover re-homing its sessions.
+#
+# Cross-region bytes are the honest comparison: the flat arm pays per-
+# onboard KV bytes; federation pays digest bytes + proactive warm bytes.
+GEO_REGIONS = 3
+GEO_PODS_PER_REGION = 4
+GEO_SESSIONS = 220
+GEO_SESSION_RATE = 2.4
+GEO_DAY_PERIOD_S = 120.0
+GEO_AMPLITUDE = 0.85
+GEO_PREFIX_WORDS = 900
+GEO_PREFIXES_PER_REGION = 2
+GEO_MAX_TURNS = 5
+GEO_PAGES_PER_POD = 384
+GEO_HOST_CAPACITY = 512
+# Region lost mid-replay, at this fraction of the trace span.
+GEO_LOST_REGION = "region-1"
+GEO_LOSS_AT_FRAC = 0.55
+# Pre-loss hit-rate window length (seconds of sim time before the loss).
+GEO_PRELOSS_WINDOW_S = 60.0
+# Digest cadence + staleness windows (sim time). Detection time is
+# bounded by stale_after + one interval; the bench reports the measured
+# value next to the configured windows.
+GEO_DIGEST_INTERVAL_S = 4.0
+GEO_DIGEST_SUSPECT_S = 8.0
+GEO_DIGEST_STALE_S = 12.0
+# Cross-region hot-chain admission: decayed-score threshold + cooldown
+# (federation/region.py knobs), and how much slower the WAN is than the
+# intra-region DCN the delta constant models.
+GEO_WARM_THRESHOLD = 8.0
+GEO_WARM_COOLDOWN_S = 120.0
+GEO_CROSS_DELTA_MULT = 4.0
+GEO_DIGEST_HOT_K = 6
+GEO_MAX_PREFIX_BLOCKS = 24
+GEO_SKETCH_WIDTH = 1024
+GEO_HALF_LIFE_S = 60.0
+GEO_LOAD_NORM = 4.0
+
+
+def _geo_kv_block_bytes() -> int:
+    """KV bytes of one PAGE_SIZE-token block in the winning-regime model
+    class (the same wide-MQA int8 shape every placement/transfer number
+    uses) — the unit every cross-region byte column is priced in."""
+    from llm_d_kv_cache_manager_tpu.engine import costs as costs_mod
+    from llm_d_kv_cache_manager_tpu.models.llama import LlamaConfig
+
+    wide = LlamaConfig(
+        vocab_size=32768, d_model=8192, n_layers=4, n_q_heads=64,
+        n_kv_heads=1, head_dim=128, d_ff=28672,
+    )
+    return costs_mod.kv_bytes_per_token(wide, quantized=True) * PAGE_SIZE
+
+
+def build_geo_trace(seed: int = 42):
+    from llm_d_kv_cache_manager_tpu.workloads import GeoConfig, generate_geo
+
+    return generate_geo(GeoConfig(
+        n_regions=GEO_REGIONS,
+        n_sessions=GEO_SESSIONS,
+        seed=seed,
+        day_period_s=GEO_DAY_PERIOD_S,
+        diurnal_amplitude=GEO_AMPLITUDE,
+        session_rate_per_s=GEO_SESSION_RATE,
+        prefixes_per_region=GEO_PREFIXES_PER_REGION,
+        prefix_words=GEO_PREFIX_WORDS,
+        max_turns=GEO_MAX_TURNS,
+    ))
+
+
+def _geo_region_of_pod(pod_idx: int) -> str:
+    return f"region-{pod_idx // GEO_PODS_PER_REGION}"
+
+
+class _RegionAccountingResolver:
+    """Peer-resolver wrapper attributing peer fetches to intra- vs
+    cross-region pairs (flat arm). The tiering store resolves a block
+    more than once per fetch (source gating + run batching), so each
+    (destination pod, block) pair is counted ONCE — an undercount when
+    eviction forces the same block to re-onboard later, which is the
+    conservative direction for the flat arm's cross-region column."""
+
+    def __init__(self, inner, addr_to_pod, self_pod_idx, counters):
+        self.inner = inner
+        self.addr_to_pod = addr_to_pod
+        self.self_region = _geo_region_of_pod(self_pod_idx)
+        self.counters = counters
+        self._seen = set()
+
+    def __call__(self, chunk_hash):
+        addr = self.inner(chunk_hash)
+        if addr is not None and chunk_hash not in self._seen:
+            self._seen.add(chunk_hash)
+            src = self.addr_to_pod.get(tuple(addr))
+            if src is not None:
+                if _geo_region_of_pod(src) == self.self_region:
+                    self.counters["intra_region_blocks"] += 1
+                else:
+                    self.counters["cross_region_blocks"] += 1
+        return addr
+
+
+def _geo_spread_router(sim):
+    """Precise routing with an UNBIASED tie-break, for both geo arms.
+
+    FleetSim.route's historical tie-breaks resolve equal scores (and the
+    no-signal fallback) to the lowest pod index — invisible in the
+    committed single-fleet arms, but in a geography-labeled fleet it
+    plants every consolidation point in "region-0" by construction. A
+    real fleet's balancer has no favorite pod: ties break by
+    (least-loaded, per-(request, pod) rendezvous hash), so placement is
+    deterministic yet spread. Same argmax, same precision — only exact
+    ties differ."""
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.hashing import fnv64a
+
+    def route(prompt):
+        head = prompt[:80].encode("utf-8", "ignore")
+
+        def spread_key(i):
+            return (sim.pod_free_at[i], fnv64a(b"%d:" % i + head))
+
+        scores = sim.indexer.get_pod_scores(prompt, MODEL, [])
+        if sim._crashed and scores and any(
+            int(p.split("-")[1]) in sim._crashed for p in scores
+        ):
+            sim.phantom_scores.append(sim.now)
+        if scores:
+            best = max(scores.values())
+            cands = [
+                int(p.split("-")[1]) for p, s in scores.items()
+                if s == best
+            ]
+            return min(cands, key=spread_key)
+        return min(sim._alive_pods(), key=spread_key)
+
+    return route
+
+
+def _geo_hit_windows(records, loss_at_s, post_from_s):
+    """(pre_loss_hit, post_failover_hit) token-weighted hit rates: pre =
+    [loss - GEO_PRELOSS_WINDOW_S, loss), post = [post_from_s, end]."""
+    def rate(lo, hi):
+        hit = tot = 0
+        for arrival, _ttft, h, t in records:
+            if lo <= arrival < hi:
+                hit += h
+                tot += t
+        return hit / max(tot, 1)
+
+    return (
+        rate(loss_at_s - GEO_PRELOSS_WINDOW_S, loss_at_s),
+        rate(post_from_s, float("inf")),
+    )
+
+
+def run_geo_flat(requests, loss_at_s):
+    """Flat-global arm: one precise fleet of every pod, data plane on.
+    Region loss = the region's pods crash (phantom placements stay in the
+    global index; the router discovers them by retry)."""
+    from llm_d_kv_cache_manager_tpu.engine.tiering import (
+        IndexBackedPeerResolver,
+    )
+
+    alpha, gamma, delta, _src = _winning_regime_constants()
+    n_pods = GEO_REGIONS * GEO_PODS_PER_REGION
+    sim = FleetSim(
+        "precise",
+        n_pods=n_pods,
+        pages_per_pod=GEO_PAGES_PER_POD,
+        host_tier=True,
+        host_capacity=GEO_HOST_CAPACITY,
+        alpha=alpha, gamma=gamma, delta=delta,
+    )
+    sim.route_override = _geo_spread_router(sim)
+    counters = {"intra_region_blocks": 0, "cross_region_blocks": 0}
+    addr_to_pod = {
+        tuple(pod.transfer_address): i for i, pod in enumerate(sim.pods)
+    }
+    for i, pod in enumerate(sim.pods):
+        pod.set_peer_resolver(_RegionAccountingResolver(
+            IndexBackedPeerResolver(
+                sim.indexer.kv_block_index, MODEL, sim._addrs, f"pod-{i}",
+            ),
+            addr_to_pod, i, counters,
+        ))
+    records = []
+    out_of_home = 0
+    lost = False
+    lost_idx = int(GEO_LOST_REGION.split("-")[1])
+    try:
+        for req in requests:
+            if not lost and req.arrival_s >= loss_at_s:
+                for i in range(
+                    lost_idx * GEO_PODS_PER_REGION,
+                    (lost_idx + 1) * GEO_PODS_PER_REGION,
+                ):
+                    sim._crashed.add(i)
+                    sim.pod_active[i] = []
+                    # A lost region is UNREACHABLE, not just unroutable:
+                    # its transfer servers are gone with it, so the data
+                    # plane cannot onboard from its pods (the index's
+                    # phantom entries resolve to nothing). Mutating the
+                    # shared addr map severs every resolver at once.
+                    sim._addrs.pop(f"pod-{i}", None)
+                lost = True
+            h0, t0 = sim.hit_tokens, sim.total_tokens
+            ttft = sim.serve(
+                req.arrival_s, req.prompt, response_words=req.output_len
+            )
+            records.append((
+                req.arrival_s, ttft,
+                sim.hit_tokens - h0, sim.total_tokens - t0,
+            ))
+            if (
+                req.region is not None
+                and _geo_region_of_pod(sim.last_pod_idx) != req.region
+            ):
+                out_of_home += 1
+        pre_hit, post_hit = _geo_hit_windows(records, loss_at_s, loss_at_s)
+        block_bytes = _geo_kv_block_bytes()
+        return records, {
+            "prefix_hit_rate": round(
+                sim.hit_tokens / max(sim.total_tokens, 1), 4
+            ),
+            "pre_loss_hit_rate": round(pre_hit, 4),
+            "post_loss_hit_rate": round(post_hit, 4),
+            "cross_region_fetch_blocks": counters["cross_region_blocks"],
+            "cross_region_fetch_bytes": (
+                counters["cross_region_blocks"] * block_bytes
+            ),
+            "intra_region_fetch_blocks": counters["intra_region_blocks"],
+            "onboarded_blocks": sim.onboarded_blocks,
+            "restored_blocks": sim.restored_blocks,
+            "preemptions": sim.preemptions,
+            "out_of_home_requests": out_of_home,
+            "stale_routes_after_loss": len(sim.stale_routes),
+            "phantom_score_offers": len(sim.phantom_scores),
+        }
+    finally:
+        sim.shutdown()
+
+
+def run_geo_federation(requests, loss_at_s):
+    """Federation arm: GEO_REGIONS region-local fleets under one
+    GlobalRouter. Digests ship every GEO_DIGEST_INTERVAL_S of sim time;
+    hot chains replicate cross-region through warm_chain-style admission
+    (prefill + free on the target, charged at WAN rate); losing a region
+    silences its digests — staleness detection + rendezvous failover."""
+    from llm_d_kv_cache_manager_tpu.federation import (
+        FederationConfig,
+        GlobalRouter,
+        Region,
+        encode_digest,
+    )
+    from llm_d_kv_cache_manager_tpu.placement import (
+        ChainPopularityTracker,
+        PopularityConfig,
+    )
+
+    alpha, gamma, delta, _src = _winning_regime_constants()
+    block_bytes = _geo_kv_block_bytes()
+    region_names = [f"region-{r}" for r in range(GEO_REGIONS)]
+
+    class _GeoClock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clock = _GeoClock()
+    sims = {}
+    trackers = {}
+    for name in region_names:
+        sim = FleetSim(
+            "precise",
+            n_pods=GEO_PODS_PER_REGION,
+            pages_per_pod=GEO_PAGES_PER_POD,
+            host_tier=True,
+            host_capacity=GEO_HOST_CAPACITY,
+            alpha=alpha, gamma=gamma, delta=delta,
+        )
+        tracker = ChainPopularityTracker(
+            PopularityConfig(
+                half_life_s=GEO_HALF_LIFE_S,
+                top_k=GEO_DIGEST_HOT_K * 4,
+                max_prefix_blocks=GEO_MAX_PREFIX_BLOCKS,
+                # Digest economy: the shipped sketch is the digest's bulk
+                # (rows x width cells). 1024x4 keeps collision rates
+                # negligible at this fleet's chain count while a digest
+                # stays sketch-sized on the WAN — the honest digest-
+                # bytes/s column prices exactly this choice.
+                sketch_width=GEO_SKETCH_WIDTH,
+            ),
+            clock=clock,
+        )
+        sim.indexer.popularity = tracker
+        sim.route_override = _geo_spread_router(sim)
+        sims[name] = sim
+        trackers[name] = tracker
+
+    warm_stats = {"jobs": 0, "blocks": 0, "bytes": 0, "charged_s": 0.0}
+    lost_state = {"lost": False}
+
+    def make_warm_fn(region_name):
+        sim = sims[region_name]
+
+        def warm_fn(chain):
+            # Cross-region admission: the chain's prefix KV ships over
+            # the WAN and lands through the target's normal allocate
+            # path (BlockStored emitted -> the region's index learns the
+            # replica), charged to the target pod at WAN rate. Serving
+            # always wins: OutOfPagesError = no replication this tick.
+            if lost_state["lost"] and region_name == GEO_LOST_REGION:
+                return 0
+            tokens = list(chain.prefix_tokens)
+            if not tokens:
+                return 0
+            # Rendezvous target inside the region (same ranking the
+            # placement replicator uses fleet-wide).
+            from llm_d_kv_cache_manager_tpu.kvcache.kvblock.hashing import (
+                fnv64a,
+            )
+
+            i = max(
+                range(sim.n_pods),
+                key=lambda j: fnv64a(
+                    b"%d:pod-%d" % (chain.head, j)
+                ),
+            )
+            pod = sim.pods[i]
+            lora = chain.extra[0] if chain.extra else None
+            try:
+                state, cached = pod.prefill(tokens, lora_id=lora)
+            except OutOfPagesError:
+                return 0
+            uncached = max(len(tokens) - cached, 0)
+            blocks = uncached // PAGE_SIZE
+            pod.free(state)
+            if blocks:
+                cost = delta * GEO_CROSS_DELTA_MULT * uncached
+                sim.pod_free_at[i] = max(
+                    sim.pod_free_at[i], clock.t
+                ) + cost
+                warm_stats["jobs"] += 1
+                warm_stats["blocks"] += blocks
+                warm_stats["bytes"] += blocks * block_bytes
+                warm_stats["charged_s"] += cost
+            sim.event_pool.drain()
+            return blocks
+
+        return warm_fn
+
+    fed_config = FederationConfig(
+        region_id=region_names[0],
+        regions=region_names,
+        digest_interval_s=GEO_DIGEST_INTERVAL_S,
+        digest_suspect_after_s=GEO_DIGEST_SUSPECT_S,
+        digest_stale_after_s=GEO_DIGEST_STALE_S,
+        digest_hot_k=GEO_DIGEST_HOT_K,
+        digest_max_prefix_blocks=GEO_MAX_PREFIX_BLOCKS,
+        replicate_score_threshold=GEO_WARM_THRESHOLD,
+        replicate_cooldown_s=GEO_WARM_COOLDOWN_S,
+    )
+    regions = {
+        name: Region(
+            name,
+            sims[name].indexer,
+            tracker=trackers[name],
+            pods_fn=(
+                lambda name=name: [
+                    f"pod-{i}" for i in sims[name]._alive_pods()
+                ]
+            ),
+            load_fn=(
+                lambda name=name: sum(
+                    len(a) for a in sims[name].pod_active
+                ) / (sims[name].n_pods * GEO_LOAD_NORM)
+            ),
+            warm_fn=make_warm_fn(name),
+        )
+        for name in region_names
+    }
+    router = GlobalRouter(
+        fed_config, regions, clock=clock,
+    )
+
+    def derive(prompt):
+        # Derivation is region-independent (same model/config everywhere);
+        # use any live region's pipeline.
+        name = region_names[0]
+        if lost_state["lost"] and name == GEO_LOST_REGION:
+            name = region_names[-1]
+        indexer = sims[name].indexer
+        tokens = indexer.tokenizers_pool.tokenize(None, prompt, MODEL)
+        keys = indexer.token_processor.tokens_to_kv_block_keys(
+            None, tokens, MODEL
+        )
+        return [k.chunk_hash for k in keys]
+
+    records = []
+    digest_bytes = 0
+    digest_ships = 0
+    lost_region_retries = 0
+    detection_at = None
+    next_digest = 0.0
+    picked_by_region = {name: 0 for name in region_names}
+    try:
+        for req in requests:
+            now = req.arrival_s
+            clock.t = now
+            if not lost_state["lost"] and now >= loss_at_s:
+                lost_state["lost"] = True
+            if now >= next_digest:
+                for name in region_names:
+                    if lost_state["lost"] and name == GEO_LOST_REGION:
+                        continue  # a lost region ships nothing
+                    sims[name].now = now
+                    data = encode_digest(
+                        regions[name].build_digest(fed_config, now=now)
+                    )
+                    digest_bytes += len(data)
+                    digest_ships += 1
+                    router.ingest_digest(data, now=now)
+                next_digest = now + GEO_DIGEST_INTERVAL_S
+            if (
+                detection_at is None
+                and lost_state["lost"]
+                and router.failover.state_of(GEO_LOST_REGION) == "stale"
+            ):
+                detection_at = now
+            picked, _detail = router.pick_region(
+                derive(req.prompt), home_region=req.region, now=now
+            )
+            if lost_state["lost"] and picked == GEO_LOST_REGION:
+                # Pre-detection window: the router still trusts the lost
+                # region's last digest; the scoring call fails and the
+                # request retries its rendezvous failover — the timeout+
+                # retry staleness detection exists to remove.
+                lost_region_retries += 1
+                picked = router.failover.failover_region(
+                    picked, exclude=[GEO_LOST_REGION]
+                ) or region_names[0]
+            picked_by_region[picked] += 1
+            sim = sims[picked]
+            h0, t0 = sim.hit_tokens, sim.total_tokens
+            ttft = sim.serve(
+                now, req.prompt, response_words=req.output_len
+            )
+            records.append((
+                now, ttft, sim.hit_tokens - h0, sim.total_tokens - t0,
+            ))
+        total_hit = sum(s.hit_tokens for s in sims.values())
+        total_tokens = sum(s.total_tokens for s in sims.values())
+        post_from = detection_at if detection_at is not None else loss_at_s
+        pre_hit, post_hit = _geo_hit_windows(records, loss_at_s, post_from)
+        _, post_loss_hit = _geo_hit_windows(records, loss_at_s, loss_at_s)
+        duration = max(records[-1][0], 1e-9)
+        status = router.status(now=clock.t)
+        return records, {
+            "prefix_hit_rate": round(total_hit / max(total_tokens, 1), 4),
+            "pre_loss_hit_rate": round(pre_hit, 4),
+            "post_failover_hit_rate": round(post_hit, 4),
+            "post_loss_hit_rate": round(post_loss_hit, 4),
+            "cross_region_fetch_bytes": digest_bytes + warm_stats["bytes"],
+            "digest_bytes_shipped": digest_bytes,
+            "digest_bytes_per_s": round(digest_bytes / duration, 1),
+            "digests_shipped": digest_ships,
+            "warm_jobs": warm_stats["jobs"],
+            "warm_blocks": warm_stats["blocks"],
+            "warm_bytes": warm_stats["bytes"],
+            "warm_charged_s": round(warm_stats["charged_s"], 4),
+            "detection_s": (
+                round(detection_at - loss_at_s, 3)
+                if detection_at is not None else None
+            ),
+            "lost_region_retries": lost_region_retries,
+            "mispicked_regions": router.stats_counters[
+                "mispicked_regions"
+            ],
+            "routed_by_region": picked_by_region,
+            "failovers": router.failover.failovers,
+            "preemptions": sum(s.preemptions for s in sims.values()),
+            "onboarded_blocks": sum(
+                s.onboarded_blocks for s in sims.values()
+            ),
+            "lost_region_state": status["regions"][GEO_LOST_REGION][
+                "state"
+            ],
+        }
+    finally:
+        for sim in sims.values():
+            sim.shutdown()
+
+
+def main_geo(args):
+    """--geo: the hierarchical-federation comparison. Writes
+    benchmarking/FLEET_BENCH_GEO.json."""
+    from llm_d_kv_cache_manager_tpu.kv_connectors.connector import (
+        native_available,
+    )
+
+    if not native_available():
+        print(json.dumps({
+            "metric": "geo_cross_region_bytes_ratio",
+            "value": None,
+            "skipped": "libkvtransfer.so not built (make kvtransfer)",
+        }))
+        return
+
+    t_start = time.time()
+    trace = build_geo_trace(seed=args.seed)
+    requests = trace.requests()
+    span = requests[-1].arrival_s
+    loss_at_s = round(span * GEO_LOSS_AT_FRAC, 3)
+    region_sessions = {}
+    for region in trace.session_regions.values():
+        region_sessions[region] = region_sessions.get(region, 0) + 1
+
+    flat_records, flat = run_geo_flat(requests, loss_at_s)
+    fed_records, fed = run_geo_federation(requests, loss_at_s)
+
+    flat_ttfts = [r[1] for r in flat_records]
+    fed_ttfts = [r[1] for r in fed_records]
+    flat["ttft_p50_s"] = round(p50(flat_ttfts), 4)
+    flat["ttft_p90_s"] = round(p90(flat_ttfts), 4)
+    fed["ttft_p50_s"] = round(p50(fed_ttfts), 4)
+    fed["ttft_p90_s"] = round(p90(fed_ttfts), 4)
+
+    retention = fed["post_failover_hit_rate"] / max(
+        fed["pre_loss_hit_rate"], 1e-9
+    )
+    bytes_ratio = fed["cross_region_fetch_bytes"] / max(
+        flat["cross_region_fetch_bytes"], 1
+    )
+    stats = {
+        "config": {
+            "workload": "geo-sharegpt (workloads/geo.py): home-pinned "
+                        "sessions, diurnal skew, one region lost "
+                        "mid-replay",
+            "n_regions": GEO_REGIONS,
+            "pods_per_region": GEO_PODS_PER_REGION,
+            "n_sessions": GEO_SESSIONS,
+            "requests": len(requests),
+            "sessions_per_region": region_sessions,
+            "day_period_s": GEO_DAY_PERIOD_S,
+            "diurnal_amplitude": GEO_AMPLITUDE,
+            "prefix_words": GEO_PREFIX_WORDS,
+            "prefixes_per_region": GEO_PREFIXES_PER_REGION,
+            "pages_per_pod": GEO_PAGES_PER_POD,
+            "host_capacity_blocks": GEO_HOST_CAPACITY,
+            "seed": args.seed,
+            "lost_region": GEO_LOST_REGION,
+            "loss_at_s": loss_at_s,
+            "trace_span_s": round(span, 3),
+            "kv_block_bytes": _geo_kv_block_bytes(),
+            "digest_interval_s": GEO_DIGEST_INTERVAL_S,
+            "digest_suspect_after_s": GEO_DIGEST_SUSPECT_S,
+            "digest_stale_after_s": GEO_DIGEST_STALE_S,
+            "warm_threshold": GEO_WARM_THRESHOLD,
+            "warm_cooldown_s": GEO_WARM_COOLDOWN_S,
+            "cross_delta_mult": GEO_CROSS_DELTA_MULT,
+            "model_class": "wide MQA + int8 KV (winning regime, shared "
+                           "with the placement scenario)",
+        },
+        "arms": {"flat_global": flat, "federation": fed},
+        # Acceptance: federation ships fewer cross-region bytes than the
+        # flat fleet's peer onboards AND retains >=80% of the pre-loss
+        # hit rate after failover, with detection time reported.
+        "cross_region_bytes_ratio": round(bytes_ratio, 4),
+        "hit_rate_retention_after_failover": round(retention, 4),
+        "detection_s": fed["detection_s"],
+        "wall_s": round(time.time() - t_start, 1),
+    }
+    print(json.dumps(stats), file=sys.stderr)
+    artifact = {k: v for k, v in stats.items() if k != "wall_s"}
+    out = os.path.join(REPO, "benchmarking", "FLEET_BENCH_GEO.json")
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({
+        "metric": "geo_hit_rate_retention_after_failover",
+        "value": round(retention, 4),
+        # Target: >=0.8 of the pre-loss hit rate after failover.
+        "vs_baseline": round(retention / 0.8, 3),
+        "unit": "fraction",
+        "cross_region_bytes_ratio_vs_flat": round(bytes_ratio, 4),
+        "detection_s": fed["detection_s"],
+        "source": "benchmarking/FLEET_BENCH_GEO.json",
+    }))
+
+
 def main_cluster_check(args):
     """--cluster-replicas N: route the synthetic headline precise arm
     through a ClusterScorer scatter-gather over N partition-gated local
@@ -3120,6 +3725,13 @@ def parse_args(argv=None):
              "benchmarking/FLEET_BENCH_AUTOSCALE.json",
     )
     ap.add_argument(
+        "--geo", action="store_true",
+        help="run the hierarchical-federation scenario (federation/): "
+             "home-pinned sessions with diurnal skew across regions, one "
+             "region lost mid-replay; flat global fleet vs two-level "
+             "federated routing, writing benchmarking/FLEET_BENCH_GEO.json",
+    )
+    ap.add_argument(
         "--replication", action="store_true",
         help="run the indexer kill-and-restart scenario (FaultPlan "
              "indexer_crash) over the ShareGPT replay: cold restart vs "
@@ -3133,6 +3745,8 @@ if __name__ == "__main__":
     _args = parse_args()
     if _args.placement:
         main_placement(_args)
+    elif _args.geo:
+        main_geo(_args)
     elif _args.autoscale:
         main_autoscale(_args)
     elif _args.batch_window > 0:

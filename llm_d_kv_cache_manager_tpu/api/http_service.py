@@ -39,6 +39,18 @@ the ZMQ KVEvents write plane, and Prometheus metrics behind HTTP:
   POST /cluster/snapshot        drain + write this replica's index
                                 snapshot (view + seq watermarks) to
                                 CLUSTER_SNAPSHOT_PATH
+  GET  /federation/status       federation introspection: per-region
+                                digest age + staleness state (healthy/
+                                suspect/stale), stale regions, route/
+                                failover/digest counters
+  GET/POST /federation/score    two-level scoring entry: region pick over
+                                shipped digests, precise delegation, pod
+                                scores + region decision evidence
+                                (same params as /score_completions plus
+                                optional home_region)
+  GET/POST /federation/digest   the digest shipping seam: GET builds this
+                                region's encoded RegionDigest; POST
+                                ingests a peer's
   GET  /debug/traces            flight recorder dump: recent complete
                                 traces + the slow-outlier reservoir
                                 (?n=<count> caps the recent list)
@@ -59,9 +71,12 @@ admission gate ADMISSION / ADMISSION_MAX_CONCURRENCY /
 ADMISSION_QUEUE_DEPTH / ADMISSION_MAX_WAIT_MS / ADMISSION_RETRY_AFTER_MS
 (scoring endpoints shed with 429 + Retry-After past the bounds; the
 client's remaining budget propagates via the X-Request-Deadline-Ms
-header), and the load-aware routing policy ROUTING_POLICY /
+header), the load-aware routing policy ROUTING_POLICY /
 ROUTING_LOAD_WEIGHT / ROUTING_QUEUE_NORM / ROUTING_BUSY_NORM_S /
-ROUTING_PREEMPTION_NORM.
+ROUTING_PREEMPTION_NORM, and the federation tier FEDERATION /
+FEDERATION_REGION_ID / FEDERATION_REGIONS / FEDERATION_PEERS /
+FEDERATION_DIGEST_INTERVAL_S / FEDERATION_DIGEST_SUSPECT_S /
+FEDERATION_DIGEST_STALE_S.
 
 Run: python -m llm_d_kv_cache_manager_tpu.api.http_service
 """
@@ -159,6 +174,31 @@ def config_from_env() -> dict:
         ),
         "placement_hotness": float(
             os.environ.get("PLACEMENT_HOTNESS", "30")
+        ),
+        # Hierarchical federation (federation/): FEDERATION=1 attaches a
+        # GlobalRouter over this region's indexer (+ the popularity
+        # tracker the digests ship) and opens the /federation/* surface.
+        # Peers are other regions' scoring fronts ("region=host:port",
+        # reached over the same gRPC transport the cluster scatter-gather
+        # uses). Single-region (no FEDERATION_REGIONS) stays pinned
+        # bit-identical to the flat read path.
+        "federation": os.environ.get("FEDERATION", "0") == "1",
+        "federation_region_id": os.environ.get(
+            "FEDERATION_REGION_ID", "region-0"
+        ),
+        "federation_regions": [
+            r for r in os.environ.get("FEDERATION_REGIONS", "").split(",")
+            if r
+        ],
+        "federation_peers": os.environ.get("FEDERATION_PEERS", ""),
+        "federation_digest_interval_s": float(
+            os.environ.get("FEDERATION_DIGEST_INTERVAL_S", "5")
+        ),
+        "federation_digest_suspect_s": float(
+            os.environ.get("FEDERATION_DIGEST_SUSPECT_S", "15")
+        ),
+        "federation_digest_stale_s": float(
+            os.environ.get("FEDERATION_DIGEST_STALE_S", "45")
         ),
         # Admission control (api/admission.py): bounded concurrency +
         # bounded waiting line on the scoring endpoints; past the bounds
@@ -402,6 +442,84 @@ class ScoringService:
                 index = index.inner
             if hasattr(index, "bind_popularity"):  # cost-aware backend
                 index.bind_popularity(self.popularity)
+
+        # Hierarchical federation (federation/): this process becomes one
+        # region of a global fleet. The local region wraps THIS indexer;
+        # peer regions are reached over the cluster gRPC transport.
+        # Digests ship pull-style through GET/POST /federation/digest —
+        # no background thread in the service; a sidecar (or the peer
+        # itself) moves the bytes on whatever cadence it owns.
+        self.federation = None
+        if env.get("federation"):
+            from llm_d_kv_cache_manager_tpu.federation import (
+                FederationConfig,
+                GlobalRouter,
+                Region,
+                derive_fn_from_indexer,
+            )
+            from llm_d_kv_cache_manager_tpu.placement import (
+                ChainPopularityTracker,
+                PopularityConfig,
+            )
+
+            if self.popularity is None:
+                # Digests ship the popularity sketch; federation without
+                # placement still needs the observation-only tracker.
+                self.popularity = ChainPopularityTracker(PopularityConfig(
+                    top_k=int(env.get("placement_top_k", 64)),
+                    half_life_s=float(
+                        env.get("placement_half_life_s", 120.0)
+                    ),
+                ))
+                self.indexer.popularity = self.popularity
+                self.event_pool.popularity = self.popularity
+            fed_config = FederationConfig(
+                region_id=env.get("federation_region_id", "region-0"),
+                regions=list(env.get("federation_regions", [])),
+                digest_interval_s=float(
+                    env.get("federation_digest_interval_s", 5.0)
+                ),
+                digest_suspect_after_s=float(
+                    env.get("federation_digest_suspect_s", 15.0)
+                ),
+                digest_stale_after_s=float(
+                    env.get("federation_digest_stale_s", 45.0)
+                ),
+            )
+            regions = {
+                fed_config.region_id: Region(
+                    fed_config.region_id,
+                    self.indexer,
+                    tracker=self.popularity,
+                    pods_fn=lambda: list(
+                        self.fleet_health.summary()["pods"]
+                    ),
+                )
+            }
+            peers = env.get("federation_peers", "")
+            if peers:
+                from llm_d_kv_cache_manager_tpu.cluster.scorer import (
+                    GrpcReplicaTransport,
+                )
+
+                for spec in peers.split(","):
+                    if not spec.strip():
+                        continue
+                    region_id, _, target = spec.partition("=")
+                    if not target:
+                        raise ValueError(
+                            f"FEDERATION_PEERS entry {spec!r} is not "
+                            "region=host:port"
+                        )
+                    regions[region_id.strip()] = Region(
+                        region_id.strip(),
+                        GrpcReplicaTransport(target.strip()),
+                    )
+            self.federation = GlobalRouter(
+                fed_config,
+                regions,
+                derive_fn=derive_fn_from_indexer(self.indexer),
+            )
 
     def start(self, with_subscriber: bool = True) -> None:
         self.indexer.run()
@@ -681,6 +799,14 @@ class ScoringService:
                 self.admission.status() if self.admission is not None
                 else None
             ),
+            # Federation section: per-region digest age + staleness state,
+            # the stale set, and failover counters. Peer-region staleness
+            # never gates THIS region's readiness — a region serving its
+            # own traffic while the WAN is dark is degraded, not down.
+            "federation": (
+                self.federation.status() if self.federation is not None
+                else None
+            ),
         }
 
     async def handle_readyz(self, request: web.Request) -> web.Response:
@@ -791,6 +917,122 @@ class ScoringService:
 
         return web.json_response(await asyncio.to_thread(build))
 
+    def _federation_disabled(self) -> Optional[web.Response]:
+        if self.federation is None:
+            return web.json_response(
+                {"error": "federation disabled (set FEDERATION=1)"},
+                status=400,
+            )
+        return None
+
+    async def handle_federation_status(
+        self, request: web.Request
+    ) -> web.Response:
+        """Federation introspection: per-region digest age/staleness,
+        stale set, route/failover/digest counters (the same document the
+        /readyz `federation` section embeds)."""
+        err = self._federation_disabled()
+        if err is not None:
+            return err
+        return web.json_response(
+            await asyncio.to_thread(self.federation.status)
+        )
+
+    async def handle_federation_score(
+        self, request: web.Request
+    ) -> web.Response:
+        """Two-level scoring entry: pick a region over the shipped
+        digests, delegate precisely, return the pod scores WITH the
+        region decision evidence. Body (POST) or query params (GET) are
+        the /score_completions shape plus optional `home_region`."""
+        err = self._federation_disabled()
+        if err is not None:
+            return err
+        if request.method == "POST":
+            try:
+                body = await request.json()
+                prompt = body["prompt"]
+                model = body["model"]
+            except (json.JSONDecodeError, KeyError, TypeError) as e:
+                return web.json_response(
+                    {"error": f"invalid request: {e}"}, status=400
+                )
+            pods = body.get("pods", [])
+            lora_id = body.get("lora_id")
+            home_region = body.get("home_region")
+        else:
+            prompt = request.query.get("prompt")
+            model = request.query.get("model")
+            if prompt is None or model is None:
+                return web.json_response(
+                    {"error": "prompt and model query params are required"},
+                    status=400,
+                )
+            pods = [
+                p for p in request.query.get("pods", "").split(",") if p
+            ]
+            lora_id = request.query.get("lora_id")
+            if lora_id is not None:
+                try:
+                    lora_id = int(lora_id)
+                except ValueError:
+                    return web.json_response(
+                        {"error": "lora_id must be an integer"}, status=400
+                    )
+            home_region = request.query.get("home_region")
+        try:
+            result = await self._admitted(
+                request,
+                lambda: self.federation.score_ex(
+                    prompt, model, pods, lora_id=lora_id,
+                    home_region=home_region,
+                ),
+            )
+        except AdmissionRejected as e:
+            return self._shed_response(e)
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"error": str(e)}, status=500)
+        return web.json_response({
+            "podScores": result.pod_scores.scores,
+            "region": result.region,
+            "detail": result.detail,
+        })
+
+    async def handle_federation_digest(
+        self, request: web.Request
+    ) -> web.Response:
+        """The digest shipping seam. GET: build + return this region's
+        encoded RegionDigest (peers pull on their own cadence). POST:
+        ingest a peer's encoded digest from the request body."""
+        err = self._federation_disabled()
+        if err is not None:
+            return err
+        if request.method == "GET":
+            try:
+                data = await asyncio.to_thread(
+                    self.federation.build_local_digest
+                )
+            except ValueError as e:
+                return web.json_response({"error": str(e)}, status=400)
+            return web.Response(
+                body=data, content_type="application/octet-stream"
+            )
+        data = await request.read()
+        from llm_d_kv_cache_manager_tpu.federation import DigestFormatError
+
+        try:
+            digest = await asyncio.to_thread(
+                self.federation.ingest_digest, data
+            )
+        except (DigestFormatError, ValueError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response({
+            "status": "ok",
+            "region": digest.region_id,
+            "seq": digest.seq,
+            "hot_chains": len(digest.hot_chains),
+        })
+
     async def handle_cluster_snapshot(self, request: web.Request) -> web.Response:
         """POST: drain the event pool and write this replica's snapshot
         (view + seq watermarks) to the configured path."""
@@ -824,6 +1066,19 @@ class ScoringService:
         app.router.add_get("/routing/status", self.handle_routing_status)
         app.router.add_post("/pod_load", self.handle_pod_load)
         app.router.add_get("/placement/status", self.handle_placement_status)
+        app.router.add_get(
+            "/federation/status", self.handle_federation_status
+        )
+        app.router.add_get("/federation/score", self.handle_federation_score)
+        app.router.add_post(
+            "/federation/score", self.handle_federation_score
+        )
+        app.router.add_get(
+            "/federation/digest", self.handle_federation_digest
+        )
+        app.router.add_post(
+            "/federation/digest", self.handle_federation_digest
+        )
         app.router.add_post("/cluster/snapshot", self.handle_cluster_snapshot)
         app.router.add_get("/debug/traces", self.handle_debug_traces)
         app.router.add_get("/debug/score_explain", self.handle_score_explain)

@@ -27,17 +27,13 @@ crash mid-snapshot can never leave a torn file for the next restart.
 from __future__ import annotations
 
 import os
-import struct
 import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
-from llm_d_kv_cache_manager_tpu.kvcache.kvblock.hashing import (
-    _cbor_text,
-    _cbor_uint_head,
-)
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index, IndexView
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import base_pod_identifier
+from llm_d_kv_cache_manager_tpu.utils import cbor
 from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
 
 logger = kvlog.get_logger("cluster.snapshot")
@@ -65,79 +61,9 @@ class Snapshot:
 
 
 # -- canonical CBOR subset codec ---------------------------------------------
-# Encoder primitives come from kvblock/hashing.py (shortest-form uint heads,
-# text strings); the snapshot document additionally needs negative ints
-# (defensive — no field should produce one), float64, arrays, and null.
-
-
-def _encode(obj, out: bytearray) -> None:
-    if obj is None:
-        out.append(0xF6)
-    elif isinstance(obj, bool):  # before int: bool is an int subtype
-        out.append(0xF5 if obj else 0xF4)
-    elif isinstance(obj, int):
-        if obj >= 0:
-            _cbor_uint_head(0, obj, out)
-        else:
-            _cbor_uint_head(1, -1 - obj, out)
-    elif isinstance(obj, float):
-        out.append(0xFB)
-        out += struct.pack(">d", obj)
-    elif isinstance(obj, str):
-        out += _cbor_text(obj)
-    elif isinstance(obj, (list, tuple)):
-        _cbor_uint_head(4, len(obj), out)
-        for item in obj:
-            _encode(item, out)
-    else:
-        raise TypeError(f"unencodable snapshot value: {type(obj).__name__}")
-
-
-def _decode(data: bytes, pos: int = 0):
-    """(value, next_pos) for the subset `_encode` emits."""
-    try:
-        head = data[pos]
-    except IndexError:
-        raise SnapshotFormatError("truncated CBOR document") from None
-    major, info = head >> 5, head & 0x1F
-    pos += 1
-    if major == 7:
-        if head == 0xF6:
-            return None, pos
-        if head == 0xF5:
-            return True, pos
-        if head == 0xF4:
-            return False, pos
-        if head == 0xFB:
-            if pos + 8 > len(data):
-                raise SnapshotFormatError("truncated float64")
-            return struct.unpack(">d", data[pos:pos + 8])[0], pos + 8
-        raise SnapshotFormatError(f"unsupported simple value 0x{head:02x}")
-    if info < 24:
-        arg = info
-    elif info in (24, 25, 26, 27):
-        width = 1 << (info - 24)
-        if pos + width > len(data):
-            raise SnapshotFormatError("truncated integer argument")
-        arg = int.from_bytes(data[pos:pos + width], "big")
-        pos += width
-    else:
-        raise SnapshotFormatError(f"unsupported CBOR info value {info}")
-    if major == 0:
-        return arg, pos
-    if major == 1:
-        return -1 - arg, pos
-    if major == 3:
-        if pos + arg > len(data):
-            raise SnapshotFormatError("truncated text string")
-        return data[pos:pos + arg].decode("utf-8"), pos + arg
-    if major == 4:
-        items = []
-        for _ in range(arg):
-            item, pos = _decode(data, pos)
-            items.append(item)
-        return items, pos
-    raise SnapshotFormatError(f"unsupported CBOR major type {major}")
+# The codec itself lives in utils/cbor.py (shared with federation/digest.py);
+# the snapshot owns only its magic/version framing and error type. Byte
+# output is unchanged by the extraction — pinned by the round-trip tests.
 
 
 # -- document shape -----------------------------------------------------------
@@ -162,14 +88,17 @@ def encode_snapshot(
         [list(row) for row in view.engine_map],
     ]
     out = bytearray(SNAPSHOT_MAGIC)
-    _encode(doc, out)
+    cbor.encode_into(doc, out)
     return bytes(out)
 
 
 def decode_snapshot(data: bytes) -> Snapshot:
     if not data.startswith(SNAPSHOT_MAGIC):
         raise SnapshotFormatError("not a KVTPU index snapshot (bad magic)")
-    doc, end = _decode(data, len(SNAPSHOT_MAGIC))
+    try:
+        doc, end = cbor.decode(data, len(SNAPSHOT_MAGIC))
+    except cbor.CborDecodeError as e:
+        raise SnapshotFormatError(str(e)) from None
     if end != len(data):
         raise SnapshotFormatError(f"{len(data) - end} trailing byte(s)")
     if not isinstance(doc, list) or len(doc) != 5:

@@ -101,6 +101,19 @@ admission_queued: Optional[Counter] = None
 routing_policy_overrides: Optional[Counter] = None
 membership_transitions: Optional[Counter] = None
 
+# Hierarchical federation (federation/): requests routed per region and
+# the global tier's degradation/replication economics. The `region` label
+# takes values from the FIXED configured region set (FederationConfig /
+# FEDERATION_REGIONS) — deployment topology, never traffic; session ids,
+# chain heads, and pod names stay data.
+federation_routes: Optional[Counter] = None
+federation_mispicks: Optional[Counter] = None
+federation_failovers: Optional[Counter] = None
+federation_transitions: Optional[Counter] = None
+federation_digest_bytes: Optional[Counter] = None
+federation_warmed_blocks: Optional[Counter] = None
+federation_digest_age: Optional[Gauge] = None
+
 _APPLY_DELAY_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
     5.0, 10.0, 30.0, 60.0,
@@ -130,6 +143,9 @@ def register_metrics(registry=None) -> None:
     global placement_skipped_unhealthy
     global admission_shed, admission_queued
     global routing_policy_overrides, membership_transitions
+    global federation_routes, federation_mispicks, federation_failovers
+    global federation_transitions, federation_digest_bytes
+    global federation_warmed_blocks, federation_digest_age
 
     with _register_lock:
         if _registered:
@@ -342,6 +358,53 @@ def register_metrics(registry=None) -> None:
             labelnames=("phase",),
             registry=reg,
         )
+        federation_routes = Counter(
+            "kvcache_federation_routed_total",
+            "Requests the global router delegated, labeled by the picked "
+            "region (values from the fixed configured region set)",
+            labelnames=("region",),
+            registry=reg,
+        )
+        federation_mispicks = Counter(
+            "kvcache_federation_mispicked_regions_total",
+            "Requests routed to a non-home region while the home region "
+            "was routable (affinity/load sent them elsewhere) — the "
+            "honest-cost column of approximate region routing",
+            registry=reg,
+        )
+        federation_failovers = Counter(
+            "kvcache_federation_failovers_total",
+            "Rendezvous failover-target selections for a stale home "
+            "region",
+            registry=reg,
+        )
+        federation_transitions = Counter(
+            "kvcache_federation_region_transitions_total",
+            "Region digest-staleness state transitions, labeled by the "
+            "state entered (fleethealth healthy/suspect/stale vocabulary "
+            "at region granularity)",
+            labelnames=("state",),
+            registry=reg,
+        )
+        federation_digest_bytes = Counter(
+            "kvcache_federation_digest_bytes_total",
+            "Encoded RegionDigest bytes produced for shipping (the "
+            "federation tier's WAN cost)",
+            registry=reg,
+        )
+        federation_warmed_blocks = Counter(
+            "kvcache_federation_warmed_blocks_total",
+            "KV blocks landed locally from a remote digest's hot chains "
+            "through the warm_chain admission seam",
+            registry=reg,
+        )
+        federation_digest_age = Gauge(
+            "kvcache_federation_digest_age_seconds",
+            "Age of the last ingested digest per region (the failover "
+            "tier's staleness signal)",
+            labelnames=("region",),
+            registry=reg,
+        )
         _registered = True
 
 
@@ -487,6 +550,41 @@ def count_routing_override() -> None:
 def count_membership_transition(phase: str) -> None:
     if membership_transitions is not None:
         membership_transitions.labels(phase=phase).inc()
+
+
+def count_federation_route(region: str) -> None:
+    if federation_routes is not None:
+        federation_routes.labels(region=region).inc()
+
+
+def count_federation_mispick() -> None:
+    if federation_mispicks is not None:
+        federation_mispicks.inc()
+
+
+def count_federation_failover() -> None:
+    if federation_failovers is not None:
+        federation_failovers.inc()
+
+
+def count_federation_transition(state: str) -> None:
+    if federation_transitions is not None:
+        federation_transitions.labels(state=state).inc()
+
+
+def count_federation_digest_bytes(n: int) -> None:
+    if federation_digest_bytes is not None and n:
+        federation_digest_bytes.inc(n)
+
+
+def count_federation_warmed(blocks: int) -> None:
+    if federation_warmed_blocks is not None and blocks:
+        federation_warmed_blocks.inc(blocks)
+
+
+def set_federation_digest_age(region: str, age_s: float) -> None:
+    if federation_digest_age is not None:
+        federation_digest_age.labels(region=region).set(age_s)
 
 
 def counter_value(c: Optional[Counter]) -> float:

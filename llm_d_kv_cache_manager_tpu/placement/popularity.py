@@ -60,6 +60,29 @@ _MASK64 = 0xFFFFFFFFFFFFFFFF
 _RESCALE_LIMIT = 2.0**64
 
 
+def sketch_cells(item: int, width: int, depth: int):
+    """Yield the (row, column) cells of `item` in a (width × depth) sketch.
+
+    Module-level so a shipped sketch (federation/digest.py carries the raw
+    rows in decayed-now units) can be probed WITHOUT constructing a
+    `DecayedCountMinSketch` — the cell mapping is the wire contract between
+    an exporting region and every remote reader, and must stay identical on
+    both sides.
+    """
+    for d in range(min(depth, len(_ROW_SALTS))):
+        h = ((item ^ _ROW_SALTS[d]) * 0x100000001B3) & _MASK64
+        h ^= h >> 29
+        yield d, h % width
+
+
+def estimate_from_rows(
+    rows: Sequence[Sequence[float]], width: int, item: int
+) -> float:
+    """Count-min estimate of `item` over exported rows (decayed-now units,
+    the form `DecayedCountMinSketch.export` produces)."""
+    return min(rows[d][i] for d, i in sketch_cells(item, width, len(rows)))
+
+
 @dataclass
 class PopularityConfig:
     """Knobs of the tracker; all bounds are hard (space never grows past
@@ -116,10 +139,7 @@ class DecayedCountMinSketch:
         return 1.0
 
     def _cells(self, item: int):
-        for d in range(self.depth):
-            h = ((item ^ _ROW_SALTS[d]) * 0x100000001B3) & _MASK64
-            h ^= h >> 29
-            yield d, h % self.width
+        return sketch_cells(item, self.width, self.depth)
 
     def add(self, item: int, amount: float, now: float) -> float:
         """Credit `amount` (decayed-now units) to `item`; returns the new
@@ -142,6 +162,41 @@ class DecayedCountMinSketch:
         factor = self._factor(now)
         est = min(self.rows[d][i] for d, i in self._cells(item))
         return est / factor
+
+    def export(self, now: float) -> List[List[float]]:
+        """Rows normalized to decayed-now units — the inflation factor is
+        divided out, so the exported cells read directly as decayed counts
+        at `now` and mean the same thing to any remote reader regardless of
+        either side's `_t0`. This is what a `RegionDigest` ships; probe it
+        with `estimate_from_rows`."""
+        factor = self._factor(now)
+        inv = 1.0 / factor
+        return [[v * inv for v in row] for row in self.rows]
+
+    def merge(
+        self, rows: Sequence[Sequence[float]], now: float, scale: float = 1.0
+    ) -> None:
+        """Fold exported rows (decayed-now units at `now`) into this
+        sketch, cell-wise, scaled by `scale`. Requires identical (width,
+        depth) — the cell mapping is position-dependent, so merging
+        mismatched shapes would silently corrupt every estimate."""
+        if len(rows) != self.depth or any(
+            len(row) != self.width for row in rows
+        ):
+            raise ValueError(
+                f"sketch shape mismatch: merging {len(rows)} rows of "
+                f"{len(rows[0]) if rows else 0} cells into a "
+                f"{self.depth}x{self.width} sketch"
+            )
+        factor = self._factor(now)
+        if factor > _RESCALE_LIMIT:
+            factor = self._rescale(factor)
+            factor = self._factor(now)
+        for d, row in enumerate(rows):
+            mine = self.rows[d]
+            for i, v in enumerate(row):
+                if v:
+                    mine[i] += v * scale * factor
 
 
 @dataclass
@@ -374,6 +429,34 @@ class ChainPopularityTracker:
             now = self.clock()
         with self._mu:
             return self.sketch.estimate(chunk_hash, now)
+
+    def export_sketch(self, now: Optional[float] = None) -> dict:
+        """Snapshot the sketch for digest shipping: shape + half-life +
+        rows in decayed-now units (see `DecayedCountMinSketch.export`).
+        The returned rows are copies — safe to encode off-lock."""
+        if now is None:
+            now = self.clock()
+        with self._mu:
+            return {
+                "width": self.sketch.width,
+                "depth": self.sketch.depth,
+                "half_life_s": self.sketch.half_life_s,
+                "rows": self.sketch.export(now),
+            }
+
+    def merge_sketch(
+        self,
+        rows: Sequence[Sequence[float]],
+        now: Optional[float] = None,
+        scale: float = 1.0,
+    ) -> None:
+        """Fold a peer's exported rows into this tracker's sketch (an
+        aggregator building a fleet-of-fleets view). Top-K chain identity
+        does not travel in rows — only block popularity merges."""
+        if now is None:
+            now = self.clock()
+        with self._mu:
+            self.sketch.merge(rows, now, scale=scale)
 
     def stats(self) -> dict:
         with self._mu:

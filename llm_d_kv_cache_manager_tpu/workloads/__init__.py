@@ -20,6 +20,14 @@ makes that measurable without network egress:
                 kept for artifact continuity; formerly utils/workload.py).
 """
 
+from llm_d_kv_cache_manager_tpu.workloads.geo import (  # noqa: F401
+    GeoConfig,
+    diurnal_weights,
+    region_name,
+)
+from llm_d_kv_cache_manager_tpu.workloads.geo import (  # noqa: F401
+    generate as generate_geo,
+)
 from llm_d_kv_cache_manager_tpu.workloads.multitenant import (  # noqa: F401
     MultiTenantConfig,
     tenant_of,
@@ -44,10 +52,14 @@ from llm_d_kv_cache_manager_tpu.workloads.trace import (  # noqa: F401
 )
 
 __all__ = [
+    "GeoConfig",
     "MultiTenantConfig",
     "ShareGPTConfig",
+    "diurnal_weights",
     "generate",
+    "generate_geo",
     "generate_multitenant",
+    "region_name",
     "tenant_of",
     "tenant_weights",
     "uniform_control",

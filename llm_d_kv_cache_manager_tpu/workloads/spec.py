@@ -16,7 +16,7 @@ trace content.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Tuple
+from typing import Dict, Iterator, List, Optional, Tuple
 
 
 @dataclass(frozen=True)
@@ -40,13 +40,19 @@ class TraceTurn:
 
 @dataclass(frozen=True)
 class MaterializedRequest:
-    """A served request: the fully grown prompt for one trace turn."""
+    """A served request: the fully grown prompt for one trace turn.
+
+    `region` is the session's home region when the trace pins one
+    (workloads.geo), else None — trailing default, so every pre-geo
+    construction site is untouched.
+    """
 
     arrival_s: float
     session: str
     turn: int
     prompt: str
     output_len: int
+    region: Optional[str] = None
 
 
 @dataclass
@@ -58,6 +64,10 @@ class WorkloadTrace:
     # session id -> shared system prefix text ("" when the session has none)
     sessions: Dict[str, str] = field(default_factory=dict)
     turns: List[TraceTurn] = field(default_factory=list)
+    # session id -> home region (workloads.geo). Sparse and strictly
+    # optional: traces recorded before the geo workload carry no regions,
+    # read back with this empty, and re-serialize byte-identically.
+    session_regions: Dict[str, str] = field(default_factory=dict)
 
     def materialize(self) -> Iterator[MaterializedRequest]:
         """Yield the full-prompt request stream in arrival order.
@@ -75,6 +85,7 @@ class WorkloadTrace:
                 turn=t.turn,
                 prompt=prompt,
                 output_len=t.output_len,
+                region=self.session_regions.get(t.session),
             )
             history[t.session] = prompt + " [assistant] " + t.response_text
 

@@ -7,6 +7,8 @@ turn, each a single JSON object tagged by "kind":
      "workload": "sharegpt", "seed": 42, "tables_version": "sharegpt-v1",
      "config": {...}}
     {"kind": "session", "id": "s0", "system_prefix": "..."}
+    {"kind": "session", "id": "s1", "system_prefix": "...",
+     "region": "region-2"}   # optional home-region pin (workloads.geo)
     {"kind": "turn", "arrival_s": 0.71, "session": "s0", "turn": 0,
      "user_len": 28, "output_len": 170, "user_text": "...",
      "response_text": "..."}
@@ -47,11 +49,20 @@ def trace_lines(trace: WorkloadTrace) -> Iterable[str]:
         "config": trace.config,
     })
     for session_id in sorted(trace.sessions):
-        yield _dump({
+        rec = {
             "kind": "session",
             "id": session_id,
             "system_prefix": trace.sessions[session_id],
-        })
+        }
+        # Optional home region (workloads.geo). Emitted ONLY when the
+        # session is pinned, so a region-free trace serializes exactly as
+        # it did before the field existed — strict back-compat both ways
+        # (old readers never see the key; old files round-trip byte-
+        # identically through new writers).
+        region = trace.session_regions.get(session_id)
+        if region is not None:
+            rec["region"] = region
+        yield _dump(rec)
     for t in trace.turns:
         yield _dump({
             "kind": "turn",
@@ -81,6 +92,7 @@ def read_trace(path_or_file: Union[str, IO[str]]) -> WorkloadTrace:
 
     header = None
     sessions = {}
+    session_regions = {}
     turns: List[TraceTurn] = []
     for lineno, line in enumerate(path_or_file, start=1):
         line = line.strip()
@@ -104,6 +116,8 @@ def read_trace(path_or_file: Union[str, IO[str]]) -> WorkloadTrace:
             if header is None:
                 raise ValueError(f"trace line {lineno}: session before header")
             sessions[rec["id"]] = rec["system_prefix"]
+            if "region" in rec:
+                session_regions[rec["id"]] = rec["region"]
         elif kind == "turn":
             if header is None:
                 raise ValueError(f"trace line {lineno}: turn before header")
@@ -127,4 +141,5 @@ def read_trace(path_or_file: Union[str, IO[str]]) -> WorkloadTrace:
         tables_version=header["tables_version"],
         sessions=sessions,
         turns=turns,
+        session_regions=session_regions,
     )

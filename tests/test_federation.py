@@ -1,0 +1,809 @@
+"""Hierarchical federation (federation/) — digest codec, region pick,
+failover, and the bit-identity pin.
+
+The tentpole invariant: a **single-region federation scores exactly like
+a flat fleet** — `GlobalRouter.get_pod_scores_ex` over one region is
+bit-identical (scores float-for-float, match_blocks, block_hashes) to the
+wrapped front, whether that front is a plain `Indexer` over any of the
+four index backends or the flat `ClusterScorer`, fed by the same event
+stream. Pinned here in the same style as the test_cluster.py
+scatter-gather pins.
+
+Around the pin: the RegionDigest canonical-CBOR round trip (version/magic
+enforcement, quantization bound, byte determinism), sketch export/merge,
+approximate-affinity region picks (hot region wins, load demotes, home
+bonus breaks ties, stale region excluded), digest-staleness failover
+(fleethealth vocabulary at region granularity, rendezvous determinism),
+the cross-region hot-chain warm offer (threshold + cooldown bounds), and
+the HTTP surface. The cross-region gRPC transport tests are
+`federation`-marked (grpcio auto-skip in conftest); everything else runs
+unmarked in tier-1.
+"""
+
+import random
+import socket
+import threading
+
+import pytest
+
+from tests.conftest import TEST_MODEL_NAME, TEST_TOKENIZER_JSON
+from llm_d_kv_cache_manager_tpu.cluster import (
+    ClusterScorer,
+    LocalReplicaTransport,
+)
+from llm_d_kv_cache_manager_tpu.federation import (
+    DigestFormatError,
+    FederationConfig,
+    GlobalRouter,
+    HotChainDigest,
+    Region,
+    RegionDigest,
+    RegionFailoverTracker,
+    build_digest,
+    decode_digest,
+    derive_fn_from_indexer,
+    encode_digest,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import (
+    Indexer,
+    IndexerConfig,
+    PodScores,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.cost_aware import (
+    CostAwareIndexConfig,
+    CostAwareMemoryIndex,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+    InMemoryIndex,
+    InMemoryIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.sharded import (
+    ShardedIndex,
+    ShardedIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.placement.popularity import (
+    ChainPopularityTracker,
+    DecayedCountMinSketch,
+    PopularityConfig,
+    estimate_from_rows,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPool,
+    TokenizersPoolConfig,
+)
+
+BLOCK_SIZE = 4
+PODS = ["pod-0", "pod-1", "pod-2", "pod-3"]
+WORDS = (
+    "alpha bravo charlie delta echo foxtrot golf hotel india juliet "
+    "kilo lima mike november oscar papa quebec romeo sierra tango"
+).split()
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _text(rng, n):
+    return " ".join(rng.choice(WORDS) for _ in range(n))
+
+
+def _backend_factories(fake_redis_url=None):
+    factories = {
+        "in_memory": lambda: InMemoryIndex(
+            InMemoryIndexConfig(size=4096, pod_cache_size=10)
+        ),
+        "sharded": lambda: ShardedIndex(
+            ShardedIndexConfig(size=4096, num_shards=8)
+        ),
+        "cost_aware": lambda: CostAwareMemoryIndex(
+            CostAwareIndexConfig(max_size_bytes="64MiB")
+        ),
+    }
+    if fake_redis_url is not None:
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import (
+            RedisIndex,
+            RedisIndexConfig,
+        )
+
+        factories["redis"] = lambda: RedisIndex(
+            RedisIndexConfig(url=fake_redis_url)
+        )
+    return factories
+
+
+@pytest.fixture(scope="module")
+def fake_redis():
+    from tests.fake_redis import FakeRedisServer
+
+    server = FakeRedisServer()
+    yield server
+    server.close()
+
+
+def _make_indexer(kv_block_index=None, tok_pool=None):
+    indexer = Indexer(
+        config=IndexerConfig(
+            token_processor_config=TokenProcessorConfig(block_size=BLOCK_SIZE),
+        ),
+        tokenization_pool=tok_pool or TokenizationPool(
+            TokenizersPoolConfig(
+                workers=2,
+                local_tokenizer_files={TEST_MODEL_NAME: TEST_TOKENIZER_JSON},
+            ),
+        ),
+        kv_block_index=kv_block_index,
+    )
+    indexer.run()
+    return indexer
+
+
+def _populate(indexer, rng, prompts, loras=(None,)):
+    """Each prompt's chain lands on a random pod subset at random depths —
+    the same randomized-placement shape the score_many pins use."""
+    seq = 0
+    for prompt in prompts:
+        enc = indexer.tokenizers_pool.tokenizer.encode(prompt, TEST_MODEL_NAME)
+        for lora in loras:
+            keys = indexer.token_processor.tokens_to_kv_block_keys(
+                None, enc.tokens, TEST_MODEL_NAME, lora_id=lora
+            )
+            if not keys:
+                continue
+            engine_keys = [
+                Key(TEST_MODEL_NAME, 1_000_000 + seq * 1000 + i)
+                for i in range(len(keys))
+            ]
+            seq += 1
+            for pod in rng.sample(PODS, rng.randint(1, 3)):
+                depth = rng.randint(1, len(keys))
+                entry = PodEntry(pod, rng.choice(("hbm", "host")))
+                indexer.kv_block_index.add(
+                    engine_keys[:depth], keys[:depth], [entry]
+                )
+
+
+def _tracker(clock, width=128, depth=4, top_k=8, half_life=60.0):
+    return ChainPopularityTracker(
+        PopularityConfig(
+            sketch_width=width, sketch_depth=depth, top_k=top_k,
+            half_life_s=half_life,
+        ),
+        clock=clock,
+    )
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# -- digest codec --------------------------------------------------------------
+
+
+class TestDigestCodec:
+    def _digest(self, clock=None):
+        clock = clock or Clock(10.0)
+        tr = _tracker(clock)
+        tr.observe_route(
+            [101, 102, 103], tokens=list(range(3 * BLOCK_SIZE)),
+            block_size=BLOCK_SIZE, model_name=TEST_MODEL_NAME, lora_id=7,
+        )
+        tr.observe_route([101, 102, 103])
+        tr.observe_store([555])
+        return build_digest(
+            "region-1", tr, seq=3, pods=4, load=0.375, hot_k=4,
+        )
+
+    def test_round_trip(self):
+        d = self._digest()
+        data = encode_digest(d)
+        d2 = decode_digest(data)
+        assert d2.region_id == d.region_id
+        assert d2.seq == 3 and d2.pods == 4
+        assert d2.load == pytest.approx(0.375)
+        assert d2.created_ts == pytest.approx(d.created_ts)
+        assert d2.sketch_width == d.sketch_width
+        assert d2.sketch_depth == d.sketch_depth
+        assert [c.head for c in d2.hot_chains] == [
+            c.head for c in d.hot_chains
+        ]
+        chain = d2.hot_chains[0]
+        assert chain.extra == (7,)
+        assert chain.model_name == TEST_MODEL_NAME
+        assert chain.prefix_hashes == [101, 102, 103]
+        assert chain.prefix_tokens == list(range(3 * BLOCK_SIZE))
+
+    def test_estimates_survive_quantization(self):
+        """Wire cells are milli-quantized; every estimate a peer reads is
+        within 0.0005 of the producer's decayed value."""
+        clock = Clock(10.0)
+        tr = _tracker(clock)
+        rng = random.Random(3)
+        hashes = [rng.getrandbits(60) for _ in range(32)]
+        for h in hashes:
+            for _ in range(rng.randint(1, 5)):
+                tr.observe_route([h])
+        d2 = decode_digest(encode_digest(
+            build_digest("region-0", tr, seq=1)
+        ))
+        for h in hashes:
+            assert d2.estimate(h) == pytest.approx(
+                tr.block_score(h), abs=5e-4
+            )
+
+    def test_byte_determinism(self):
+        d = self._digest()
+        assert encode_digest(d) == encode_digest(d)
+
+    def test_magic_version_truncation_enforced(self):
+        data = encode_digest(self._digest())
+        with pytest.raises(DigestFormatError):
+            decode_digest(b"NOTADGST!" + data[9:])
+        bad = bytearray(data)
+        bad[9] = 0x17  # version byte -> 23
+        with pytest.raises(DigestFormatError):
+            decode_digest(bytes(bad))
+        with pytest.raises(DigestFormatError):
+            decode_digest(data[:-3])
+        with pytest.raises(DigestFormatError):
+            decode_digest(data + b"\x00")
+
+    def test_affinity_leading_blocks_only(self):
+        rows = [[0.0] * 64 for _ in range(2)]
+        d = RegionDigest(
+            region_id="r", created_ts=0.0, seq=1, pods=1, load=0.0,
+            sketch_width=64, sketch_depth=2, half_life_s=60.0, rows=rows,
+        )
+        assert d.affinity([1, 2, 3]) == 0.0
+        assert d.affinity([]) == 0.0
+
+
+# -- sketch export / merge ----------------------------------------------------
+
+
+class TestSketchExportMerge:
+    def test_export_is_decayed_now_units(self):
+        clock = Clock(0.0)
+        tr = _tracker(clock, half_life=10.0)
+        tr.observe_route([42])
+        clock.t = 10.0  # one half-life
+        rows = tr.export_sketch()["rows"]
+        assert estimate_from_rows(rows, 128, 42) == pytest.approx(0.5)
+
+    def test_merge_preserves_estimates(self):
+        clock = Clock(5.0)
+        a = _tracker(clock)
+        b = _tracker(clock)
+        a.observe_route([7, 8])
+        a.observe_route([7])
+        b.observe_route([9])
+        b.merge_sketch(a.export_sketch()["rows"])
+        # Count-min merge: estimates add (overestimate-only preserved).
+        assert b.block_score(7) >= 2.0 - 1e-9
+        assert b.block_score(9) >= 1.0 - 1e-9
+
+    def test_merge_shape_mismatch_rejected(self):
+        s = DecayedCountMinSketch(64, 2, 60.0)
+        with pytest.raises(ValueError):
+            s.merge([[0.0] * 32, [0.0] * 32], now=0.0)
+        with pytest.raises(ValueError):
+            s.merge([[0.0] * 64], now=0.0)
+
+
+# -- the bit-identity pin -----------------------------------------------------
+
+
+class TestSingleRegionBitIdentity:
+    """A 1-region federation's scores are bit-identical to the flat fleet
+    on the same event stream — across all four index backends, LoRA
+    keyspaces, pod filters, and the ClusterScorer front."""
+
+    @pytest.mark.parametrize(
+        "backend", ["in_memory", "sharded", "cost_aware", "redis"]
+    )
+    def test_pinned_to_flat_indexer(self, backend, fake_redis):
+        rng = random.Random(11)
+        factory = _backend_factories(fake_redis.url)[backend]
+        index = factory()
+        if backend == "redis":
+            index._pipeline([("FLUSHALL",)])  # noqa: SLF001
+        indexer = _make_indexer(kv_block_index=index)
+        try:
+            prompts = [_text(rng, rng.randint(8, 40)) for _ in range(6)]
+            shared = _text(rng, 12)
+            prompts += [shared + " " + _text(rng, 6) for _ in range(3)]
+            _populate(indexer, rng, prompts, loras=(None, 1))
+            tracker = _tracker(Clock(0.0))
+            indexer.popularity = tracker  # observation-only: no drift
+            router = GlobalRouter(
+                FederationConfig(region_id="region-0"),
+                [Region("region-0", indexer, tracker=tracker)],
+            )
+            queries = prompts + [shared, _text(rng, 5), "x"]
+            for prompt in queries:
+                for pods, lora in (
+                    ([], None), ([], 1), (["pod-0", "pod-2"], None),
+                ):
+                    ref = indexer.get_pod_scores_ex(
+                        prompt, TEST_MODEL_NAME, pods, lora_id=lora
+                    )
+                    fed = router.get_pod_scores_ex(
+                        prompt, TEST_MODEL_NAME, pods, lora_id=lora
+                    )
+                    assert fed.scores == ref.scores
+                    assert fed.match_blocks == ref.match_blocks
+                    assert fed.block_hashes == ref.block_hashes
+            # Non-vacuous: the stream genuinely produced scores.
+            assert any(
+                indexer.get_pod_scores(p, TEST_MODEL_NAME, [])
+                for p in queries
+            )
+            assert router.stats_counters["routed"] == 3 * len(queries)
+        finally:
+            indexer.shutdown()
+
+    def test_pinned_to_flat_cluster_scorer(self):
+        """Region front = the flat ClusterScorer itself: federation adds
+        a level above the replicated control plane without touching its
+        merged answers."""
+        rng = random.Random(12)
+        indexer = _make_indexer()
+        try:
+            prompts = [_text(rng, rng.randint(8, 30)) for _ in range(5)]
+            _populate(indexer, rng, prompts)
+            flat = ClusterScorer([LocalReplicaTransport(indexer)])
+            try:
+                router = GlobalRouter(
+                    FederationConfig(region_id="region-0"),
+                    [Region("region-0", flat)],
+                )
+                for prompt in prompts:
+                    ref = flat.get_pod_scores_ex(prompt, TEST_MODEL_NAME, [])
+                    fed = router.get_pod_scores_ex(
+                        prompt, TEST_MODEL_NAME, []
+                    )
+                    assert fed.scores == ref.scores
+                    assert fed.match_blocks == ref.match_blocks
+                    assert fed.block_hashes == ref.block_hashes
+                assert any(
+                    flat.get_pod_scores(p, TEST_MODEL_NAME, [])
+                    for p in prompts
+                )
+            finally:
+                flat.close()
+        finally:
+            indexer.shutdown()
+
+
+# -- region pick ---------------------------------------------------------------
+
+
+def _fixed_scorer(scores):
+    class _S:
+        def get_pod_scores_ex(self, prompt, model, pods, lora_id=None):
+            return PodScores(scores=dict(scores))
+
+    return _S()
+
+
+def _two_region_router(clock, **cfg_kwargs):
+    cfg = FederationConfig(
+        region_id="region-0",
+        regions=["region-0", "region-1"],
+        digest_suspect_after_s=10.0,
+        digest_stale_after_s=30.0,
+        **cfg_kwargs,
+    )
+    trackers = {
+        "region-0": _tracker(clock),
+        "region-1": _tracker(clock),
+    }
+    regions = [
+        Region(
+            r, _fixed_scorer({f"{r}-pod": 1.0}), tracker=trackers[r],
+            pods_fn=lambda: ["p"] * 4, load_fn=lambda: 0.0,
+        )
+        for r in ("region-0", "region-1")
+    ]
+    router = GlobalRouter(cfg, regions, clock=clock)
+    return router, trackers
+
+
+def _ship(router, region, tracker, seq, load=0.0, now=None):
+    digest = build_digest(
+        region, tracker, seq=seq, pods=4, load=load,
+        now=now if now is not None else router.clock(),
+    )
+    router.ingest_digest(digest)
+    return digest
+
+
+class TestRegionPick:
+    def test_hot_region_wins_over_empty(self):
+        clock = Clock(0.0)
+        router, trackers = _two_region_router(clock)
+        trackers["region-1"].observe_route([71, 72, 73])
+        trackers["region-1"].observe_route([71, 72, 73])
+        _ship(router, "region-0", trackers["region-0"], 1)
+        _ship(router, "region-1", trackers["region-1"], 1)
+        picked, detail = router.pick_region([71, 72, 73])
+        assert picked == "region-1"
+        assert detail["regions"]["region-1"]["affinity"] > 0
+
+    def test_home_bonus_breaks_cold_ties_and_mispick_counts(self):
+        clock = Clock(0.0)
+        router, trackers = _two_region_router(clock)
+        _ship(router, "region-0", trackers["region-0"], 1)
+        _ship(router, "region-1", trackers["region-1"], 1)
+        picked, detail = router.pick_region([5, 6], home_region="region-1")
+        assert picked == "region-1"
+        assert detail["mispick"] is False
+        # A genuinely hot remote region beats the home bonus — and the
+        # override is counted as a mispick (the honest-cost column).
+        trackers["region-0"].observe_route([5, 6])
+        trackers["region-0"].observe_route([5, 6])
+        _ship(router, "region-0", trackers["region-0"], 2)
+        picked, detail = router.pick_region([5, 6], home_region="region-1")
+        assert picked == "region-0"
+        assert detail["mispick"] is True
+        assert router.stats_counters["mispicked_regions"] == 1
+
+    def test_load_demotes_a_busy_region(self):
+        clock = Clock(0.0)
+        router, trackers = _two_region_router(clock, load_weight=1.0)
+        # Equal (zero) affinity; region-0 is saturated, region-1 idle.
+        _ship(router, "region-0", trackers["region-0"], 1, load=2.0)
+        _ship(router, "region-1", trackers["region-1"], 1, load=0.0)
+        picked, _ = router.pick_region([99], home_region="region-0")
+        assert picked == "region-1"
+
+    def test_stale_region_excluded_and_home_fails_over(self):
+        clock = Clock(0.0)
+        router, trackers = _two_region_router(clock)
+        _ship(router, "region-0", trackers["region-0"], 1)
+        _ship(router, "region-1", trackers["region-1"], 1)
+        clock.t = 31.0  # past stale for both...
+        _ship(router, "region-0", trackers["region-0"], 2)  # ...r0 recovers
+        picked, detail = router.pick_region([1], home_region="region-1")
+        assert picked == "region-0"
+        assert detail["failover"] == {
+            "home": "region-1", "target": "region-0"
+        }
+        assert detail["regions"].keys() == {"region-0"}
+        assert router.stats_counters["failover_routes"] == 1
+
+    def test_delegation_failure_degrades_to_failover(self):
+        clock = Clock(0.0)
+        cfg = FederationConfig(
+            region_id="region-0", regions=["region-0", "region-1"],
+            digest_suspect_after_s=10.0, digest_stale_after_s=30.0,
+        )
+
+        class _Boom:
+            def get_pod_scores_ex(self, *a, **k):
+                raise ConnectionError("region down")
+
+        router = GlobalRouter(cfg, [
+            Region("region-0", _Boom(), tracker=_tracker(clock)),
+            Region("region-1", _fixed_scorer({"r1-pod": 2.0})),
+        ], clock=clock)
+        result = router.score_ex("prompt", TEST_MODEL_NAME, [],
+                                 home_region="region-0")
+        assert result.region == "region-1"
+        assert result.pod_scores.scores == {"r1-pod": 2.0}
+        assert router.stats_counters["delegation_failures"] == 1
+
+    def test_unknown_region_digest_rejected(self):
+        clock = Clock(0.0)
+        router, trackers = _two_region_router(clock)
+        alien = build_digest("region-9", _tracker(clock), seq=1)
+        with pytest.raises(ValueError):
+            router.ingest_digest(alien)
+
+
+# -- failover state machine ---------------------------------------------------
+
+
+class TestFailover:
+    def test_staleness_states_follow_digest_age(self):
+        clock = Clock(0.0)
+        t = RegionFailoverTracker(
+            ["region-0", "region-1"], suspect_after_s=10.0,
+            stale_after_s=30.0, clock=clock,
+        )
+        t.observe_digest("region-0", 1)
+        assert t.state_of("region-0") == "healthy"
+        assert t.state_of("region-1") == "healthy"  # never seen = healthy
+        clock.t = 15.0
+        assert t.state_of("region-0") == "suspect"
+        assert t.demotion("region-0", 0.5) == 0.5
+        clock.t = 31.0
+        assert t.state_of("region-0") == "stale"
+        assert t.stale_regions() == ["region-0"]
+        # Recovery: one digest flips it healthy again.
+        t.observe_digest("region-0", 2, now=31.0)
+        assert t.state_of("region-0") == "healthy"
+        assert t.summary()["region-0"]["recoveries"] == 1
+
+    def test_rendezvous_failover_is_deterministic_and_spread(self):
+        clock = Clock(0.0)
+        regions = [f"region-{i}" for i in range(4)]
+        t1 = RegionFailoverTracker(regions, 10.0, 30.0, clock=clock)
+        t2 = RegionFailoverTracker(regions, 10.0, 30.0, clock=clock)
+        for home in regions:
+            a = t1.failover_region(home)
+            assert a == t2.failover_region(home)  # same everywhere
+            assert a != home
+            b = t1.failover_region(home, exclude=[a])
+            assert b not in (home, a)
+        # Not everyone drains to the same survivor.
+        targets = {t1.failover_region(h) for h in regions}
+        assert len(targets) > 1
+
+    def test_all_stale_never_empty(self):
+        clock = Clock(0.0)
+        t = RegionFailoverTracker(["region-0", "region-1"], 1.0, 2.0,
+                                  clock=clock)
+        t.observe_digest("region-0", 1)
+        t.observe_digest("region-1", 1)
+        clock.t = 50.0
+        assert t.stale_regions() == ["region-0", "region-1"]
+        assert t.routable_regions() == ["region-0", "region-1"]
+        assert t.failover_region("region-0") is None
+
+
+# -- cross-region hot-chain admission -----------------------------------------
+
+
+class TestCrossRegionWarm:
+    def _router_with_warm(self, clock, threshold=1.5, cooldown=60.0):
+        warmed = []
+
+        def warm_fn(chain):
+            warmed.append(chain.head)
+            return len(chain.prefix_hashes)
+
+        cfg = FederationConfig(
+            region_id="region-0", regions=["region-0", "region-1"],
+            digest_suspect_after_s=10.0, digest_stale_after_s=30.0,
+            replicate_score_threshold=threshold,
+            replicate_cooldown_s=cooldown,
+        )
+        router = GlobalRouter(cfg, [
+            Region("region-0", _fixed_scorer({}),
+                   tracker=_tracker(clock), warm_fn=warm_fn),
+            Region("region-1", _fixed_scorer({})),
+        ], clock=clock)
+        return router, warmed
+
+    def _hot_digest(self, clock, score, head=901, seq=1):
+        tr = _tracker(clock)
+        for _ in range(int(score)):
+            tr.observe_route(
+                [head, head + 1], tokens=list(range(2 * BLOCK_SIZE)),
+                block_size=BLOCK_SIZE, model_name=TEST_MODEL_NAME,
+            )
+        return build_digest("region-1", tr, seq=seq, now=clock())
+
+    def test_remote_hot_chain_lands_once_per_cooldown(self):
+        clock = Clock(0.0)
+        router, warmed = self._router_with_warm(clock)
+        digest = self._hot_digest(clock, score=3)
+        router.ingest_digest(digest)
+        assert warmed == [901]
+        assert router.stats_counters["warmed_blocks"] == 2
+        # Same chain inside the cooldown: skipped, counted.
+        router.ingest_digest(self._hot_digest(clock, score=3, seq=2))
+        assert warmed == [901]
+        assert router.stats_counters["warm_skipped_cooldown"] == 1
+        # Past the cooldown it may land again.
+        clock.t = 61.0
+        router.ingest_digest(self._hot_digest(clock, score=3, seq=3))
+        assert warmed == [901, 901]
+
+    def test_cold_chains_do_not_travel(self):
+        clock = Clock(0.0)
+        router, warmed = self._router_with_warm(clock, threshold=10.0)
+        router.ingest_digest(self._hot_digest(clock, score=2))
+        assert warmed == []
+
+    def test_own_digest_never_warms_itself(self):
+        clock = Clock(0.0)
+        router, warmed = self._router_with_warm(clock)
+        tr = router.regions["region-0"].tracker
+        for _ in range(3):
+            tr.observe_route(
+                [333], tokens=list(range(BLOCK_SIZE)),
+                block_size=BLOCK_SIZE, model_name=TEST_MODEL_NAME,
+            )
+        router.build_local_digest()
+        assert warmed == []
+
+
+# -- HTTP surface -------------------------------------------------------------
+
+
+class TestFederationHttp:
+    def _service(self):
+        from llm_d_kv_cache_manager_tpu.api.http_service import ScoringService
+
+        env = {
+            "zmq_endpoint": "tcp://127.0.0.1:15999",
+            "zmq_topic": "kv@",
+            "pool_concurrency": 1,
+            "hash_seed": "",
+            "block_size": BLOCK_SIZE,
+            "http_port": 0,
+            "enable_metrics": False,
+            "federation": True,
+            "federation_region_id": "region-0",
+            "federation_regions": ["region-0", "region-1"],
+        }
+        return ScoringService(env, indexer=_make_indexer())
+
+    def test_status_score_digest_and_readyz_section(self):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        service = self._service()
+        rng = random.Random(2)
+        prompt = _text(rng, 20)
+        _populate(service.indexer, rng, [prompt])
+
+        async def run():
+            async with TestClient(TestServer(service.make_app())) as client:
+                resp = await client.get("/federation/status")
+                assert resp.status == 200
+                doc = await resp.json()
+                assert doc["region_id"] == "region-0"
+                assert set(doc["regions"]) == {"region-0", "region-1"}
+
+                # Scoring entry: pod scores + region evidence. region-1 is
+                # configured but unattached; home affinity keeps the pick
+                # local.
+                resp = await client.post("/federation/score", json={
+                    "prompt": prompt, "model": TEST_MODEL_NAME,
+                    "home_region": "region-0",
+                })
+                assert resp.status == 200
+                data = await resp.json()
+                assert data["region"] == "region-0"
+                assert data["podScores"]
+                flat = service.indexer.get_pod_scores(
+                    prompt, TEST_MODEL_NAME, []
+                )
+                assert data["podScores"] == flat
+
+                # Digest seam: GET builds ours, POST round-trips it back
+                # (self-digests are valid input — idempotent refresh).
+                resp = await client.get("/federation/digest")
+                assert resp.status == 200
+                body = await resp.read()
+                assert body.startswith(b"KVTPUDGST")
+                resp = await client.post("/federation/digest", data=body)
+                assert resp.status == 200
+                assert (await resp.json())["region"] == "region-0"
+                resp = await client.post(
+                    "/federation/digest", data=b"garbage"
+                )
+                assert resp.status == 400
+
+                # /readyz carries the federation section.
+                service.start(with_subscriber=False)
+                resp = await client.get("/readyz")
+                data = await resp.json()
+                assert data["federation"]["region_id"] == "region-0"
+                assert "region-1" in data["federation"]["regions"]
+
+        try:
+            asyncio.run(run())
+        finally:
+            service.stop()
+
+    def test_disabled_surface_is_400(self):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+        from llm_d_kv_cache_manager_tpu.api.http_service import ScoringService
+
+        env = {
+            "zmq_endpoint": "tcp://127.0.0.1:15998",
+            "zmq_topic": "kv@",
+            "pool_concurrency": 1,
+            "hash_seed": "",
+            "block_size": BLOCK_SIZE,
+            "http_port": 0,
+            "enable_metrics": False,
+        }
+        service = ScoringService(env, indexer=_make_indexer())
+
+        async def run():
+            async with TestClient(TestServer(service.make_app())) as client:
+                for path in (
+                    "/federation/status", "/federation/digest",
+                ):
+                    resp = await client.get(path)
+                    assert resp.status == 400
+                resp = await client.get("/readyz")
+                assert (await resp.json())["federation"] is None
+
+        try:
+            asyncio.run(run())
+        finally:
+            service.indexer.shutdown()
+
+
+# -- cross-region gRPC transport (federation-marked: needs grpcio) ------------
+
+
+@pytest.mark.federation
+class TestGrpcCrossRegion:
+    def test_remote_region_scores_match_local(self):
+        """A remote region behind the cluster gRPC transport answers
+        byte-identically to scoring it locally — the transport is the
+        same one the scatter-gather front already trusts."""
+        from llm_d_kv_cache_manager_tpu.api.grpc_server import serve_grpc
+        from llm_d_kv_cache_manager_tpu.cluster.scorer import (
+            GrpcReplicaTransport,
+        )
+
+        rng = random.Random(21)
+        remote = _make_indexer()
+        local = _make_indexer()
+        prompts = [_text(rng, rng.randint(8, 24)) for _ in range(4)]
+        _populate(remote, rng, prompts)
+        port = _free_port()
+        server = serve_grpc(remote, f"127.0.0.1:{port}")
+        clock = Clock(0.0)
+        tracker = _tracker(clock)
+        local.popularity = tracker
+        cfg = FederationConfig(
+            region_id="region-0", regions=["region-0", "region-1"],
+            digest_suspect_after_s=10.0, digest_stale_after_s=30.0,
+        )
+        router = GlobalRouter(cfg, [
+            Region("region-0", local, tracker=tracker),
+            Region(
+                "region-1",
+                GrpcReplicaTransport(f"127.0.0.1:{port}", timeout_s=5.0),
+            ),
+        ], derive_fn=derive_fn_from_indexer(local), clock=clock)
+        try:
+            # Ship region-1's digest so its prefixes read hot globally.
+            remote_tracker = _tracker(clock)
+            for prompt in prompts:
+                hashes = derive_fn_from_indexer(remote)(
+                    prompt, TEST_MODEL_NAME
+                )
+                remote_tracker.observe_route(hashes)
+                remote_tracker.observe_route(hashes)
+            router.ingest_digest(encode_digest(build_digest(
+                "region-1", remote_tracker, seq=1, now=clock(),
+            )))
+            for prompt in prompts:
+                ref = remote.get_pod_scores_ex(prompt, TEST_MODEL_NAME, [])
+                got = router.score_ex(prompt, TEST_MODEL_NAME, [])
+                assert got.region == "region-1"
+                assert got.pod_scores.scores == ref.scores
+                assert got.pod_scores.match_blocks == ref.match_blocks
+                assert got.pod_scores.block_hashes == ref.block_hashes
+            assert any(
+                remote.get_pod_scores(p, TEST_MODEL_NAME, [])
+                for p in prompts
+            )
+        finally:
+            router.regions["region-1"].scorer.close()
+            server.stop(grace=0)
+            remote.shutdown()
+            local.shutdown()

@@ -30,7 +30,12 @@ from llm_d_kv_cache_manager_tpu.obs import spans as obs_spans
 #   phase   — fleet-membership lifecycle phases (cluster/membership.py
 #             PHASES tuple: joining/warming/reassigning/serving/
 #             draining/left)
-ALLOWED_LABELS = {"state", "kind", "backend", "op", "plane", "stage", "phase"}
+#   region  — federation region ids (the FIXED configured region set,
+#             FederationConfig.regions / FEDERATION_REGIONS — deployment
+#             topology, never traffic)
+ALLOWED_LABELS = {
+    "state", "kind", "backend", "op", "plane", "stage", "phase", "region",
+}
 ALLOWED_PLANES = {"read", "write", "transfer", "cluster", "other"}
 
 
@@ -74,6 +79,18 @@ def test_collectors_exist():
     assert "admission_queued" in collectors
     assert "routing_policy_overrides" in collectors
     assert "membership_transitions" in collectors
+    # Hierarchical federation (federation/): per-region routing volume +
+    # digest age gauge (both carrying the bounded `region` label), the
+    # staleness state machine's transitions, and the WAN-cost counters
+    # (digest bytes, cross-region warmed blocks, mispicks, failovers) —
+    # all inside the walk so their label bounds stay enforced.
+    assert "federation_routes" in collectors
+    assert "federation_digest_age" in collectors
+    assert "federation_transitions" in collectors
+    assert "federation_digest_bytes" in collectors
+    assert "federation_warmed_blocks" in collectors
+    assert "federation_mispicks" in collectors
+    assert "federation_failovers" in collectors
 
 
 def test_membership_phase_label_values_are_code_defined():
@@ -90,6 +107,24 @@ def test_membership_phase_label_values_are_code_defined():
             phase = sample.labels.get("phase")
             if phase is not None:
                 assert phase in PHASES, f"unexpected phase {phase!r}"
+
+
+def test_federation_transition_state_values_are_code_defined():
+    """The federation region-transition `state` label carries only the
+    fleethealth vocabulary (the federation reuses it verbatim at region
+    granularity)."""
+    from llm_d_kv_cache_manager_tpu.fleethealth import HEALTHY, STALE, SUSPECT
+
+    metrics.register_metrics()
+    for metric in REGISTRY.collect():
+        if metric.name != "kvcache_federation_region_transitions":
+            continue
+        for sample in metric.samples:
+            state = sample.labels.get("state")
+            if state is not None:
+                assert state in (HEALTHY, SUSPECT, STALE), (
+                    f"unexpected region state {state!r}"
+                )
 
 
 def test_admission_shed_kind_values_are_code_defined():

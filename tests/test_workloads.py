@@ -206,3 +206,135 @@ class TestSimBenchShareGPT:
             f"sharegpt={hit_sharegpt:.3f} uniform={hit_uniform:.3f}"
         )
         assert hit_uniform < 0.1  # the control really is reuse-free
+
+
+class TestGeoWorkload:
+    """workloads/geo.py: home-pinned sessions, diurnal skew, and the
+    trace schema's optional `region` field with strict back-compat."""
+
+    def test_deterministic_and_home_pinned(self):
+        from llm_d_kv_cache_manager_tpu.workloads import (
+            GeoConfig,
+            generate_geo,
+        )
+
+        cfg = GeoConfig(n_sessions=50, seed=7)
+        trace = generate_geo(cfg)
+        assert generate_geo(cfg) == trace
+        # Every session carries exactly one home region from the
+        # configured set, and every materialized request inherits it.
+        names = {f"region-{r}" for r in range(cfg.n_regions)}
+        assert set(trace.session_regions) == set(trace.sessions)
+        assert set(trace.session_regions.values()) <= names
+        for req in trace.materialize():
+            assert req.region == trace.session_regions[req.session]
+
+    def test_diurnal_skew_shifts_regional_peaks(self):
+        from llm_d_kv_cache_manager_tpu.workloads import (
+            GeoConfig,
+            diurnal_weights,
+            generate_geo,
+        )
+
+        cfg = GeoConfig(
+            n_sessions=240, seed=3, diurnal_amplitude=0.9,
+            day_period_s=60.0, session_rate_per_s=8.0,
+        )
+        trace = generate_geo(cfg)
+        # Each region's sessions concentrate in its own phase window:
+        # the mean within-day phase of each region's session starts must
+        # track the region's peak (circular mean within half a period).
+        import math
+
+        starts = {}
+        for sid, region in trace.session_regions.items():
+            first = min(
+                t.arrival_s for t in trace.turns if t.session == sid
+            )
+            starts.setdefault(region, []).append(first)
+        for r in range(cfg.n_regions):
+            region = f"region-{r}"
+            if len(starts.get(region, [])) < 10:
+                continue
+            xs = [
+                2 * math.pi * (t / cfg.day_period_s)
+                for t in starts[region]
+            ]
+            mean_phase = math.atan2(
+                sum(math.sin(x) for x in xs) / len(xs),
+                sum(math.cos(x) for x in xs) / len(xs),
+            ) % (2 * math.pi)
+            peak = (2 * math.pi * (0.25 + r / cfg.n_regions)) % (
+                2 * math.pi
+            )
+            dist = min(
+                abs(mean_phase - peak), 2 * math.pi - abs(mean_phase - peak)
+            )
+            assert dist < math.pi / 2, (
+                f"{region}: mean phase {mean_phase:.2f} far from its "
+                f"peak {peak:.2f}"
+            )
+        # Amplitude 0 is the uniform control: no region starves.
+        flat = generate_geo(GeoConfig(
+            n_sessions=240, seed=3, diurnal_amplitude=0.0,
+            session_rate_per_s=8.0,
+        ))
+        counts = {}
+        for region in flat.session_regions.values():
+            counts[region] = counts.get(region, 0) + 1
+        assert min(counts.values()) > 240 / (flat.config["n_regions"] * 3)
+
+    def test_geo_trace_roundtrip_bit_identical(self, tmp_path):
+        from llm_d_kv_cache_manager_tpu.workloads import (
+            GeoConfig,
+            generate_geo,
+        )
+
+        trace = generate_geo(GeoConfig(n_sessions=20, seed=5))
+        path = tmp_path / "geo.jsonl"
+        write_trace(trace, str(path))
+        replayed = read_trace(str(path))
+        assert replayed == trace
+        assert replayed.session_regions == trace.session_regions
+        buf = io.StringIO()
+        write_trace(replayed, buf)
+        assert buf.getvalue() == path.read_text(encoding="utf-8")
+
+    def test_pre_region_trace_replays_unchanged(self, tmp_path):
+        """A trace recorded before this PR (no `region` keys) parses with
+        empty session_regions, materializes with region=None, and
+        re-serializes byte-identically — the strict back-compat pin."""
+        old = "\n".join([
+            '{"config": {}, "kind": "header", '
+            '"schema": "kvtpu-workload-trace/v1", "seed": 1, '
+            '"tables_version": "sharegpt-v1", "workload": "sharegpt"}',
+            '{"id": "s0", "kind": "session", '
+            '"system_prefix": "hello world"}',
+            '{"arrival_s": 0.5, "kind": "turn", "output_len": 2, '
+            '"response_text": "ok there", "session": "s0", "turn": 0, '
+            '"user_len": 1, "user_text": "hi"}',
+        ]) + "\n"
+        path = tmp_path / "old.jsonl"
+        path.write_text(old, encoding="utf-8")
+        trace = read_trace(str(path))
+        assert trace.session_regions == {}
+        reqs = trace.requests()
+        assert [r.region for r in reqs] == [None]
+        buf = io.StringIO()
+        write_trace(trace, buf)
+        assert buf.getvalue() == old
+
+    def test_region_survives_record_replay(self, tmp_path):
+        """Old writer ∘ new reader is covered above; this is new writer ∘
+        new reader: the region pin must survive a full record/replay and
+        reach the replayed MaterializedRequests."""
+        from llm_d_kv_cache_manager_tpu.workloads import (
+            GeoConfig,
+            generate_geo,
+        )
+
+        trace = generate_geo(GeoConfig(n_sessions=10, seed=2))
+        path = tmp_path / "geo.jsonl"
+        write_trace(trace, str(path))
+        for req in read_trace(str(path)).materialize():
+            assert req.region == trace.session_regions[req.session]
