@@ -4,8 +4,8 @@
 
 .PHONY: native kvtransfer test bench bench-micro bench-read bench-obs \
 	bench-batch bench-faults bench-replication bench-placement \
-	bench-autoscale bench-geo bench-transfer clean proto lint \
-	precommit-install image-build image-push
+	bench-anticipate bench-autoscale bench-geo bench-transfer clean \
+	proto lint precommit-install image-build image-push
 
 # Container image coordinates (override per environment/registry). The
 # release workflow (.github/workflows/ci-release.yaml) builds the same
@@ -101,6 +101,13 @@ bench-replication:
 # Headless; rewrites benchmarking/FLEET_BENCH_PLACEMENT.json.
 bench-placement: kvtransfer
 	JAX_PLATFORMS=cpu python bench.py --placement
+
+# Anticipatory-prefetch scenario (prediction/): the session predictor
+# pre-lands each session's next turn during its think window; reactive
+# vs anticipate arms over the ShareGPT and agentic replays. Headless;
+# rewrites benchmarking/FLEET_BENCH_ANTICIPATE.json.
+bench-anticipate: kvtransfer
+	JAX_PLATFORMS=cpu python bench.py --anticipate
 
 # Saturation-resilience scenario (kvcache/routing.py + cluster/membership.py):
 # the qps ladder's collapse row under load-aware routing + elastic membership

@@ -463,6 +463,79 @@ def fleet_placement_section() -> str:
     ])
 
 
+def fleet_anticipate_section() -> str:
+    """Anticipatory-prefetch scenario (bench.py --anticipate /
+    prediction/ subsystem): what pre-landing each session's next turn
+    during its think window buys over the reactive data plane."""
+    path = os.path.join(HERE, "FLEET_BENCH_ANTICIPATE.json")
+    if not os.path.exists(path):
+        raise SystemExit(
+            "benchmarking/FLEET_BENCH_ANTICIPATE.json missing — run "
+            "`python bench.py --anticipate`"
+        )
+    stats = _load(path)
+    cfg = stats["config"]
+    arms = stats["arms"]
+    rows = []
+    for name, label in (
+        ("sharegpt_reactive", "sharegpt, reactive"),
+        ("sharegpt_anticipate", "**sharegpt, + prediction**"),
+        ("agentic_reactive", "agentic, reactive"),
+        ("agentic_anticipate", "**agentic, + prediction**"),
+    ):
+        a = arms[name]
+        rows.append(
+            f"| {label} | {a['ttft_p50_s']} | {a['ttft_turn2plus_p50_s']} "
+            f"| {a['ttft_turn2plus_p90_s']} "
+            f"| {a['prefix_resident_before_arrival_frac']:.1%} "
+            f"| {a['restored_blocks']} "
+            f"| {a.get('mispredicted_bytes', 0) / (1024 * 1024):.1f} |"
+        )
+    sg = arms["sharegpt_anticipate"]
+    pred = sg.get("prediction", {})
+    sched = pred.get("scheduler", {})
+    return "\n".join([
+        "Anticipatory-prefetch arm (prediction/): a session predictor "
+        "learns per-session next-turn ETAs from the read path alone "
+        "(EWMA over inter-turn gaps blended with a fleet-level quantile "
+        "prior) and, inside the predicted idle window, pre-lands the "
+        "continuation prefix on the pod the ROUTER would pick "
+        "(`Indexer.score_hashes` — same lookup/score/health/policy "
+        "stages). Jobs ride the bounded prefetch queue "
+        "(source=`prediction`) into `warm_chain`, which aborts on page "
+        f"pressure — serving always wins. Fleet at "
+        f"{cfg['pages_per_pod']} pages/pod (think-window eviction is "
+        "real), winning-regime model class, both arms over the SAME "
+        "replays.",
+        "",
+        "| Arm | TTFT p50 (s) | turn≥2 p50 (s) | turn≥2 p90 (s) "
+        "| full prefix resident before arrival | restored on TTFT path "
+        "| mispredicted MB |",
+        "|---|---:|---:|---:|---:|---:|---:|",
+        *rows,
+        "",
+        f"On the ShareGPT replay, "
+        f"**{stats['sharegpt_prefix_resident_frac']:.1%} of turn-N≥2 "
+        "requests arrive with their full previous-turn prompt chain "
+        "already device-resident** on the routed pod (target ≥50%; "
+        f"audited at the pre-admit seam), and turn-N≥2 TTFT p50 improves "
+        f"**{stats['sharegpt_ttft_turn2plus_p50_speedup']}x** over the "
+        "reactive arm (overall p50 "
+        f"{stats['sharegpt_ttft_p50_speedup']}x) — "
+        f"{sched.get('jobs_submitted', 0)} prefetch jobs moved "
+        f"{sg.get('predicted_landed_blocks', 0)} restore blocks off the "
+        "TTFT path into think windows. The agentic replay is the "
+        "predictor's best case: tight tool loops + branch-shared "
+        f"prefixes hold {stats['agentic_prefix_resident_frac']:.1%} "
+        "residency. Honest cost: "
+        f"{stats['sharegpt_mispredicted_bytes'] / (1024 * 1024):.1f} MB "
+        "pre-landed for turns that never arrived (or for a pod the "
+        "router then didn't pick) on sharegpt, "
+        f"{stats['agentic_mispredicted_bytes'] / (1024 * 1024):.1f} MB "
+        "on agentic. Source: `FLEET_BENCH_ANTICIPATE.json`.",
+    ])
+
+
 def fleet_geo_section() -> str:
     """Hierarchical-federation geo scenario (bench.py --geo / federation/
     subsystem): what two-level region routing buys over a flat global
@@ -1167,6 +1240,7 @@ def regenerate(text: str) -> str:
         ("fleet-faults", fleet_faults_section()),
         ("fleet-replication", fleet_replication_section()),
         ("fleet-placement", fleet_placement_section()),
+        ("fleet-anticipate", fleet_anticipate_section()),
         ("fleet-autoscale", fleet_autoscale_section()),
         ("fleet-geo", fleet_geo_section()),
         ("fleet-device", fleet_device_section()),

@@ -51,6 +51,12 @@ the ZMQ KVEvents write plane, and Prometheus metrics behind HTTP:
   GET/POST /federation/digest   the digest shipping seam: GET builds this
                                 region's encoded RegionDigest; POST
                                 ingests a peer's
+  GET  /prediction/status       anticipatory-prefetch introspection:
+                                session-table occupancy/ETA evidence (the
+                                soonest-expected sessions), misprediction
+                                counters, and — when an embedder wires
+                                them — the scheduler's policy stats and
+                                the prefetch queue's per-source drops
   GET  /debug/traces            flight recorder dump: recent complete
                                 traces + the slow-outlier reservoir
                                 (?n=<count> caps the recent list)
@@ -73,10 +79,14 @@ ADMISSION_QUEUE_DEPTH / ADMISSION_MAX_WAIT_MS / ADMISSION_RETRY_AFTER_MS
 client's remaining budget propagates via the X-Request-Deadline-Ms
 header), the load-aware routing policy ROUTING_POLICY /
 ROUTING_LOAD_WEIGHT / ROUTING_QUEUE_NORM / ROUTING_BUSY_NORM_S /
-ROUTING_PREEMPTION_NORM, and the federation tier FEDERATION /
+ROUTING_PREEMPTION_NORM, the federation tier FEDERATION /
 FEDERATION_REGION_ID / FEDERATION_REGIONS / FEDERATION_PEERS /
 FEDERATION_DIGEST_INTERVAL_S / FEDERATION_DIGEST_SUSPECT_S /
-FEDERATION_DIGEST_STALE_S.
+FEDERATION_DIGEST_STALE_S, and the session predictor PREDICTION /
+PREDICTION_MAX_SESSIONS / PREDICTION_ETA_ALPHA /
+PREDICTION_MAX_CHAIN_BLOCKS / PREDICTION_DEFAULT_ETA_S (PREDICTION=0,
+the default, keeps the read path byte-for-byte — the table is pure
+observation even when on).
 
 Run: python -m llm_d_kv_cache_manager_tpu.api.http_service
 """
@@ -234,6 +244,27 @@ def config_from_env() -> dict:
         ),
         "routing_preemption_norm": float(
             os.environ.get("ROUTING_PREEMPTION_NORM", "8.0")
+        ),
+        # Anticipatory prefetch (prediction/): PREDICTION=1 attaches the
+        # session predictor's table at the read-path observation seam.
+        # Observation only — scores stay bit-identical; the prefetch
+        # scheduler itself needs a prefetch plane to the engine fleet, so
+        # embedders wire a PrefetchScheduler + RoutePrefetcher and assign
+        # them to `self.prefetch_scheduler` / `self.route_prefetcher` to
+        # surface through /prediction/status and /readyz. PREDICTION=0
+        # (default) leaves the seam None.
+        "prediction": os.environ.get("PREDICTION", "0") == "1",
+        "prediction_max_sessions": int(
+            os.environ.get("PREDICTION_MAX_SESSIONS", "1024")
+        ),
+        "prediction_eta_alpha": float(
+            os.environ.get("PREDICTION_ETA_ALPHA", "0.4")
+        ),
+        "prediction_max_chain_blocks": int(
+            os.environ.get("PREDICTION_MAX_CHAIN_BLOCKS", "256")
+        ),
+        "prediction_default_eta_s": float(
+            os.environ.get("PREDICTION_DEFAULT_ETA_S", "8")
         ),
     }
 
@@ -442,6 +473,32 @@ class ScoringService:
                 index = index.inner
             if hasattr(index, "bind_popularity"):  # cost-aware backend
                 index.bind_popularity(self.popularity)
+
+        # Anticipatory prefetch (prediction/): PREDICTION=1 attaches the
+        # session table at the read-path observation seam. The scheduler
+        # and its prefetch plane are embedder-wired (like the placement
+        # replicator) — assign to `prefetch_scheduler`/`route_prefetcher`
+        # to surface them through /prediction/status and /readyz.
+        self.session_table = None
+        self.prefetch_scheduler = None
+        self.route_prefetcher = None
+        if env.get("prediction"):
+            from llm_d_kv_cache_manager_tpu.prediction import (
+                PredictionConfig,
+                SessionTable,
+            )
+
+            self.session_table = SessionTable(PredictionConfig(
+                max_sessions=int(env.get("prediction_max_sessions", 1024)),
+                eta_alpha=float(env.get("prediction_eta_alpha", 0.4)),
+                max_chain_blocks=int(
+                    env.get("prediction_max_chain_blocks", 256)
+                ),
+                default_eta_s=float(
+                    env.get("prediction_default_eta_s", 8.0)
+                ),
+            ))
+            self.indexer.prediction = self.session_table
 
         # Hierarchical federation (federation/): this process becomes one
         # region of a global fleet. The local region wraps THIS indexer;
@@ -807,7 +864,30 @@ class ScoringService:
                 self.federation.status() if self.federation is not None
                 else None
             ),
+            # Anticipatory-prefetch section: session-table occupancy +
+            # misprediction counters, and — when a prefetch plane is
+            # wired — the queue's depth and PER-SOURCE drop counters, so
+            # a budget-bounded prediction drop is distinguishable from a
+            # route-prefetch drop. Never gates readiness: a cold (or
+            # absent) predictor is a correct predictor.
+            "prediction": self._prediction_section(),
         }
+
+    def _prediction_section(self) -> Optional[dict]:
+        if self.session_table is None and self.route_prefetcher is None:
+            return None
+        section: dict = {}
+        if self.session_table is not None:
+            stats = self.session_table.stats()
+            metrics_collector.set_prediction_sessions(
+                stats["tracked_sessions"]
+            )
+            section["table"] = stats
+        if self.prefetch_scheduler is not None:
+            section["scheduler"] = dict(self.prefetch_scheduler.stats)
+        if self.route_prefetcher is not None:
+            section["prefetcher"] = self.route_prefetcher.status()
+        return section
 
     async def handle_readyz(self, request: web.Request) -> web.Response:
         payload = await asyncio.to_thread(self.readiness)
@@ -829,6 +909,26 @@ class ScoringService:
                     else None
                 ),
             }
+
+        return web.json_response(await asyncio.to_thread(build))
+
+    async def handle_prediction_status(
+        self, request: web.Request
+    ) -> web.Response:
+        """Anticipatory-prefetch introspection: the session table's
+        occupancy/ETA evidence (soonest-expected sessions, tails as hex —
+        data, never metric labels), misprediction counters, and the
+        scheduler/prefetch-plane stats when an embedder wired them."""
+        if self.session_table is None:
+            return web.json_response(
+                {"error": "prediction disabled (set PREDICTION=1)"},
+                status=400,
+            )
+
+        def build():
+            section = self._prediction_section() or {}
+            section["soonest_sessions"] = self.session_table.snapshot()
+            return section
 
         return web.json_response(await asyncio.to_thread(build))
 
@@ -1066,6 +1166,7 @@ class ScoringService:
         app.router.add_get("/routing/status", self.handle_routing_status)
         app.router.add_post("/pod_load", self.handle_pod_load)
         app.router.add_get("/placement/status", self.handle_placement_status)
+        app.router.add_get("/prediction/status", self.handle_prediction_status)
         app.router.add_get(
             "/federation/status", self.handle_federation_status
         )

@@ -716,6 +716,20 @@ class EnginePod:
         ]
         return self.tier_store.prefetch(missing)
 
+    def resident_prefix_blocks(self, chunk_hashes: List[int]) -> int:
+        """Length of the leading run of `chunk_hashes` whose blocks are
+        resident in this pod's device cache RIGHT NOW. The anticipate
+        bench's audit seam: called at arrival time, before admission, it
+        answers "was the predicted continuation prefix fully pre-landed
+        before the request showed up?" — prefill would make the blocks
+        resident and erase the evidence."""
+        n = 0
+        for h in chunk_hashes:
+            if not self.block_manager.is_cached(h):
+                break
+            n += 1
+        return n
+
     def warm_chain(self, tokens: List[int], lora_id: Optional[int] = None) -> int:
         """Replication warm admission (placement/): materialize the longest
         *restorable* prefix of this token chain through the data plane

@@ -30,6 +30,11 @@ logger = kvlog.get_logger("kv_connectors.prefetch")
 
 PrefetchFn = Callable[[str, List[int]], int]
 
+# The fixed submitter vocabulary: every plane that rides this queue names
+# itself from this set, and the per-source drop metric's label is bounded
+# by it (tests/test_metrics_hygiene.py pins the values).
+PREFETCH_SOURCES = ("route", "replication", "prediction")
+
 
 class RoutePrefetcher:
     """Bounded background queue from routing decisions to pod prefetchers."""
@@ -46,12 +51,36 @@ class RoutePrefetcher:
         self.stats: Dict[str, int] = {
             "submitted": 0, "dropped": 0, "executed": 0, "blocks_queued": 0,
         }
+        # Per-source bookkeeping: the queue is shared by route-driven
+        # prefetch, hot-prefix replication, and anticipatory prediction,
+        # and a drop means something different for each (a route drop
+        # costs this request's TTFT; a prediction drop costs nothing now).
+        # One aggregate counter hid which plane was being shed.
+        self.source_stats: Dict[str, Dict[str, int]] = {}
 
-    def submit(self, pod_identifier: str, block_hashes: List[int]) -> bool:
+    def _source(self, source: str) -> Dict[str, int]:
+        st = self.source_stats.get(source)
+        if st is None:
+            st = self.source_stats[source] = {
+                "submitted": 0, "dropped": 0, "executed": 0,
+                "blocks_queued": 0,
+            }
+        return st
+
+    def queue_depth(self) -> int:
+        """Entries waiting for the worker (approximate, lock-free)."""
+        return self._q.qsize()
+
+    def submit(
+        self,
+        pod_identifier: str,
+        block_hashes: List[int],
+        source: str = "route",
+    ) -> bool:
         """Queue the chosen pod's missing tail for background prefetch.
-        Non-blocking: returns False (and counts a drop) when the queue is
-        full or the prefetcher is closed — the engine's fault path stays
-        correct without the hint."""
+        Non-blocking: returns False (and counts a drop, per `source`) when
+        the queue is full or the prefetcher is closed — the engine's fault
+        path stays correct without the hint."""
         if not block_hashes:
             return False
         with self._mu:
@@ -59,11 +88,14 @@ class RoutePrefetcher:
                 return False
             self._ensure_thread()
         try:
-            self._q.put_nowait((pod_identifier, list(block_hashes)))
+            self._q.put_nowait((pod_identifier, list(block_hashes), source))
         except queue.Full:
             self.stats["dropped"] += 1
+            self._source(source)["dropped"] += 1
+            metrics.count_prefetch_drop(source)
             return False
         self.stats["submitted"] += 1
+        self._source(source)["submitted"] += 1
         return True
 
     def submit_route(self, pod_identifier: str, pod_scores) -> bool:
@@ -83,7 +115,7 @@ class RoutePrefetcher:
             item = self._q.get()
             if item is None:
                 return
-            pod_identifier, block_hashes = item
+            pod_identifier, block_hashes, source = item
             try:
                 if not self._closed:
                     # A root trace: the prefetch worker thread never has a
@@ -92,6 +124,9 @@ class RoutePrefetcher:
                         n = self.prefetch_fn(pod_identifier, block_hashes)
                     self.stats["executed"] += 1
                     self.stats["blocks_queued"] += int(n or 0)
+                    st = self._source(source)
+                    st["executed"] += 1
+                    st["blocks_queued"] += int(n or 0)
                     metrics.count_route_prefetch(int(n or 0))
             except Exception as e:  # noqa: BLE001 - a hint must never kill
                 logger.debug(  # the worker; the engine restores on fault
@@ -99,6 +134,20 @@ class RoutePrefetcher:
                 )
             finally:
                 self._processed += 1
+
+    def status(self) -> dict:
+        """Introspection snapshot (the /readyz prefetch section): queue
+        occupancy plus aggregate AND per-source counters, so a
+        budget-bounded prediction drop is distinguishable from a
+        route-prefetch drop at a glance."""
+        return {
+            "queue_depth": self.queue_depth(),
+            "queue_bound": self._q.maxsize,
+            "stats": dict(self.stats),
+            "by_source": {
+                src: dict(st) for src, st in self.source_stats.items()
+            },
+        }
 
     def drain(self, timeout_s: float = 5.0) -> None:
         """Wait until every submitted entry has been handed to

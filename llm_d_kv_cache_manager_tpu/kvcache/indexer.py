@@ -110,6 +110,7 @@ class Indexer:
         fleet_health=None,
         popularity=None,
         routing_policy=None,
+        prediction=None,
     ):
         self.config = config or IndexerConfig()
         # Optional fleethealth.FleetHealthTracker: when wired, scores pass
@@ -130,6 +131,13 @@ class Indexer:
         # bit-identical with the tracker attached, and None (the default)
         # keeps the hot path at one attribute check.
         self.popularity = popularity
+        # Optional prediction.SessionTable: every scored request reports
+        # its chain + token slice to the session predictor
+        # (prediction/sessions.py), which learns per-session next-turn
+        # ETAs and continuation prefixes. Observation only — same contract
+        # as the popularity seam: scores are bit-identical with a table
+        # attached, None costs one attribute check.
+        self.prediction = prediction
 
         self.prefix_store = (
             tokenization_pool.prefix_store
@@ -280,6 +288,17 @@ class Indexer:
                 model_name=model_name,
                 block_size=self.token_processor.block_size,
             )
+        if self.prediction is not None:
+            # Session prediction (prediction/): continuation detection +
+            # think-time learning over the same chain the scorer is about
+            # to walk. Pure observation — nothing below reads the table.
+            self.prediction.observe_route(
+                [k.chunk_hash for k in block_keys],
+                tokens=tokenized.tokens,
+                lora_id=lora_id,
+                model_name=model_name,
+                block_size=self.token_processor.block_size,
+            )
 
         with obs.stage("read.lookup"):
             key_to_pods = self.kv_block_index.lookup(
@@ -413,6 +432,14 @@ class Indexer:
                     model_name=requests[i].model_name,
                     block_size=self.token_processor.block_size,
                 )
+            if self.prediction is not None:
+                self.prediction.observe_route(
+                    [k.chunk_hash for k in block_keys],
+                    tokens=tokenized[i].tokens,
+                    lora_id=loras[i],
+                    model_name=requests[i].model_name,
+                    block_size=self.token_processor.block_size,
+                )
             pods = tuple(requests[i].pod_identifiers)
             pod_set = pod_sets.get(pods)
             if pod_set is None:
@@ -483,6 +510,44 @@ class Indexer:
                         block_hashes=[k.chunk_hash for k in spec["keys"]],
                     )
         return results
+
+    def score_hashes(
+        self,
+        model_name: str,
+        block_hashes: Sequence[int],
+        pod_identifiers: Sequence[str] = (),
+    ) -> PodScores:
+        """Score pods over an ALREADY-DERIVED chain: the read path's
+        lookup/score/fleet-health/routing-policy stages, minus
+        tokenization and key derivation (the caller holds the chain —
+        e.g. the anticipatory-prefetch scheduler replaying a session's
+        observed chain during its idle window).
+
+        By running the exact same stages over the same live state, a
+        decision made here can never disagree with what
+        `get_pod_scores_ex` would answer for a prompt deriving this
+        chain — which is what lets the predictor target "the pod the
+        router would pick" instead of a parallel heuristic. Tenant/LoRA
+        scoping needs no extra argument: the adapter id is already mixed
+        into every chunk hash."""
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key
+
+        if not block_hashes:
+            return PodScores()
+        block_keys = [Key(model_name, h) for h in block_hashes]
+        key_to_pods = self.kv_block_index.lookup(
+            block_keys, set(pod_identifiers)
+        )
+        scores, match_blocks = self.scorer.score_ex(block_keys, key_to_pods)
+        if self.fleet_health is not None:
+            scores = self.fleet_health.filter_scores(scores)
+        if self.routing_policy is not None:
+            scores = self.routing_policy.adjust(scores)
+        return PodScores(
+            scores=scores,
+            match_blocks=match_blocks,
+            block_hashes=list(block_hashes),
+        )
 
     def explain_scores(
         self,

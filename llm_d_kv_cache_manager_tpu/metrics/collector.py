@@ -114,6 +114,17 @@ federation_digest_bytes: Optional[Counter] = None
 federation_warmed_blocks: Optional[Counter] = None
 federation_digest_age: Optional[Gauge] = None
 
+# Anticipatory prefetch (prediction/): session-predictor occupancy, jobs
+# landed ahead of their request, and the honest misprediction cost. The
+# prefetch-drop counter's `source` label takes values from the FIXED
+# submitter vocabulary (route | replication | prediction) — plane
+# identity, never traffic.
+prediction_sessions: Optional[Gauge] = None
+prediction_jobs: Optional[Counter] = None
+prediction_blocks: Optional[Counter] = None
+prediction_mispredicted_blocks: Optional[Counter] = None
+prefetch_drops: Optional[Counter] = None
+
 _APPLY_DELAY_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
     5.0, 10.0, 30.0, 60.0,
@@ -146,6 +157,8 @@ def register_metrics(registry=None) -> None:
     global federation_routes, federation_mispicks, federation_failovers
     global federation_transitions, federation_digest_bytes
     global federation_warmed_blocks, federation_digest_age
+    global prediction_sessions, prediction_jobs, prediction_blocks
+    global prediction_mispredicted_blocks, prefetch_drops
 
     with _register_lock:
         if _registered:
@@ -405,6 +418,40 @@ def register_metrics(registry=None) -> None:
             labelnames=("region",),
             registry=reg,
         )
+        prediction_sessions = Gauge(
+            "kvcache_prediction_tracked_sessions",
+            "Sessions currently tracked by the anticipatory-prefetch "
+            "session table (prediction/sessions.py; hard-bounded by "
+            "max_sessions)",
+            registry=reg,
+        )
+        prediction_jobs = Counter(
+            "kvcache_prediction_jobs_total",
+            "Anticipatory prefetch jobs submitted to the prefetch plane "
+            "by the session predictor",
+            registry=reg,
+        )
+        prediction_blocks = Counter(
+            "kvcache_prediction_prefetch_blocks_total",
+            "KV blocks submitted for anticipatory prefetch (pre-landed "
+            "during the session's predicted idle window)",
+            registry=reg,
+        )
+        prediction_mispredicted_blocks = Counter(
+            "kvcache_prediction_mispredicted_blocks_total",
+            "Anticipatorily prefetched blocks whose predicted turn never "
+            "arrived, or that landed on a pod the router did not pick — "
+            "the subsystem's honest cost column",
+            registry=reg,
+        )
+        prefetch_drops = Counter(
+            "kvcache_prefetch_drops_total",
+            "Prefetch jobs dropped at the bounded queue, labeled by the "
+            "submitting plane (fixed vocabulary: route | replication | "
+            "prediction)",
+            labelnames=("source",),
+            registry=reg,
+        )
         _registered = True
 
 
@@ -585,6 +632,28 @@ def count_federation_warmed(blocks: int) -> None:
 def set_federation_digest_age(region: str, age_s: float) -> None:
     if federation_digest_age is not None:
         federation_digest_age.labels(region=region).set(age_s)
+
+
+def set_prediction_sessions(n: int) -> None:
+    if prediction_sessions is not None:
+        prediction_sessions.set(n)
+
+
+def count_prediction_prefetch(blocks: int) -> None:
+    if prediction_jobs is not None:
+        prediction_jobs.inc()
+    if prediction_blocks is not None and blocks:
+        prediction_blocks.inc(blocks)
+
+
+def count_prediction_mispredicted(blocks: int) -> None:
+    if prediction_mispredicted_blocks is not None and blocks:
+        prediction_mispredicted_blocks.inc(blocks)
+
+
+def count_prefetch_drop(source: str) -> None:
+    if prefetch_drops is not None:
+        prefetch_drops.labels(source=source).inc()
 
 
 def counter_value(c: Optional[Counter]) -> float:

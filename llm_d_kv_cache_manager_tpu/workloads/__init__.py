@@ -18,8 +18,21 @@ makes that measurable without network egress:
                 traces against the committed tables.
 - `synthetic` — the historical word-salad backend (both benches' default,
                 kept for artifact continuity; formerly utils/workload.py).
+- `multitenant`/`geo`/`agentic` — scenario generators over the same trace
+                model: Zipf tenant mixes, home-pinned diurnal regions,
+                and fan-out/fan-in sub-agent sessions branching off a
+                shared tool prefix (the anticipatory-prefetch bench's
+                best-case replay).
 """
 
+from llm_d_kv_cache_manager_tpu.workloads.agentic import (  # noqa: F401
+    AgenticConfig,
+    is_root,
+    task_of,
+)
+from llm_d_kv_cache_manager_tpu.workloads.agentic import (  # noqa: F401
+    generate as generate_agentic,
+)
 from llm_d_kv_cache_manager_tpu.workloads.geo import (  # noqa: F401
     GeoConfig,
     diurnal_weights,
@@ -52,14 +65,18 @@ from llm_d_kv_cache_manager_tpu.workloads.trace import (  # noqa: F401
 )
 
 __all__ = [
+    "AgenticConfig",
     "GeoConfig",
     "MultiTenantConfig",
     "ShareGPTConfig",
     "diurnal_weights",
     "generate",
+    "generate_agentic",
     "generate_geo",
     "generate_multitenant",
+    "is_root",
     "region_name",
+    "task_of",
     "tenant_of",
     "tenant_weights",
     "uniform_control",

@@ -33,8 +33,11 @@ from llm_d_kv_cache_manager_tpu.obs import spans as obs_spans
 #   region  — federation region ids (the FIXED configured region set,
 #             FederationConfig.regions / FEDERATION_REGIONS — deployment
 #             topology, never traffic)
+#   source  — prefetch-queue submitter planes (kv_connectors/prefetch.py
+#             PREFETCH_SOURCES tuple: route/replication/prediction)
 ALLOWED_LABELS = {
     "state", "kind", "backend", "op", "plane", "stage", "phase", "region",
+    "source",
 }
 ALLOWED_PLANES = {"read", "write", "transfer", "cluster", "other"}
 
@@ -91,6 +94,35 @@ def test_collectors_exist():
     assert "federation_warmed_blocks" in collectors
     assert "federation_mispicks" in collectors
     assert "federation_failovers" in collectors
+    # Anticipatory prefetch (prediction/): session-table occupancy, jobs/
+    # blocks pre-landed, the misprediction cost column, and the per-source
+    # prefetch-drop counter (bounded `source` label) — all inside the walk
+    # so their label bounds stay enforced.
+    assert "prediction_sessions" in collectors
+    assert "prediction_jobs" in collectors
+    assert "prediction_blocks" in collectors
+    assert "prediction_mispredicted_blocks" in collectors
+    assert "prefetch_drops" in collectors
+
+
+def test_prefetch_drop_source_values_are_code_defined():
+    """The prefetch-drop `source` label carries only the fixed submitter
+    vocabulary (route-driven prefetch / hot-prefix replication /
+    anticipatory prediction) — plane identity, never traffic."""
+    from llm_d_kv_cache_manager_tpu.kv_connectors.prefetch import (
+        PREFETCH_SOURCES,
+    )
+
+    metrics.register_metrics()
+    for metric in REGISTRY.collect():
+        if metric.name != "kvcache_prefetch_drops":
+            continue
+        for sample in metric.samples:
+            source = sample.labels.get("source")
+            if source is not None:
+                assert source in PREFETCH_SOURCES, (
+                    f"unexpected prefetch source {source!r}"
+                )
 
 
 def test_membership_phase_label_values_are_code_defined():

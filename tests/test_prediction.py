@@ -1,0 +1,717 @@
+"""Anticipatory-prefetch tests (prediction/ subsystem).
+
+Policy/table/scheduler tests run unmarked (tier-1). The end-to-end sim
+tests that move real KV payloads through the transfer plane are marked
+`prediction` and auto-skip (with a visible reason) when libkvtransfer.so
+isn't built — same contract as the `placement` marker.
+"""
+
+import asyncio
+
+import pytest
+
+from tests.conftest import TEST_MODEL_NAME, TEST_TOKENIZER_JSON
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import Indexer, IndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.prediction import (
+    PredictionConfig,
+    PrefetchScheduler,
+    SchedulerConfig,
+    SessionTable,
+    best_score_select,
+    fleet_prior_from_tables,
+)
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPool,
+    TokenizersPoolConfig,
+)
+
+BLOCK_SIZE = 4
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _table(**kw) -> SessionTable:
+    clock = kw.pop("clock", FakeClock())
+    defaults = dict(tail_trim_blocks=0, default_eta_s=5.0)
+    defaults.update(kw)
+    return SessionTable(PredictionConfig(**defaults), clock=clock)
+
+
+def _chain(start, n):
+    return list(range(start, start + n))
+
+
+# ---------------------------------------------------------------------------
+# Session table: identity, ETA model, bounds, misprediction accounting
+# ---------------------------------------------------------------------------
+
+class TestSessionTable:
+    def test_continuation_by_chain_containment(self):
+        t = _table()
+        t.observe_route(_chain(100, 3), model_name="m", now=0.0)
+        # Next turn: previous chain is a leading prefix of the new one.
+        t.observe_route(_chain(100, 6), model_name="m", now=7.0)
+        s = t.stats()
+        assert s["new_sessions"] == 1
+        assert s["continuations"] == 1
+        rec = t.record_by_tail(105)
+        assert rec is not None
+        assert rec.turns_observed == 2
+        assert rec.gap_ewma_s == 7.0
+        # Re-keyed: the old tail no longer resolves.
+        assert t.record_by_tail(102) is None
+
+    def test_disjoint_chains_are_distinct_sessions(self):
+        t = _table()
+        t.observe_route(_chain(100, 4), model_name="m", now=0.0)
+        t.observe_route(_chain(500, 4), model_name="m", now=1.0)
+        assert t.stats()["new_sessions"] == 2
+        assert t.sessions() == 2
+
+    def test_eta_estimates_are_deterministic(self):
+        """Scripted observations produce exact EWMA/blend arithmetic."""
+        t = _table(eta_alpha=0.5, prior_weight=2.0, fleet_quantile=0.5)
+        t.observe_route(_chain(0, 2), model_name="m", now=0.0)
+        t.observe_route(_chain(0, 4), model_name="m", now=4.0)   # gap 4
+        t.observe_route(_chain(0, 6), model_name="m", now=12.0)  # gap 8
+        rec = t.record_by_tail(5)
+        # EWMA: 4, then 4 + 0.5*(8-4) = 6.
+        assert rec.gap_ewma_s == 6.0
+        # Fleet reservoir [4, 8] -> median picks index 1 -> 8.
+        assert t.fleet_eta_s() == 8.0
+        # Blend: (n*ewma + w*prior)/(n+w) = (2*6 + 2*8)/4 = 7.
+        assert t.eta_s(rec) == 7.0
+        # Rebuilding from the same script yields the same estimates.
+        t2 = _table(eta_alpha=0.5, prior_weight=2.0, fleet_quantile=0.5)
+        t2.observe_route(_chain(0, 2), model_name="m", now=0.0)
+        t2.observe_route(_chain(0, 4), model_name="m", now=4.0)
+        t2.observe_route(_chain(0, 6), model_name="m", now=12.0)
+        assert t2.eta_s(t2.record_by_tail(5)) == t.eta_s(rec)
+
+    def test_cold_session_uses_fleet_prior(self):
+        t = _table(default_eta_s=9.0)
+        t.observe_route(_chain(0, 2), model_name="m", now=0.0)
+        rec = t.record_by_tail(1)
+        assert rec.gap_ewma_s is None
+        assert t.eta_s(rec) == 9.0  # no fleet gaps yet -> default
+        # Another session's continuation seeds the fleet reservoir.
+        t.observe_route(_chain(50, 2), model_name="m", now=0.0)
+        t.observe_route(_chain(50, 4), model_name="m", now=3.0)
+        assert t.eta_s(rec) == 3.0
+
+    def test_fleet_prior_from_tables_shape(self):
+        lo = fleet_prior_from_tables(4.0, 0.01, quantile=0.1)
+        mid = fleet_prior_from_tables(4.0, 0.01, quantile=0.5)
+        hi = fleet_prior_from_tables(4.0, 0.01, quantile=0.9)
+        assert 4.0 < lo <= mid <= hi
+
+    def test_gap_clamps_reject_outliers(self):
+        t = _table(min_gap_s=0.1, max_gap_s=100.0)
+        t.observe_route(_chain(0, 2), model_name="m", now=0.0)
+        t.observe_route(_chain(0, 4), model_name="m", now=0.01)   # fan-out
+        t.observe_route(_chain(0, 6), model_name="m", now=500.0)  # comeback
+        rec = t.record_by_tail(5)
+        assert rec.gap_ewma_s is None
+        assert t.stats()["clamped_gaps"] == 2
+        assert rec.turns_observed == 3  # still tracked as the same session
+
+    def test_bounded_lru_eviction_counts_pending_as_mispredicted(self):
+        clock = FakeClock()
+        t = _table(max_sessions=2, block_bytes=10, clock=clock)
+        t.observe_route(_chain(0, 3), model_name="m", now=0.0)
+        rec = t.record_by_tail(2)
+        t.note_prefetch(rec, "pod-1", now=0.5)
+        t.note_landed(2, 3)  # 3 blocks actually moved
+        # Two newer sessions evict the oldest (with its pending prefetch).
+        t.observe_route(_chain(100, 3), model_name="m", now=1.0)
+        t.observe_route(_chain(200, 3), model_name="m", now=2.0)
+        s = t.stats()
+        assert s["tracked_sessions"] == 2
+        assert s["evictions"] == 1
+        assert s["mispredicted_blocks"] == 3
+        assert s["mispredicted_bytes"] == 30
+        assert t.record_by_tail(2) is None
+
+    def test_tail_trim_drops_unstable_blocks(self):
+        t = _table(tail_trim_blocks=2)
+        tokens = list(range(6 * BLOCK_SIZE))
+        t.observe_route(
+            _chain(0, 6), tokens=tokens, model_name="m",
+            block_size=BLOCK_SIZE, now=0.0,
+        )
+        rec = t.record_by_tail(3)  # trimmed tail: block 3, not 5
+        assert rec is not None
+        assert rec.chain_hashes == _chain(0, 4)
+        # Tokens cover exactly the retained chain.
+        assert rec.tokens == tokens[: 4 * BLOCK_SIZE]
+
+    def test_expiry_counts_landed_blocks_only(self):
+        clock = FakeClock()
+        t = _table(expiry_factor=1.0, block_bytes=5, clock=clock)
+        t.observe_route(_chain(0, 2), model_name="m", now=0.0)
+        t.observe_route(_chain(0, 4), model_name="m", now=5.0)  # eta -> 5
+        rec = t.record_by_tail(3)
+        t.note_prefetch(rec, "pod-0", now=6.0)
+        # Nothing landed yet: expiring now costs nothing.
+        assert t.expire_pending(now=100.0) == 1
+        assert t.stats()["mispredicted_blocks"] == 0
+        # With landed feedback, expiry charges exactly the moved blocks.
+        t.note_prefetch(rec, "pod-0", now=101.0)
+        t.note_landed(rec.tail, 7)
+        assert t.expire_pending(now=500.0) == 1
+        s = t.stats()
+        assert s["mispredicted_blocks"] == 7
+        assert s["mispredicted_bytes"] == 35
+
+    def test_continuation_resolves_pending_into_consumed(self):
+        t = _table()
+        t.observe_route(_chain(0, 3), model_name="m", now=0.0)
+        rec = t.record_by_tail(2)
+        t.note_prefetch(rec, "pod-4", now=2.0)
+        t.note_landed(2, 9)
+        t.observe_route(_chain(0, 6), model_name="m", now=5.0)
+        rec = t.record_by_tail(5)
+        assert rec.pending is None
+        assert rec.consumed is not None
+        assert rec.consumed.pod == "pod-4"
+        assert rec.consumed.blocks == 9
+        assert t.stats()["prefetches_resolved"] == 1
+
+    def test_due_sessions_window_and_cooldown(self):
+        t = _table(default_eta_s=10.0, expiry_factor=2.0)
+        t.observe_route(_chain(0, 3), model_name="m", now=0.0)
+        # Window opens at start_frac * eta = 4, closes at 10 + 2*10 = 30.
+        assert t.due_sessions(now=2.0, start_frac=0.4) == []
+        due = t.due_sessions(now=5.0, start_frac=0.4)
+        assert len(due) == 1
+        rec, expected = due[0]
+        assert expected == 10.0
+        assert t.due_sessions(now=31.0, start_frac=0.4) == []
+        # A noted prefetch removes the session until resolved/expired...
+        t.note_prefetch(rec, "pod-0", now=5.0)
+        assert t.due_sessions(now=6.0, start_frac=0.4) == []
+        rec.pending = None
+        # ...and the cooldown gates re-attempts after that.
+        assert t.due_sessions(now=6.0, start_frac=0.4, cooldown_s=5.0) == []
+        assert len(t.due_sessions(now=11.0, start_frac=0.4, cooldown_s=5.0)) == 1
+
+
+# ---------------------------------------------------------------------------
+# Scheduler: budget, cooldown, routing-decision fidelity, drops
+# ---------------------------------------------------------------------------
+
+class _Scores:
+    def __init__(self, scores, match_blocks=None):
+        self.scores = scores
+        self.match_blocks = match_blocks or {}
+
+
+class TestScheduler:
+    def _setup(self, scores, submit_ok=True, **sched_kw):
+        clock = FakeClock()
+        table = _table(default_eta_s=5.0, clock=clock)
+        jobs = []
+
+        def submit(pod, hashes):
+            if submit_ok:
+                jobs.append((pod, list(hashes)))
+            return submit_ok
+
+        sched = PrefetchScheduler(
+            table,
+            score_fn=lambda model, hashes: _Scores(dict(scores)),
+            submit_fn=submit,
+            config=SchedulerConfig(**sched_kw),
+            clock=clock,
+        )
+        return table, sched, jobs, clock
+
+    def test_submits_whole_chain_to_best_pod(self):
+        table, sched, jobs, clock = self._setup({"pod-2": 3.0, "pod-1": 1.0})
+        table.observe_route(_chain(0, 4), model_name="m", now=0.0)
+        clock.t = 3.0  # inside [0.25*5, ...]
+        assert sched.tick() == 1
+        assert jobs == [("pod-2", _chain(0, 4))]
+        assert sched.stats["blocks_submitted"] == 4
+        assert table.stats()["prefetches_noted"] == 1
+
+    def test_budget_bounds_jobs_per_tick(self):
+        table, sched, jobs, clock = self._setup(
+            {"pod-0": 1.0}, max_jobs_per_tick=2
+        )
+        for s in range(5):
+            table.observe_route(_chain(1000 * (s + 1), 3),
+                                model_name="m", now=0.0)
+        clock.t = 3.0
+        assert sched.tick() == 2
+        assert len(jobs) == 2
+        # The remaining sessions trickle out over later ticks.
+        clock.t = 3.5
+        assert sched.tick() == 2
+        clock.t = 4.0
+        assert sched.tick() == 1
+
+    def test_session_cooldown_prevents_hot_loop(self):
+        table, sched, jobs, clock = self._setup(
+            {"pod-0": 1.0}, session_cooldown_s=4.0
+        )
+        table.observe_route(_chain(0, 3), model_name="m", now=0.0)
+        clock.t = 3.0
+        assert sched.tick() == 1
+        table.record_by_tail(2).pending = None  # simulate executor no-op
+        clock.t = 4.0
+        assert sched.tick() == 0  # inside cooldown
+        clock.t = 7.5
+        assert sched.tick() == 1
+
+    def test_no_target_is_counted_not_submitted(self):
+        table, sched, jobs, clock = self._setup({})
+        table.observe_route(_chain(0, 3), model_name="m", now=0.0)
+        clock.t = 3.0
+        assert sched.tick() == 0
+        assert sched.stats["skipped_no_target"] == 1
+        assert jobs == []
+
+    def test_queue_drops_are_counted(self):
+        table, sched, jobs, clock = self._setup(
+            {"pod-0": 1.0}, submit_ok=False
+        )
+        table.observe_route(_chain(0, 3), model_name="m", now=0.0)
+        clock.t = 3.0
+        assert sched.tick() == 0
+        assert sched.stats["drops"] == 1
+        assert table.stats()["prefetches_noted"] == 0
+
+    def test_default_select_is_deterministic(self):
+        assert best_score_select({}) is None
+        assert best_score_select({"pod-b": 2.0, "pod-a": 2.0}) == "pod-a"
+        assert best_score_select({"pod-b": 3.0, "pod-a": 2.0}) == "pod-b"
+
+
+# ---------------------------------------------------------------------------
+# Read path: observation only — scores bit-identical, score_hashes fidelity
+# ---------------------------------------------------------------------------
+
+def _make_indexer(prediction=None):
+    indexer = Indexer(
+        config=IndexerConfig(
+            token_processor_config=TokenProcessorConfig(
+                block_size=BLOCK_SIZE
+            ),
+        ),
+        tokenization_pool=TokenizationPool(
+            TokenizersPoolConfig(
+                workers=1,
+                local_tokenizer_files={TEST_MODEL_NAME: TEST_TOKENIZER_JSON},
+            ),
+        ),
+        prediction=prediction,
+    )
+    indexer.run()
+    return indexer
+
+
+PROMPT = "the quick brown fox jumps over the lazy dog " * 8
+
+
+def _seed(indexer, pod="pod-a", n=None):
+    enc = indexer.tokenizers_pool.tokenizer.encode(PROMPT, TEST_MODEL_NAME)
+    keys = indexer.token_processor.tokens_to_kv_block_keys(
+        None, enc.tokens, TEST_MODEL_NAME
+    )
+    if n is not None:
+        keys = keys[:n]
+    engine_keys = [Key(TEST_MODEL_NAME, 77_000 + i) for i in range(len(keys))]
+    indexer.kv_block_index.add(engine_keys, keys, [PodEntry(pod, "hbm")])
+    return keys
+
+
+class TestReadPathIdentity:
+    def test_scores_bit_identical_with_table_attached(self):
+        plain = _make_indexer(None)
+        table = _table(clock=FakeClock())
+        tracked = _make_indexer(table)
+        try:
+            _seed(plain)
+            _seed(tracked)
+            s1 = plain.get_pod_scores(PROMPT, TEST_MODEL_NAME, [])
+            s2 = tracked.get_pod_scores(PROMPT, TEST_MODEL_NAME, [])
+            assert s1 == s2
+            # The table observed the session (pure side effect).
+            assert table.stats()["observations"] == 1
+            assert table.sessions() == 1
+        finally:
+            plain.shutdown()
+            tracked.shutdown()
+
+    def test_score_many_observes_like_single_calls(self):
+        from llm_d_kv_cache_manager_tpu.kvcache.indexer import ScoreRequest
+
+        table = _table(clock=FakeClock())
+        ix = _make_indexer(table)
+        try:
+            _seed(ix)
+            results = ix.score_many([
+                ScoreRequest(prompt=PROMPT, model_name=TEST_MODEL_NAME),
+                ScoreRequest(prompt=PROMPT + " more words here",
+                             model_name=TEST_MODEL_NAME),
+            ])
+            assert len(results) == 2
+            assert table.stats()["observations"] == 2
+        finally:
+            ix.shutdown()
+
+    def test_score_hashes_matches_prompt_scoring(self):
+        """The scheduler's routing decision runs the same lookup/score
+        stages as the prompt path: over the same chain, same answer."""
+        ix = _make_indexer(None)
+        try:
+            keys = _seed(ix, pod="pod-a")
+            _seed(ix, pod="pod-b", n=3)  # partial holder
+            via_prompt = ix.get_pod_scores_ex(PROMPT, TEST_MODEL_NAME, [])
+            via_hashes = ix.score_hashes(
+                TEST_MODEL_NAME, [k.chunk_hash for k in keys]
+            )
+            assert via_hashes.scores == via_prompt.scores
+            assert via_hashes.match_blocks == via_prompt.match_blocks
+            assert via_hashes.block_hashes == via_prompt.block_hashes
+            assert ix.score_hashes(TEST_MODEL_NAME, []).scores == {}
+        finally:
+            ix.shutdown()
+
+    def test_tenant_isolation_rides_the_hash_chain(self):
+        """Identical token streams under different LoRA extras derive
+        disjoint chains, so their sessions never merge — the same
+        mechanism that isolates their index entries."""
+        table = _table(clock=FakeClock())
+        ix = _make_indexer(table)
+        try:
+            ix.get_pod_scores(PROMPT, TEST_MODEL_NAME, [], lora_id=1)
+            ix.get_pod_scores(PROMPT, TEST_MODEL_NAME, [], lora_id=2)
+            assert table.stats()["new_sessions"] == 2
+        finally:
+            ix.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Serving wins: page pressure aborts a warm admission, never serving
+# ---------------------------------------------------------------------------
+
+class TestServingWins:
+    def test_warm_chain_aborts_on_page_pressure(self):
+        from llm_d_kv_cache_manager_tpu.engine.engine import (
+            EnginePod,
+            EnginePodConfig,
+        )
+
+        pod = EnginePod(EnginePodConfig(
+            pod_id="tiny", n_pages=8, page_size=4, max_pages_per_seq=64,
+        ))
+
+        class StubTier:
+            """Everything is 'restorable' — the allocate must still lose
+            to page pressure and abort cleanly."""
+
+            def plan_restore(self, hashes):
+                return len(hashes)
+
+            def close(self):
+                pass
+
+        pod.tier_store = StubTier()
+        # A 20-block chain against an 8-page pool: OutOfPagesError inside
+        # warm_chain -> 0 landed, no exception.
+        assert pod.warm_chain(list(range(1000, 1080))) == 0
+        # Serving is untouched: a small real prefill still succeeds.
+        state, cached = pod.prefill([1, 2, 3, 4, 5, 6, 7, 8])
+        assert len(state.tokens) == 8
+        pod.free(state)
+        pod.close()
+
+    def test_prefetch_worker_survives_out_of_pages(self):
+        from llm_d_kv_cache_manager_tpu.engine.block_manager import (
+            OutOfPagesError,
+        )
+        from llm_d_kv_cache_manager_tpu.kv_connectors.prefetch import (
+            RoutePrefetcher,
+        )
+
+        calls = []
+
+        def exploding(pod, hashes):
+            calls.append(pod)
+            raise OutOfPagesError("no free pages")
+
+        pf = RoutePrefetcher(exploding, queue_bound=4)
+        try:
+            assert pf.submit("pod-0", [1, 2], source="prediction")
+            pf.drain()
+            assert calls == ["pod-0"]
+            # The worker survived; later jobs still execute.
+            assert pf.submit("pod-1", [3], source="prediction")
+            pf.drain()
+            assert calls == ["pod-0", "pod-1"]
+            assert pf.stats["executed"] == 0  # failures don't count
+        finally:
+            pf.close()
+
+
+# ---------------------------------------------------------------------------
+# RoutePrefetcher: per-source visibility
+# ---------------------------------------------------------------------------
+
+class TestPrefetcherSources:
+    def test_per_source_counters_and_queue_depth(self):
+        import threading
+
+        from llm_d_kv_cache_manager_tpu.kv_connectors.prefetch import (
+            RoutePrefetcher,
+        )
+
+        gate = threading.Event()
+        started = threading.Event()
+
+        def slow(pod, hashes):
+            started.set()
+            gate.wait(5.0)
+            return len(hashes)
+
+        pf = RoutePrefetcher(slow, queue_bound=1)
+        try:
+            assert pf.submit("pod-0", [1, 2])  # default source: route
+            started.wait(5.0)  # worker busy; queue is empty again
+            assert pf.submit("pod-1", [3], source="replication")
+            # Bounded queue full: the prediction job drops, counted under
+            # ITS source — the route/replication counters are untouched.
+            assert not pf.submit("pod-2", [4], source="prediction")
+            assert pf.queue_depth() == 1
+            st = pf.status()
+            assert st["queue_bound"] == 1
+            assert st["by_source"]["route"]["submitted"] == 1
+            assert st["by_source"]["replication"]["submitted"] == 1
+            assert st["by_source"]["prediction"]["dropped"] == 1
+            assert st["by_source"]["replication"]["dropped"] == 0
+            assert st["stats"]["dropped"] == 1
+            gate.set()
+            pf.drain()
+            st = pf.status()
+            assert st["by_source"]["route"]["executed"] == 1
+            assert st["by_source"]["route"]["blocks_queued"] == 2
+            assert st["by_source"]["replication"]["executed"] == 1
+        finally:
+            gate.set()
+            pf.close()
+
+
+# ---------------------------------------------------------------------------
+# API surface
+# ---------------------------------------------------------------------------
+
+class TestApiSurface:
+    def _service(self, prediction: bool):
+        from llm_d_kv_cache_manager_tpu.api.http_service import (
+            ScoringService,
+        )
+
+        env = {
+            "zmq_endpoint": "tcp://*:0",
+            "zmq_topic": "kv@",
+            "pool_concurrency": 1,
+            "hash_seed": "",
+            "block_size": BLOCK_SIZE,
+            "http_port": 0,
+            "enable_metrics": False,
+            "prediction": prediction,
+        }
+        return ScoringService(env, indexer=_make_indexer())
+
+    def test_prediction_status_and_readyz_section(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        service = self._service(prediction=True)
+
+        async def run():
+            async with TestClient(TestServer(service.make_app())) as client:
+                service.start(with_subscriber=False)
+                resp = await client.post(
+                    "/score_completions",
+                    json={"prompt": PROMPT, "model": TEST_MODEL_NAME},
+                )
+                assert resp.status == 200
+
+                resp = await client.get("/prediction/status")
+                assert resp.status == 200
+                data = await resp.json()
+                assert data["table"]["tracked_sessions"] == 1
+                assert data["table"]["observations"] == 1
+                assert len(data["soonest_sessions"]) == 1
+                assert data["soonest_sessions"][0]["turns_observed"] == 1
+
+                resp = await client.get("/readyz")
+                assert resp.status == 200
+                payload = await resp.json()
+                assert payload["prediction"]["table"]["tracked_sessions"] == 1
+
+        try:
+            asyncio.run(run())
+        finally:
+            service.stop()
+
+    def test_prediction_disabled_is_400_and_absent_from_readyz(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        service = self._service(prediction=False)
+
+        async def run():
+            async with TestClient(TestServer(service.make_app())) as client:
+                service.start(with_subscriber=False)
+                resp = await client.get("/prediction/status")
+                assert resp.status == 400
+                resp = await client.get("/readyz")
+                assert (await resp.json())["prediction"] is None
+
+        try:
+            asyncio.run(run())
+        finally:
+            service.stop()
+
+
+# ---------------------------------------------------------------------------
+# End-to-end through the fleet sim (transfer plane; marked `prediction`)
+# ---------------------------------------------------------------------------
+
+def _bench():
+    import importlib.util
+    import pathlib
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod_prediction", repo / "bench.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _mini_trace(bench):
+    """A hand-scripted replay where anticipation provably matters: one
+    multi-turn session whose prefix gets churned out of HBM by junk
+    traffic during its think window, then returns."""
+    import random
+
+    from llm_d_kv_cache_manager_tpu.workloads.spec import MaterializedRequest
+    from llm_d_kv_cache_manager_tpu.workloads.synthetic import text
+
+    rng = random.Random(7)
+    base = "session zero shared prefix " + text(rng, 420)
+    reqs = [MaterializedRequest(
+        arrival_s=0.0, session="s0", turn=0, prompt=base, output_len=20,
+    )]
+    # Junk single-turn sessions churn every pod's LRU during the window.
+    for j in range(24):
+        reqs.append(MaterializedRequest(
+            arrival_s=1.0 + 0.25 * j,
+            session=f"junk-{j}", turn=0,
+            prompt=f"[junk {j}] " + text(rng, 500),
+            output_len=10,
+        ))
+    grown = base + " [user] " + text(rng, 60)
+    reqs.append(MaterializedRequest(
+        arrival_s=12.0, session="s0", turn=1, prompt=grown, output_len=20,
+    ))
+    return reqs
+
+
+@pytest.mark.prediction
+class TestPredictionEndToEnd:
+    def test_disabled_and_observe_only_are_bit_identical(self):
+        """The PREDICTION=0 contract through the whole sim: attaching the
+        table (and a scheduler whose budget is zero — pure observation)
+        leaves the served TTFT stream byte-for-byte."""
+        bench = _bench()
+        reqs = _mini_trace(bench)
+
+        def run(prediction):
+            sim = bench.FleetSim(
+                "precise", pages_per_pod=192, host_tier=True,
+                host_capacity=2048, gated=False, prediction=prediction,
+            )
+            try:
+                return [
+                    sim.serve(r.arrival_s, r.prompt,
+                              response_words=r.output_len)
+                    for r in reqs
+                ]
+            finally:
+                sim.shutdown()
+
+        off = run(None)
+        observe_only = run(dict(max_jobs_per_tick=0, tail_trim_blocks=0))
+        assert observe_only == off
+
+    def test_anticipation_prelands_the_next_turn(self):
+        """With the predictor on, the returning session's prefix is
+        device-resident before its turn-2 arrival; reactive serving finds
+        it evicted."""
+        bench = _bench()
+        reqs = _mini_trace(bench)
+
+        def run(prediction):
+            sim = bench.FleetSim(
+                "precise", pages_per_pod=192, host_tier=True,
+                host_capacity=2048, gated=False, prediction=prediction,
+            )
+            audit = {}
+
+            def hook(sim, pod_idx, pod, tokens, arrival):
+                if audit.get("session") != "s0-t1":
+                    return
+                prev = audit["prev_chain"]
+                audit["resident"] = pod.resident_prefix_blocks(prev)
+                audit["prefix_blocks"] = len(prev)
+
+            sim.pre_admit_hook = hook
+            try:
+                for r in reqs:
+                    if r.session == "s0" and r.turn == 0:
+                        toks = sim.indexer.tokenizers_pool.tokenize(
+                            None, r.prompt, bench.MODEL
+                        )
+                        keys = (
+                            sim.indexer.token_processor
+                            .tokens_to_kv_block_keys(None, toks, bench.MODEL)
+                        )
+                        audit["prev_chain"] = [k.chunk_hash for k in keys]
+                    audit["session"] = (
+                        "s0-t1" if (r.session, r.turn) == ("s0", 1) else ""
+                    )
+                    sim.serve(r.arrival_s, r.prompt,
+                              response_words=r.output_len)
+                stats = sim.prediction_stats()
+                return audit, stats
+            finally:
+                sim.shutdown()
+
+        reactive, _ = run(None)
+        assert reactive["resident"] < reactive["prefix_blocks"], (
+            "scenario must actually evict the idle prefix"
+        )
+        # start_frac=0.8 opens the prefetch window late in the think gap
+        # (after the junk churn has finished evicting), the regime the
+        # scheduler is built for.
+        anticipated, stats = run(dict(
+            max_jobs_per_tick=4, session_cooldown_s=1.0, start_frac=0.8,
+            tail_trim_blocks=8, default_eta_s=10.0,
+        ))
+        assert stats["predicted_landed_blocks"] > 0
+        assert anticipated["resident"] > reactive["resident"]

@@ -338,3 +338,110 @@ class TestGeoWorkload:
         write_trace(trace, str(path))
         for req in read_trace(str(path)).materialize():
             assert req.region == trace.session_regions[req.session]
+
+
+class TestAgenticWorkload:
+    """workloads/agentic.py: branching fan-out/fan-in trace generator."""
+
+    def _trace(self, **kw):
+        from llm_d_kv_cache_manager_tpu.workloads import (
+            AgenticConfig,
+            generate_agentic,
+        )
+
+        defaults = dict(n_tasks=4, seed=11)
+        defaults.update(kw)
+        return generate_agentic(AgenticConfig(**defaults))
+
+    def test_deterministic_in_config_and_seed(self):
+        assert self._trace() == self._trace()
+        assert self._trace(seed=12) != self._trace(seed=11)
+
+    def test_record_replay_round_trip(self):
+        import io
+
+        from llm_d_kv_cache_manager_tpu.workloads import (
+            read_trace,
+            write_trace,
+        )
+
+        trace = self._trace()
+        buf = io.StringIO()
+        write_trace(trace, buf)
+        buf.seek(0)
+        replayed = read_trace(buf)
+        assert replayed == trace
+        # The materialized prompt streams are identical too.
+        assert [r.prompt for r in replayed.materialize()] == [
+            r.prompt for r in trace.materialize()
+        ]
+
+    def test_structure_fan_out_fan_in(self):
+        from llm_d_kv_cache_manager_tpu.workloads import is_root, task_of
+
+        cfg = dict(n_tasks=3, n_phases=2, fan_out=3, subagent_turns=2)
+        trace = self._trace(**cfg)
+        roots = [s for s in trace.sessions if is_root(s)]
+        workers = [s for s in trace.sessions if not is_root(s)]
+        assert len(roots) == 3
+        assert len(workers) == 3 * 2 * 3  # tasks x phases x fan_out
+        assert {task_of(s) for s in trace.sessions} == {0, 1, 2}
+        counts = trace.turn_counts()
+        for r in roots:
+            assert counts[r] == 1 + 2  # planning + one synthesis per phase
+        for w in workers:
+            assert counts[w] == 2
+
+    def test_workers_branch_off_the_root_grown_prompt(self):
+        """A sub-agent's system prefix IS the root conversation at its
+        branch point — the shared-prefix containment every prefix plane
+        (and the session predictor's continuation detection) keys on."""
+        trace = self._trace(n_tasks=2, n_phases=2)
+        reqs = {(r.session, r.turn): r for r in trace.materialize()}
+        for k in range(2):
+            root_prefix = trace.sessions[f"a{k}-root"]
+            # Phase-0 workers extend the root's turn-0 grown prompt...
+            p0 = trace.sessions[f"a{k}-p0-w0"]
+            assert p0.startswith(root_prefix)
+            assert reqs[(f"a{k}-root", 0)].prompt == p0[: len(
+                reqs[(f"a{k}-root", 0)].prompt
+            )]
+            # ...and phase-1 workers extend the longer post-synthesis one.
+            p1 = trace.sessions[f"a{k}-p1-w0"]
+            assert p1.startswith(p0)
+            assert len(p1) > len(p0)
+            # All same-phase siblings share the exact branch prefix.
+            assert trace.sessions[f"a{k}-p0-w1"] == p0
+            assert trace.sessions[f"a{k}-p0-w2"] == p0
+
+    def test_tool_loop_gaps_are_short_and_ordered(self):
+        trace = self._trace(n_tasks=2, tool_latency_mean_s=1.0)
+        arrivals = {}
+        for t in trace.turns:
+            arrivals.setdefault(t.session, []).append(t.arrival_s)
+        for session, times in arrivals.items():
+            assert times == sorted(times)
+            if "-w" in session:
+                gaps = [b - a for a, b in zip(times, times[1:])]
+                # Exponential around the 1s tool latency: well under the
+                # multi-second human think times of the chat workloads.
+                assert all(g < 15.0 for g in gaps)
+
+    def test_header_carries_config_provenance(self):
+        trace = self._trace()
+        assert trace.workload == "agentic"
+        assert trace.config["n_tasks"] == 4
+        assert trace.config["fan_out"] == 3
+        # Arrival order is globally sorted with a total tie-break.
+        key = trace.sorted_key()
+        assert key == sorted(key)
+
+    def test_invalid_shapes_rejected(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            self._trace(n_tasks=0)
+        with pytest.raises(ValueError):
+            self._trace(fan_out=0)
+        with pytest.raises(ValueError):
+            self._trace(subagent_turns=0)
