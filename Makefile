@@ -68,12 +68,15 @@ bench-micro:
 bench-read:
 	JAX_PLATFORMS=cpu python benchmarking/micro_bench.py --quick --legs read
 
-# Tracing-spine legs only (obs/): enabled-tracing overhead on the warm
-# read path (A/B/A trials) + per-stage attribution of the read/write/
-# transfer planes from flight-recorder traces. Full mode (rewrites
-# MICRO_BENCH.json): python benchmarking/micro_bench.py
+# Tracing-spine legs (obs/): enabled-tracing overhead on the warm read
+# path (paired alternating trials, carrier propagation ON) + per-stage
+# attribution of the read/write/transfer planes + the DISTRIBUTED
+# critical-path leg (2-replica scatter-gather over gRPC, assembled
+# cross-process traces). Full mode: refreshes the obs legs IN PLACE in
+# the committed MICRO_BENCH.json (classic legs keep their numbers).
+# Smoke: add --quick (prints only, writes nothing).
 bench-obs:
-	JAX_PLATFORMS=cpu python benchmarking/micro_bench.py --quick --legs obs
+	JAX_PLATFORMS=cpu python benchmarking/micro_bench.py --legs obs
 
 # Batched read-path legs only (Indexer.score_many at router batch sizes
 # 1/8/32/128, shared-prefix vs disjoint mixes, warm vs cold, plus the

@@ -1231,6 +1231,40 @@ def obs_section() -> str:
         "overlap their parents, so shares need not sum to 100. Source: "
         "`MICRO_BENCH.json` (`stage_attribution`, `obs_overhead`)._",
     ]
+
+    dist = d.get("stage_attribution_distributed")
+    if dist:
+        cp = dist.get("critical_path") or {}
+        out += [
+            "",
+            f"Distributed critical path (cluster scatter-gather, "
+            f"{dist['replicas']} replicas over "
+            f"{'real gRPC' if dist['transport'] == 'grpc' else 'in-process transports'}, "
+            f"{dist['requests']} assembled traces, "
+            f"{dist['remote_spans_assembled']} replica-side spans grafted "
+            "back through TraceCarrier propagation):",
+            "",
+            "| Span | Hop | self p.r. (µs) | critical-path share |",
+            "|---|---|---:|---:|",
+        ]
+        n_traces = max(1, dist.get("requests", 1))
+        for e in (cp.get("entries") or [])[:10]:
+            out.append(
+                f"| `{e['span']}` | {e['hop']} "
+                f"| {round(e['self_us'] / n_traces, 1)} "
+                f"| {e['share_pct']}% |"
+            )
+        out += [
+            "",
+            "_Critical-path self-time along the longest dependency chain "
+            "of the ASSEMBLED cross-process trace (per-request µs = "
+            "total/traces); `hop=cluster.rpc` rows ran on a replica, the "
+            "`cluster.rpc`@local row is wire+serialization+scheduling "
+            "slack, and shares sum to ~100% of root wall time per trace "
+            f"(p50 {dist.get('share_sum_pct_p50', 0)}%). Source: "
+            "`MICRO_BENCH.json` (`stage_attribution_distributed`); live "
+            "form: `GET /debug/critical_path`._",
+        ]
     return "\n".join(out)
 
 

@@ -27,6 +27,7 @@ from typing import Dict, Iterator, List
 
 import grpc
 
+from llm_d_kv_cache_manager_tpu import obs
 from llm_d_kv_cache_manager_tpu.api import indexer_pb2 as pb
 from llm_d_kv_cache_manager_tpu.api.admission import (
     SHED_DEADLINE,
@@ -78,6 +79,19 @@ def _shed_abort(context: grpc.ServicerContext, e: AdmissionRejected) -> None:
     context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
 
 
+def _carrier_from_context(context: grpc.ServicerContext):
+    """Raw trace carrier from the request metadata (obs.GRPC_CARRIER_KEY),
+    or None. Never raises: a carrier problem must never fail scoring —
+    malformed values are counted downstream by `obs.adopt`."""
+    try:
+        for key, value in context.invocation_metadata() or ():
+            if key == obs.GRPC_CARRIER_KEY:
+                return value
+    except Exception:  # noqa: BLE001 - metadata access is best-effort
+        return None
+    return None
+
+
 def _deadline_expired(context: grpc.ServicerContext) -> bool:
     """True when the CLIENT's propagated deadline has already passed —
     any score computed now is work nobody is waiting for. Counted as a
@@ -104,14 +118,16 @@ def _make_handler(
     ) -> pb.GetPodScoresResponse:
         try:
             with admit(context.time_remaining()):
-                scores: Dict[str, float] = indexer.get_pod_scores(
-                    request.prompt,
-                    request.model_name,
-                    list(request.pod_identifiers),
-                    lora_id=(
-                        request.lora_id if request.HasField("lora_id") else None
-                    ),
-                )
+                with obs.adopt(_carrier_from_context(context)):
+                    scores: Dict[str, float] = indexer.get_pod_scores(
+                        request.prompt,
+                        request.model_name,
+                        list(request.pod_identifiers),
+                        lora_id=(
+                            request.lora_id if request.HasField("lora_id")
+                            else None
+                        ),
+                    )
         except AdmissionRejected as e:
             _shed_abort(context, e)
             return pb.GetPodScoresResponse()
@@ -141,14 +157,21 @@ def _make_handler(
             }
         try:
             with admit(context.time_remaining()):
-                result = indexer.get_pod_scores_ex(
-                    request.prompt,
-                    request.model_name,
-                    list(request.pod_identifiers),
-                    lora_id=(
-                        request.lora_id if request.HasField("lora_id") else None
-                    ),
-                )
+                # Cross-process tracing seam: a carrier in the metadata
+                # makes the read path's root trace adopt the CALLER's
+                # trace id, and the completed trace's span tuples ride
+                # back in the reply so the caller's recorder can
+                # assemble one distributed tree (obs/carrier.py).
+                with obs.adopt(_carrier_from_context(context)) as adoption:
+                    result = indexer.get_pod_scores_ex(
+                        request.prompt,
+                        request.model_name,
+                        list(request.pod_identifiers),
+                        lora_id=(
+                            request.lora_id if request.HasField("lora_id")
+                            else None
+                        ),
+                    )
         except AdmissionRejected as e:
             _shed_abort(context, e)
             return {}
@@ -156,11 +179,15 @@ def _make_handler(
             logger.warning("GetPodScoresEx failed: %s", e)
             context.abort(grpc.StatusCode.INTERNAL, str(e))
             return {}
-        return {
+        payload = {
             "scores": result.scores,
             "match_blocks": result.match_blocks,
             "block_hashes": result.block_hashes,
         }
+        shipped = obs.export_trace(adoption.trace)
+        if shipped is not None:
+            payload["trace"] = shipped
+        return payload
 
     def cluster_status(
         request: pb.GetPodScoresRequest, context: grpc.ServicerContext
@@ -205,7 +232,12 @@ def _make_handler(
         `Indexer.score_many` — so a router pushing 32 concurrent requests
         pays ONE amortized read-path pass, while a trickle of singles
         still gets per-request latency. Responses carry `index` (the
-        request's position in the stream) and stream back in order."""
+        request's position in the stream) and stream back in order; when
+        the stream metadata carried a trace carrier, each scored window's
+        span payload additionally streams back as an index-less
+        `{"trace": ...}` message (the client filters them out of the
+        result list)."""
+        carrier = _carrier_from_context(context)
         feed: "queue.Queue" = queue.Queue()
         _done = object()
 
@@ -258,9 +290,10 @@ def _make_handler(
                 return
             try:
                 with admit(context.time_remaining()):
-                    scored = indexer.score_many(
-                        [_request_to_score_request(r) for r in window]
-                    )
+                    with obs.adopt(carrier) as adoption:
+                        scored = indexer.score_many(
+                            [_request_to_score_request(r) for r in window]
+                        )
             except AdmissionRejected as e:
                 # Count the whole window (one stream-level shed would hide
                 # the per-item volume) and surface the explicit status.
@@ -280,6 +313,9 @@ def _make_handler(
                     "block_hashes": result.block_hashes,
                 }
                 index += 1
+            shipped = obs.export_trace(adoption.trace)
+            if shipped is not None:
+                yield {"trace": shipped}
 
     rpc_handlers = {
         METHOD_SCORE_PODS_BULK: grpc.stream_stream_rpc_method_handler(
@@ -424,12 +460,19 @@ class IndexerGrpcClient:
             request.lora_id = lora_id
         return self._explain_call(request, timeout=self._timeout)
 
+    @staticmethod
+    def _carrier_metadata(carrier):
+        return ((obs.GRPC_CARRIER_KEY, carrier),) if carrier else None
+
     def get_pod_scores_ex(
-        self, prompt: str, model_name: str, pod_identifiers=(), lora_id=None
+        self, prompt: str, model_name: str, pod_identifiers=(), lora_id=None,
+        carrier=None,
     ) -> dict:
         """Scatter-gather transport call: {"scores", "match_blocks",
         "block_hashes"} as plain JSON types (cluster/scorer.py rebuilds a
-        PodScores from it)."""
+        PodScores from it). `carrier` (an obs/carrier.py string) rides the
+        request metadata; the reply then carries the server-side span
+        payload under "trace"."""
         request = pb.GetPodScoresRequest(
             prompt=prompt,
             model_name=model_name,
@@ -437,16 +480,21 @@ class IndexerGrpcClient:
         )
         if lora_id is not None:
             request.lora_id = lora_id
-        return self._ex_call(request, timeout=self._timeout)
+        return self._ex_call(
+            request, timeout=self._timeout,
+            metadata=self._carrier_metadata(carrier),
+        )
 
-    def score_pods_bulk(self, requests) -> List[dict]:
+    def score_pods_bulk(self, requests, carrier=None, trace_sink=None) -> List[dict]:
         """Streaming bulk scoring: `requests` is a sequence of dicts with
         `prompt`, `model_name` and optional `pod_identifiers` / `lora_id`.
         Streams every request up, collects the per-item JSON results
         (emitted by the server as its micro-batches complete) and returns
         them ordered by stream position — one
         `{"index", "scores", "match_blocks", "block_hashes"}` payload per
-        request."""
+        request. With a `carrier`, the server's per-window span payloads
+        are appended to `trace_sink` (when given) instead of the result
+        list."""
 
         def gen():
             for r in requests:
@@ -459,7 +507,15 @@ class IndexerGrpcClient:
                     request.lora_id = r["lora_id"]
                 yield request
 
-        results = list(self._bulk_call(gen(), timeout=self._timeout))
+        results = []
+        for payload in self._bulk_call(
+            gen(), timeout=self._timeout,
+            metadata=self._carrier_metadata(carrier),
+        ):
+            if "index" in payload:
+                results.append(payload)
+            elif trace_sink is not None and payload.get("trace") is not None:
+                trace_sink.append(payload["trace"])
         results.sort(key=lambda d: d["index"])
         return results
 

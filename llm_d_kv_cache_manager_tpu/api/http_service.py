@@ -57,9 +57,19 @@ the ZMQ KVEvents write plane, and Prometheus metrics behind HTTP:
                                 counters, and — when an embedder wires
                                 them — the scheduler's policy stats and
                                 the prefetch queue's per-source drops
+  GET  /slo/status              SLO burn-rate evaluation (obs/slo.py):
+                                per-objective fast/slow-window burn
+                                rates off the live registry, breach
+                                status (also a /readyz `slo` section)
   GET  /debug/traces            flight recorder dump: recent complete
-                                traces + the slow-outlier reservoir
-                                (?n=<count> caps the recent list)
+                                traces + the slow-outlier reservoir.
+                                Filters: ?limit= (alias n=), ?plane=,
+                                ?min_ms=, ?trace_id= (exact 16-hex
+                                distributed id), ?crit=1 attaches each
+                                trace's critical-path breakdown
+  GET  /debug/critical_path     window summary: per-(span, hop)
+                                critical-path self-time over the recent
+                                ring, grouped by root (?root= filters)
   GET  /debug/score_explain     score with the decision evidence attached
                                 (per-pod matched prefix, fleet-health
                                 adjustment, chain-memo family, chosen
@@ -72,7 +82,10 @@ Env config mirrors the reference's variable set (online/main.go:41-58):
 ZMQ_ENDPOINT, ZMQ_TOPIC, POOL_CONCURRENCY, PYTHONHASHSEED (hash seed!),
 BLOCK_SIZE, BLOCK_HASH_ALGO, HTTP_PORT, HF_TOKEN, LOCAL_TOKENIZER_DIR,
 the fleet-health windows SUSPECT_AFTER_S / STALE_AFTER_S, the tracing
-spine knobs KVTPU_TRACE / KVTPU_TRACE_RING / KVTPU_TRACE_SLOW_MS, the
+spine knobs KVTPU_TRACE / KVTPU_TRACE_RING / KVTPU_TRACE_SLOW_MS /
+KVTPU_TRACE_PROPAGATE, the SLO plane SLO / SLO_FAST_WINDOW_S /
+SLO_SLOW_WINDOW_S / SLO_BURN_THRESHOLD / SLO_READ_P99_MS /
+SLO_READ_BUDGET / SLO_HIT_RATE_FLOOR / SLO_SHED_RATE_CEILING, the
 admission gate ADMISSION / ADMISSION_MAX_CONCURRENCY /
 ADMISSION_QUEUE_DEPTH / ADMISSION_MAX_WAIT_MS / ADMISSION_RETRY_AFTER_MS
 (scoring endpoints shed with 429 + Retry-After past the bounds; the
@@ -162,10 +175,33 @@ def config_from_env() -> dict:
         # beyond these demotes / excludes-and-purges a pod.
         "suspect_after_s": float(os.environ.get("SUSPECT_AFTER_S", "30")),
         "stale_after_s": float(os.environ.get("STALE_AFTER_S", "120")),
-        # Tracing spine (obs/): per-request spans + flight recorder.
+        # Tracing spine (obs/): per-request spans + flight recorder, plus
+        # cross-process carrier propagation (obs/carrier.py) — off, every
+        # process traces independently; scores identical either way.
         "trace_enabled": os.environ.get("KVTPU_TRACE", "1") == "1",
         "trace_ring": int(os.environ.get("KVTPU_TRACE_RING", "256")),
         "trace_slow_ms": float(os.environ.get("KVTPU_TRACE_SLOW_MS", "10")),
+        "trace_propagate": os.environ.get("KVTPU_TRACE_PROPAGATE", "1") == "1",
+        # SLO plane (obs/slo.py): declarative objectives evaluated from
+        # the live Prometheus registry with fast+slow multi-window burn
+        # rates (GET /slo/status, /readyz `slo` section,
+        # kvcache_slo_burn_rate gauges). SLO=0 removes the monitor.
+        "slo": os.environ.get("SLO", "1") == "1",
+        "slo_fast_window_s": float(os.environ.get("SLO_FAST_WINDOW_S", "300")),
+        "slo_slow_window_s": float(
+            os.environ.get("SLO_SLOW_WINDOW_S", "3600")
+        ),
+        "slo_burn_threshold": float(
+            os.environ.get("SLO_BURN_THRESHOLD", "2.0")
+        ),
+        "slo_read_p99_ms": float(os.environ.get("SLO_READ_P99_MS", "5")),
+        "slo_read_budget": float(os.environ.get("SLO_READ_BUDGET", "0.01")),
+        "slo_hit_rate_floor": float(
+            os.environ.get("SLO_HIT_RATE_FLOOR", "0.5")
+        ),
+        "slo_shed_rate_ceiling": float(
+            os.environ.get("SLO_SHED_RATE_CEILING", "0.01")
+        ),
         # Replicated control plane (cluster/): this process's membership in
         # the logical index. CLUSTER_REPLICAS=1 (default) is the monolithic
         # deployment — no partition gate, no replication section.
@@ -288,6 +324,7 @@ class ScoringService:
                 enabled=bool(env.get("trace_enabled", True)),
                 ring_capacity=int(env.get("trace_ring", 256)),
                 slow_threshold_s=float(env.get("trace_slow_ms", 10)) / 1e3,
+                propagate=bool(env.get("trace_propagate", True)),
             ))
         self.templating = ChatTemplatingProcessor()
         self.fleet_health = FleetHealthTracker(FleetHealthConfig(
@@ -315,6 +352,31 @@ class ScoringService:
                     float(env.get("admission_retry_after_ms", 1000)) / 1e3
                 ),
             ))
+
+        # SLO plane (obs/slo.py): one monitor over the live Prometheus
+        # registry. Evaluation is pull-based (/slo/status, /readyz, the
+        # scrape cadence); no background thread. A breach never gates
+        # readiness — it is an alert, not a liveness failure.
+        self.slo = None
+        if env.get("slo", True):
+            from llm_d_kv_cache_manager_tpu.obs.slo import (
+                SLOConfig,
+                SLOMonitor,
+                default_objectives,
+            )
+
+            slo_config = SLOConfig(
+                fast_window_s=float(env.get("slo_fast_window_s", 300.0)),
+                slow_window_s=float(env.get("slo_slow_window_s", 3600.0)),
+                burn_threshold=float(env.get("slo_burn_threshold", 2.0)),
+                read_p99_ms=float(env.get("slo_read_p99_ms", 5.0)),
+                read_latency_budget=float(env.get("slo_read_budget", 0.01)),
+                hit_rate_floor=float(env.get("slo_hit_rate_floor", 0.5)),
+                shed_rate_ceiling=float(
+                    env.get("slo_shed_rate_ceiling", 0.01)
+                ),
+            )
+            self.slo = SLOMonitor(default_objectives(slo_config), slo_config)
 
         # Load-aware routing policy (kvcache/routing.py +
         # fleethealth/load.py). The load tracker exists whenever the
@@ -619,15 +681,32 @@ class ScoringService:
     async def _admitted(self, request: web.Request, fn):
         """Run sync scoring work on a worker thread under the admission
         gate (when one is configured), with the client's deadline budget
-        capping the queue wait. Raises `AdmissionRejected` on shed."""
+        capping the queue wait. Raises `AdmissionRejected` on shed.
+
+        Cross-process tracing seam: an `X-Kvtpu-Trace` header (or a W3C
+        `traceparent` from an upstream gateway) makes the read path's
+        root trace adopt the caller's trace id. Missing, truncated, or
+        malformed values NEVER fail the request — they fall back to a
+        fresh local trace, counted in
+        kvcache_trace_carrier_errors_total."""
+        carrier = request.headers.get(obs.HTTP_TRACE_HEADER)
+        if carrier is None:
+            carrier = request.headers.get("traceparent")
+
+        def traced():
+            if carrier is None:
+                return fn()
+            with obs.adopt(carrier):
+                return fn()
+
         if self.admission is None:
-            return await asyncio.to_thread(fn)
+            return await asyncio.to_thread(traced)
         budget = self._deadline_budget(request)
         admission = self.admission
 
         def gated():
             with admission.admit(budget):
-                return fn()
+                return traced()
 
         return await asyncio.to_thread(gated)
 
@@ -731,17 +810,75 @@ class ScoringService:
         )
 
     async def handle_debug_traces(self, request: web.Request) -> web.Response:
-        """Flight-recorder dump: recent complete traces + slow outliers."""
+        """Flight-recorder dump: recent complete traces + slow outliers.
+
+        Query filters (AND-combined): `n`/`limit` caps the recent list,
+        `plane=` keeps traces whose root lives in that plane, `min_ms=`
+        keeps traces at least that slow, `trace_id=` (16 hex) fetches one
+        distributed trace exactly, `crit=1` attaches each trace's
+        critical-path breakdown. The ring is snapshotted under the lock
+        once; filtering and JSON rendering happen outside it."""
+        q = request.query
         n = None
-        if "n" in request.query:
+        raw_n = q.get("limit", q.get("n"))
+        if raw_n is not None:
             try:
-                n = max(0, int(request.query["n"]))
+                n = max(0, int(raw_n))
             except ValueError:
                 return web.json_response(
-                    {"error": "n must be an integer"}, status=400
+                    {"error": "limit must be an integer"}, status=400
                 )
-        snapshot = await asyncio.to_thread(obs.get_recorder().snapshot, n)
+        min_ms = None
+        if "min_ms" in q:
+            try:
+                min_ms = float(q["min_ms"])
+            except ValueError:
+                return web.json_response(
+                    {"error": "min_ms must be a number"}, status=400
+                )
+        plane = q.get("plane")
+        if plane is not None and plane not in obs.PLANES:
+            return web.json_response(
+                {"error": f"plane must be one of {list(obs.PLANES)}"},
+                status=400,
+            )
+        snapshot = await asyncio.to_thread(
+            lambda: obs.get_recorder().snapshot(
+                n=n, plane=plane, min_ms=min_ms,
+                trace_id=q.get("trace_id"),
+                include_critical=q.get("crit") == "1",
+            )
+        )
         return web.json_response(snapshot)
+
+    async def handle_debug_critical_path(
+        self, request: web.Request
+    ) -> web.Response:
+        """Critical-path window summary: per-(span, hop) self-time along
+        the longest dependency chain, aggregated over the recorder's
+        recent ring and grouped by root name — "which hop do I optimize
+        next", as one document. `root=` filters to one root name."""
+        root = request.query.get("root")
+
+        def build():
+            traces = obs.get_recorder().recent()
+            if root is not None:
+                traces = [t for t in traces if t.name == root]
+            return {
+                "traces": len(traces),
+                "roots": obs.aggregate_critical_path(traces),
+            }
+
+        return web.json_response(await asyncio.to_thread(build))
+
+    async def handle_slo_status(self, request: web.Request) -> web.Response:
+        """SLO burn-rate evaluation over the live registry (obs/slo.py):
+        per-objective fast/slow-window burn rates and breach status."""
+        if self.slo is None:
+            return web.json_response(
+                {"error": "slo monitoring disabled (set SLO=1)"}, status=400
+            )
+        return web.json_response(await asyncio.to_thread(self.slo.evaluate))
 
     async def handle_score_explain(self, request: web.Request) -> web.Response:
         """Scores with the decision evidence attached. Same pipeline as
@@ -849,6 +986,11 @@ class ScoringService:
             # slowest recent stage): degraded observability is itself
             # observable, but never gates readiness.
             "obs": obs.get_recorder().stats(),
+            # SLO burn-rate evaluation (obs/slo.py): breach status per
+            # objective. NEVER gates readiness — a breaching service is
+            # degrading, not down; taking it out of rotation would turn
+            # an alert into an outage.
+            "slo": self.slo.evaluate() if self.slo is not None else None,
             # Admission gate occupancy + shed counters: a service AT
             # capacity and shedding correctly is still ready (shedding is
             # the designed overload behavior, not a failure).
@@ -1181,6 +1323,10 @@ class ScoringService:
             "/federation/digest", self.handle_federation_digest
         )
         app.router.add_post("/cluster/snapshot", self.handle_cluster_snapshot)
+        app.router.add_get("/slo/status", self.handle_slo_status)
+        app.router.add_get(
+            "/debug/critical_path", self.handle_debug_critical_path
+        )
         app.router.add_get("/debug/traces", self.handle_debug_traces)
         app.router.add_get("/debug/score_explain", self.handle_score_explain)
         app.router.add_post("/debug/score_explain", self.handle_score_explain)

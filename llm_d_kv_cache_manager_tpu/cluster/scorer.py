@@ -74,6 +74,28 @@ class LocalReplicaTransport:
         pass instead of N single calls)."""
         return self.indexer.score_many(requests)
 
+    # -- carrier-propagating forms (obs/carrier.py) ------------------------
+    # The scatter-gather runs transports on executor threads, so even an
+    # in-process replica loses the caller's thread-local trace; adopting
+    # the carrier re-links its root trace to the caller's trace id, and
+    # the exported spans ride back exactly like a gRPC reply's — one
+    # assembly path for both transports.
+
+    def get_pod_scores_ex_traced(
+        self, prompt, model_name, pod_identifiers, lora_id=None, carrier=None
+    ):
+        with obs.adopt(carrier) as adoption:
+            ps = self.indexer.get_pod_scores_ex(
+                prompt, model_name, pod_identifiers, lora_id=lora_id
+            )
+        return ps, obs.export_trace(adoption.trace)
+
+    def score_many_traced(self, requests, carrier=None):
+        with obs.adopt(carrier) as adoption:
+            results = self.indexer.score_many(requests)
+        payload = obs.export_trace(adoption.trace)
+        return results, ([payload] if payload is not None else [])
+
 
 class GrpcReplicaTransport:
     """Remote replica over `kvtpu.api.v1.IndexerService/GetPodScoresEx`.
@@ -99,17 +121,8 @@ class GrpcReplicaTransport:
             self._client = IndexerGrpcClient(self.target, timeout_s=self.timeout_s)
         return self._client
 
-    def get_pod_scores_ex(
-        self, prompt: str, model_name: str, pod_identifiers, lora_id=None
-    ) -> PodScores:
-        import grpc
-
-        try:
-            payload = self._ensure_client().get_pod_scores_ex(
-                prompt, model_name, pod_identifiers, lora_id=lora_id
-            )
-        except (grpc.RpcError, json.JSONDecodeError, OSError) as e:
-            raise ReplicaUnavailable(f"{self.target}: {e}") from e
+    @staticmethod
+    def _to_pod_scores(payload: dict) -> PodScores:
         return PodScores(
             scores=dict(payload.get("scores", {})),
             match_blocks={
@@ -118,23 +131,56 @@ class GrpcReplicaTransport:
             block_hashes=[int(h) for h in payload.get("block_hashes", [])],
         )
 
+    def get_pod_scores_ex(
+        self, prompt: str, model_name: str, pod_identifiers, lora_id=None
+    ) -> PodScores:
+        return self.get_pod_scores_ex_traced(
+            prompt, model_name, pod_identifiers, lora_id=lora_id
+        )[0]
+
+    def get_pod_scores_ex_traced(
+        self, prompt, model_name, pod_identifiers, lora_id=None, carrier=None
+    ):
+        """Carrier-propagating form: the carrier rides the gRPC metadata,
+        the replica runs its stages under the caller's trace id, and its
+        span tuples come back as the reply's `trace` field (returned
+        separately so the merge never sees it)."""
+        import grpc
+
+        try:
+            payload = self._ensure_client().get_pod_scores_ex(
+                prompt, model_name, pod_identifiers, lora_id=lora_id,
+                carrier=carrier,
+            )
+        except (grpc.RpcError, json.JSONDecodeError, OSError) as e:
+            raise ReplicaUnavailable(f"{self.target}: {e}") from e
+        return self._to_pod_scores(payload), payload.get("trace")
+
     def score_many(self, requests) -> List[PodScores]:
         """Batched read path over the streaming `ScorePodsBulk` endpoint:
         the whole batch rides one gRPC stream (the server micro-batches it
         through `Indexer.score_many`), so a replica is crossed once per
         BATCH, not once per request."""
+        return self.score_many_traced(requests)[0]
+
+    def score_many_traced(self, requests, carrier=None):
         import grpc
 
+        traces: List[dict] = []
         try:
-            payloads = self._ensure_client().score_pods_bulk([
-                {
-                    "prompt": r.prompt,
-                    "model_name": r.model_name,
-                    "pod_identifiers": list(r.pod_identifiers),
-                    "lora_id": r.lora_id,
-                }
-                for r in requests
-            ])
+            payloads = self._ensure_client().score_pods_bulk(
+                [
+                    {
+                        "prompt": r.prompt,
+                        "model_name": r.model_name,
+                        "pod_identifiers": list(r.pod_identifiers),
+                        "lora_id": r.lora_id,
+                    }
+                    for r in requests
+                ],
+                carrier=carrier,
+                trace_sink=traces,
+            )
         except (grpc.RpcError, json.JSONDecodeError, OSError) as e:
             raise ReplicaUnavailable(f"{self.target}: {e}") from e
         if len(payloads) != len(requests):
@@ -142,16 +188,7 @@ class GrpcReplicaTransport:
                 f"{self.target}: bulk stream returned {len(payloads)} "
                 f"results for {len(requests)} requests"
             )
-        return [
-            PodScores(
-                scores=dict(p.get("scores", {})),
-                match_blocks={
-                    pod: int(n) for pod, n in p.get("match_blocks", {}).items()
-                },
-                block_hashes=[int(h) for h in p.get("block_hashes", [])],
-            )
-            for p in payloads
-        ]
+        return [self._to_pod_scores(p) for p in payloads], traces
 
     def close(self) -> None:
         if self._client is not None:
@@ -246,96 +283,99 @@ class ClusterScorer:
             "cluster.score_many",
             {"replicas": len(self.transports), "batch": len(requests)},
         ) as trace:
-            self.scatter_calls += 1
-            targets = self._live_replicas()
-            t_fan = time.perf_counter()
-            futures = [
-                (
-                    rid,
-                    self._executor.submit(
-                        self.transports[rid].score_many, requests
-                    ),
-                )
-                for rid in targets
-            ]
-            deadline = time.perf_counter() + self.config.scatter_timeout_s
-            replies: List[Tuple[int, List[PodScores]]] = []
-            degraded: List[int] = []
-            for rid, fut in futures:
-                budget = max(0.0, deadline - time.perf_counter())
-                try:
-                    result = fut.result(timeout=budget)
-                except Exception as e:  # noqa: BLE001 - degrade per replica
-                    fut.cancel()
-                    self._observe_failure(rid, e)
-                    degraded.append(rid)
-                    continue
-                self._observe_success(rid)
-                replies.append((rid, result))
-            obs.record_into(trace, "cluster.fanout", t_fan, time.perf_counter())
-            if trace is not None and getattr(trace, "meta", None) is not None:
-                trace.meta["degraded_replicas"] = degraded
-
+            replies = self._fan_out(
+                trace, "score_many", "score_many_traced", requests,
+            )
             t_merge = time.perf_counter()
             merged = [
                 self._merge([(rid, reply[i]) for rid, reply in replies])
                 for i in range(len(requests))
             ]
             obs.record_into(trace, "cluster.merge", t_merge, time.perf_counter())
-            if degraded:
-                kvlog.trace(
-                    logger,
-                    "batched scatter-gather degraded: replicas %s "
-                    "contributed no signal", degraded,
-                )
             return merged
 
-    def _scatter_gather(
-        self, prompt, model_name, pod_identifiers, lora_id, trace
-    ) -> PodScores:
+    def _fan_out(self, trace, method, traced_method, *args):
+        """One scatter wave: submit the call to every live replica, gather
+        under the fan-out deadline, degrade per replica. When the caller
+        has a trace, its carrier rides to every replica that supports the
+        traced transport form and the replies' span payloads are grafted
+        back under per-replica `cluster.rpc` hop spans — the recorder
+        then holds ONE cross-process tree for the request."""
         self.scatter_calls += 1
         targets = self._live_replicas()
+        carrier = obs.current_carrier() if trace is not None else None
+
+        def call(rid: int):
+            transport = self.transports[rid]
+            traced = (
+                getattr(transport, traced_method, None)
+                if carrier is not None else None
+            )
+            t0 = time.perf_counter()
+            if traced is not None:
+                result, remote = traced(*args, carrier=carrier)
+            else:
+                result = getattr(transport, method)(*args)
+                remote = None
+            return result, remote, t0, time.perf_counter()
+
         t_fan = time.perf_counter()
         futures = [
-            (
-                rid,
-                self._executor.submit(
-                    self.transports[rid].get_pod_scores_ex,
-                    prompt, model_name, pod_identifiers, lora_id,
-                ),
-            )
-            for rid in targets
+            (rid, self._executor.submit(call, rid)) for rid in targets
         ]
         deadline = time.perf_counter() + self.config.scatter_timeout_s
-        replies: List[Tuple[int, PodScores]] = []
+        replies = []
+        grafts = []
         degraded: List[int] = []
         for rid, fut in futures:
             budget = max(0.0, deadline - time.perf_counter())
             try:
-                result = fut.result(timeout=budget)
-            except Exception as e:  # noqa: BLE001 - any replica failure degrades
+                result, remote, t0c, t1c = fut.result(timeout=budget)
+            except Exception as e:  # noqa: BLE001 - degrade per replica
                 fut.cancel()
                 self._observe_failure(rid, e)
                 degraded.append(rid)
                 continue
             self._observe_success(rid)
             replies.append((rid, result))
-        # Replica-tagged span: one fan-out window for the whole wave; which
-        # replicas degraded rides in the trace meta (ids are data, never
-        # metric labels — cardinality stays bounded).
+            if carrier is not None:
+                grafts.append((rid, remote, t0c, t1c))
+        # One fan-out window for the whole wave, then per-replica rpc hop
+        # spans with the replicas' own stages grafted inside them. Which
+        # replicas participated/degraded rides in the trace meta (ids are
+        # data, never metric labels — cardinality stays bounded).
         obs.record_into(trace, "cluster.fanout", t_fan, time.perf_counter())
+        for rid, remote, t0c, t1c in grafts:
+            if isinstance(remote, list):
+                payloads = [p for p in remote if p] or [None]
+            else:
+                payloads = [remote]
+            for k, payload in enumerate(payloads):
+                obs.graft_remote(
+                    trace, payload, t0c, t1c, hop="cluster.rpc", depth=2,
+                    add_hop=(k == 0),
+                )
+            obs.annotate("rpc_replicas", self.replica_name(rid))
         if trace is not None and getattr(trace, "meta", None) is not None:
             trace.meta["degraded_replicas"] = degraded
-
-        t_merge = time.perf_counter()
-        merged = self._merge(replies)
-        obs.record_into(trace, "cluster.merge", t_merge, time.perf_counter())
         if degraded:
             kvlog.trace(
                 logger,
                 "scatter-gather degraded: replicas %s contributed no signal",
                 degraded,
             )
+        return replies
+
+    def _scatter_gather(
+        self, prompt, model_name, pod_identifiers, lora_id, trace
+    ) -> PodScores:
+        replies = self._fan_out(
+            trace, "get_pod_scores_ex", "get_pod_scores_ex_traced",
+            prompt, model_name, pod_identifiers, lora_id,
+        )
+        t_merge = time.perf_counter()
+        merged = self._merge(replies)
+        obs.record_into(trace, "cluster.merge", t_merge, time.perf_counter())
         return merged
 
     def _live_replicas(self) -> List[int]:

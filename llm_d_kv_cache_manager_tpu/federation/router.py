@@ -30,6 +30,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
+from llm_d_kv_cache_manager_tpu import obs
 from llm_d_kv_cache_manager_tpu.federation.digest import (
     RegionDigest,
     decode_digest,
@@ -294,36 +295,65 @@ class GlobalRouter:
         fails at delegation time contributes nothing — the request retries
         the next-ranked candidate (degraded, never stalled), and an
         exhausted candidate list answers the explicit no-cache-signal
-        empty PodScores."""
+        empty PodScores.
+
+        Traced end to end (`federation.score` root): region_pick /
+        delegate / failover_retry stages, and a remote region's reply
+        spans graft back under a `federation.rpc` hop — the recorder then
+        shows the WAN hop inside the same tree as the local stages."""
+        with obs.request("federation.score"):
+            return self._score_ex(
+                prompt, model_name, pod_identifiers, lora_id=lora_id,
+                home_region=home_region, now=now,
+            )
+
+    def _score_ex(
+        self,
+        prompt: str,
+        model_name: str,
+        pod_identifiers=(),
+        lora_id=None,
+        home_region: Optional[str] = None,
+        now: Optional[float] = None,
+    ) -> GlobalScore:
         region_set = self.config.region_set()
         if len(region_set) == 1:
             # Bit-identity fast path: no derivation, no blend — the flat
             # fleet's answer IS the federation's answer.
             region_id = region_set[0]
-            ps = self.regions[region_id].get_pod_scores_ex(
-                prompt, model_name, pod_identifiers, lora_id=lora_id
+            ps, _ = self._delegate(
+                self.regions[region_id], prompt, model_name,
+                pod_identifiers, lora_id,
             )
             self._count_route(region_id, home_region)
             return GlobalScore(
                 region=region_id, pod_scores=ps,
                 detail={"single_region": True},
             )
-        hashes: Sequence[int] = ()
-        if self.derive_fn is not None:
-            hashes = self.derive_fn(prompt, model_name, lora_id)
-        region_id, detail = self.pick_region(
-            hashes, home_region=home_region, now=now
-        )
+        with obs.stage("federation.region_pick", nested=True):
+            hashes: Sequence[int] = ()
+            if self.derive_fn is not None:
+                hashes = self.derive_fn(prompt, model_name, lora_id)
+            region_id, detail = self.pick_region(
+                hashes, home_region=home_region, now=now
+            )
         tried = []
         while region_id is not None:
             region = self.regions.get(region_id)
             if region is not None:
+                stage_name = (
+                    "federation.delegate" if not tried
+                    else "federation.failover_retry"
+                )
                 try:
-                    ps = region.get_pod_scores_ex(
-                        prompt, model_name, pod_identifiers, lora_id=lora_id
-                    )
+                    with obs.stage(stage_name, nested=True):
+                        ps, _ = self._delegate(
+                            region, prompt, model_name, pod_identifiers,
+                            lora_id,
+                        )
                     self._count_route(region_id, home_region)
                     detail["tried"] = tried
+                    obs.annotate("region", region_id)
                     return GlobalScore(
                         region=region_id, pod_scores=ps, detail=detail
                     )
@@ -341,6 +371,31 @@ class GlobalRouter:
         return GlobalScore(
             region="", pod_scores=PodScores(), detail=detail
         )
+
+    def _delegate(self, region, prompt, model_name, pod_identifiers, lora_id):
+        """One precise delegation, carrier-propagating when the region's
+        front supports the traced transport form (a remote region over
+        gRPC); its reply spans assemble under a `federation.rpc` hop. A
+        local region's front runs on THIS thread — its stages land in the
+        current trace directly, no carrier needed."""
+        carrier = obs.current_carrier()
+        traced = getattr(region, "get_pod_scores_ex_traced", None)
+        if carrier is None or traced is None:
+            return region.get_pod_scores_ex(
+                prompt, model_name, pod_identifiers, lora_id=lora_id
+            ), None
+        t0 = time.perf_counter()
+        ps, remote = traced(
+            prompt, model_name, pod_identifiers, lora_id=lora_id,
+            carrier=carrier,
+        )
+        t1 = time.perf_counter()
+        if remote is not None:
+            obs.graft_remote(
+                obs.current_trace(), remote, t0, t1,
+                hop="federation.rpc", depth=1,
+            )
+        return ps, remote
 
     def get_pod_scores_ex(
         self, prompt: str, model_name: str, pod_identifiers, lora_id=None

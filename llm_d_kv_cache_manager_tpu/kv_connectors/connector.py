@@ -282,6 +282,8 @@ class TransferClient:
         cap = max(max_size, 1)
         buf = (ctypes.c_uint8 * cap)()
         conn = self._conn(host, port)
+        # Peer identity rides the trace META (data), never a metric label.
+        obs.annotate("peer", f"{host}:{port}")
         with obs.stage("transfer.dcn_fetch"), conn.lock:
             for attempt in range(self.config.retries + 1):
                 if attempt:
@@ -330,6 +332,7 @@ class TransferClient:
         buf = (ctypes.c_uint8 * (n * cap))()
         lens = (ctypes.c_int64 * n)()
         conn = self._conn(host, port)
+        obs.annotate("peer", f"{host}:{port}")
         with obs.stage("transfer.dcn_fetch"), conn.lock:
             for attempt in range(self.config.retries + 1):
                 if attempt:

@@ -114,6 +114,16 @@ federation_digest_bytes: Optional[Counter] = None
 federation_warmed_blocks: Optional[Counter] = None
 federation_digest_age: Optional[Gauge] = None
 
+# Fleet-scope distributed tracing (obs/carrier.py): carriers that arrived
+# malformed/truncated at a cross-process seam. The request is NEVER
+# failed — it falls back to a fresh local trace — so this counter is the
+# only evidence a peer is speaking a broken carrier dialect.
+trace_carrier_errors: Optional[Counter] = None
+# SLO plane (obs/slo.py): multi-window error-budget burn rates. Both
+# labels take values from FIXED code vocabularies (SLO_OBJECTIVES /
+# SLO_WINDOWS) — objective topology, never traffic.
+slo_burn_rate: Optional[Gauge] = None
+
 # Anticipatory prefetch (prediction/): session-predictor occupancy, jobs
 # landed ahead of their request, and the honest misprediction cost. The
 # prefetch-drop counter's `source` label takes values from the FIXED
@@ -159,6 +169,7 @@ def register_metrics(registry=None) -> None:
     global federation_warmed_blocks, federation_digest_age
     global prediction_sessions, prediction_jobs, prediction_blocks
     global prediction_mispredicted_blocks, prefetch_drops
+    global trace_carrier_errors, slo_burn_rate
 
     with _register_lock:
         if _registered:
@@ -444,6 +455,21 @@ def register_metrics(registry=None) -> None:
             "the subsystem's honest cost column",
             registry=reg,
         )
+        trace_carrier_errors = Counter(
+            "kvcache_trace_carrier_errors_total",
+            "Trace carriers that arrived missing fields, truncated, or "
+            "malformed at a cross-process seam (the request fell back to "
+            "a fresh local trace; it was never failed)",
+            registry=reg,
+        )
+        slo_burn_rate = Gauge(
+            "kvcache_slo_burn_rate",
+            "Error-budget burn rate per SLO objective and evaluation "
+            "window (obs/slo.py; 1.0 spends the budget exactly at the "
+            "objective rate)",
+            labelnames=("objective", "window"),
+            registry=reg,
+        )
         prefetch_drops = Counter(
             "kvcache_prefetch_drops_total",
             "Prefetch jobs dropped at the bounded queue, labeled by the "
@@ -654,6 +680,16 @@ def count_prediction_mispredicted(blocks: int) -> None:
 def count_prefetch_drop(source: str) -> None:
     if prefetch_drops is not None:
         prefetch_drops.labels(source=source).inc()
+
+
+def count_trace_carrier_error() -> None:
+    if trace_carrier_errors is not None:
+        trace_carrier_errors.inc()
+
+
+def set_slo_burn_rate(objective: str, window: str, burn: float) -> None:
+    if slo_burn_rate is not None:
+        slo_burn_rate.labels(objective=objective, window=window).set(burn)
 
 
 def counter_value(c: Optional[Counter]) -> float:

@@ -25,8 +25,12 @@ traffic.
 """
 
 from llm_d_kv_cache_manager_tpu.obs.spans import (  # noqa: F401
+    HOP_SPANS,
+    PLANES,
+    SPAN_INVENTORY,
     ObsConfig,
     Trace,
+    annotate,
     bind,
     configure,
     configure_from_env,
@@ -40,6 +44,19 @@ from llm_d_kv_cache_manager_tpu.obs.spans import (  # noqa: F401
 )
 from llm_d_kv_cache_manager_tpu.obs.recorder import (  # noqa: F401
     FlightRecorder,
+    aggregate_critical_path,
     aggregate_stages,
+    critical_path,
     get_recorder,
+)
+from llm_d_kv_cache_manager_tpu.obs.carrier import (  # noqa: F401
+    GRPC_CARRIER_KEY,
+    HTTP_TRACE_HEADER,
+    TraceCarrier,
+    adopt,
+    current_carrier,
+    export_trace,
+    graft_remote,
+    make_carrier,
+    parse_carrier,
 )

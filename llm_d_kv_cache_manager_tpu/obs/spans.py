@@ -36,7 +36,9 @@ trace; ordering across threads is whatever the wall clock says.
 
 from __future__ import annotations
 
+import itertools
 import os
+import random
 import threading
 import time
 from dataclasses import dataclass
@@ -46,11 +48,61 @@ from llm_d_kv_cache_manager_tpu.metrics import collector as _metrics
 
 _perf = time.perf_counter
 
+# The bounded plane vocabulary: the first dotted component of every span
+# name, and the only values the `plane` Prometheus label may take
+# (tests/test_metrics_hygiene.py walks the registry against this tuple).
+PLANES = (
+    "read", "write", "transfer", "cluster", "federation", "prediction",
+    "other",
+)
+
+# The committed span-name inventory: every (plane, stage) the code emits
+# anywhere — instrumentation sites, record()/record_into() stamps, and the
+# cross-process hop spans grafted by obs/carrier.py. A silent stage rename
+# fails tests/test_metrics_hygiene.py's source scan against this set, and
+# remote span payloads are sanitized against it before they can mint a
+# Prometheus label (graft_remote).
+SPAN_INVENTORY = frozenset({
+    # read plane (kvcache/indexer.py, tokenization/pool.py)
+    "read.get_pod_scores", "read.score_many",
+    "read.tokenize", "read.tokenize_queue_wait", "read.render",
+    "read.prefix_store", "read.encode", "read.derive",
+    "read.lookup", "read.score",
+    "read.batch.tokenize", "read.batch.derive", "read.batch.lookup",
+    "read.batch.score",
+    # write plane (kvevents/pool.py)
+    "write.digest", "write.queue_wait", "write.decode", "write.index_apply",
+    # transfer plane (engine/tiering.py, kv_connectors/)
+    "transfer.stage", "transfer.stage_extract", "transfer.stage_drain",
+    "transfer.stage_admit", "transfer.offload_dispatch",
+    "transfer.offload_drain", "transfer.load_chain", "transfer.staged_fetch",
+    "transfer.peer_fetch", "transfer.dcn_fetch", "transfer.onboard_wave",
+    "transfer.prefetch_batch", "transfer.route_prefetch",
+    # cluster plane (cluster/scorer.py, cluster/replica.py)
+    "cluster.get_pod_scores", "cluster.score_many", "cluster.fanout",
+    "cluster.merge", "cluster.rpc", "cluster.warm_restart",
+    "cluster.snapshot_load", "cluster.replay",
+    # federation plane (federation/router.py)
+    "federation.score", "federation.region_pick", "federation.delegate",
+    "federation.failover_retry", "federation.rpc",
+    # prediction plane (prediction/scheduler.py)
+    "prediction.tick", "prediction.score_hashes", "prediction.submit",
+    # fallback name a grafted remote span is renamed to when its name is
+    # not in this inventory (a peer cannot mint labels)
+    "other.remote_span",
+})
+
+# Spans that mark a cross-process hop: the critical-path analyzer
+# attributes every span nested under one of these to that hop instead of
+# "local" (obs/recorder.py critical_path).
+HOP_SPANS = frozenset({"cluster.rpc", "federation.rpc"})
+
 
 @dataclass
 class ObsConfig:
     """Tracing-spine knobs (env: KVTPU_TRACE, KVTPU_TRACE_RING,
-    KVTPU_TRACE_SLOW_MS — read by `configure_from_env`)."""
+    KVTPU_TRACE_SLOW_MS, KVTPU_TRACE_PROPAGATE — read by
+    `configure_from_env`)."""
 
     enabled: bool = True
     # Flight-recorder ring: how many recent complete traces are kept.
@@ -70,6 +122,11 @@ class ObsConfig:
     # requests (MICRO_BENCH: ~23k batches/s vs ~3k reads/s): trace every
     # Nth batch so the recorder sees the write plane without taxing it.
     write_trace_stride: int = 16
+    # Cross-process trace propagation (obs/carrier.py): inject a
+    # TraceCarrier at every client seam we own and adopt one at every
+    # server seam. Off, every process traces independently (PR-6
+    # behavior); scores are bit-identical either way.
+    propagate: bool = True
 
 
 # A recorded span: (name, depth, t0, t1) — perf_counter stamps.
@@ -90,7 +147,10 @@ class Trace:
     """One request's span collection. Created by `request()`, completed on
     context exit, then handed to the flight recorder."""
 
-    __slots__ = ("name", "meta", "t0", "t1", "spans", "thread", "depth")
+    __slots__ = (
+        "name", "meta", "t0", "t1", "spans", "thread", "depth",
+        "trace_id", "parent_id",
+    )
 
     def __init__(self, name: str, meta: Optional[dict] = None):
         self.name = name
@@ -102,6 +162,21 @@ class Trace:
         if tname is None:
             tname = _tls.name = threading.current_thread().name
         self.thread = tname
+        # Distributed identity. A pending adoption (obs/carrier.py set it
+        # from an extracted TraceCarrier) hands this root the CALLER's
+        # trace id, so the caller's recorder can assemble one
+        # cross-process tree; otherwise a fresh process-local id is
+        # minted (xor of a per-process random salt and a counter — one
+        # integer op, no urandom syscall on the hot path).
+        adopt = _tls.adopt
+        if adopt is None:
+            self.trace_id = next(_id_counter) ^ _ID_SALT
+            self.parent_id = 0
+        else:
+            self.trace_id = adopt.carrier.trace_id
+            self.parent_id = adopt.carrier.span_id
+            adopt.trace = self
+            _tls.adopt = None  # one root per adoption
         # Current nesting depth of open stages. Lives on the trace, not
         # the thread-local: object attribute access is several times
         # cheaper than threading.local lookup, and every span exit needs
@@ -126,10 +201,13 @@ class Trace:
     def as_dict(self) -> dict:
         d = {
             "name": self.name,
+            "trace_id": f"{self.trace_id:016x}",
             "duration_us": round(self.duration_s * 1e6, 1),
             "thread": self.thread,
             "spans": [span_as_dict(s, self.t0) for s in self.spans],
         }
+        if self.parent_id:
+            d["parent_id"] = f"{self.parent_id:016x}"
         if self.meta:
             d["meta"] = self.meta
         return d
@@ -141,13 +219,22 @@ _config = ObsConfig(
     enabled=os.environ.get("KVTPU_TRACE", "1") == "1",
     ring_capacity=int(os.environ.get("KVTPU_TRACE_RING", "256")),
     slow_threshold_s=float(os.environ.get("KVTPU_TRACE_SLOW_MS", "10")) / 1e3,
+    propagate=os.environ.get("KVTPU_TRACE_PROPAGATE", "1") == "1",
 )
+
+# Trace-id minting: 64-bit, unique within the process (counter) and
+# collision-unlikely across a fleet (random salt drawn once at import).
+_ID_SALT = random.getrandbits(64) | 1
+_id_counter = itertools.count(1)
 
 
 class _Tls(threading.local):
     trace: Optional[Trace] = None
     name: Optional[str] = None  # cached thread name (current_thread() is
     # a lock-free dict lookup but still ~3x an attribute read)
+    # Pending carrier adoption (obs/carrier.py `adopt()` sets it; the next
+    # root Trace created on this thread consumes it).
+    adopt = None
 
 
 _tls = _Tls()
@@ -185,6 +272,7 @@ def configure_from_env() -> ObsConfig:
         ring_capacity=int(os.environ.get("KVTPU_TRACE_RING", "256")),
         slow_threshold_s=float(os.environ.get("KVTPU_TRACE_SLOW_MS", "10"))
         / 1e3,
+        propagate=os.environ.get("KVTPU_TRACE_PROPAGATE", "1") == "1",
     )
     configure(cfg)
     return cfg
@@ -210,12 +298,16 @@ def current_trace() -> Optional[Trace]:
 class _Noop:
     """Shared do-nothing span/trace: what every API point returns when
     tracing is disabled. A singleton, so disabled-mode instrumentation
-    allocates nothing (pinned by test_obs.py)."""
+    allocates nothing (pinned by test_obs.py). `__enter__` yields None —
+    never the singleton — so `with obs.request(...) as trace:` callers can
+    hand the yield straight to `record_into`/meta updates and disabled
+    mode stays a no-op instead of an AttributeError on a span-less
+    object."""
 
     __slots__ = ()
 
     def __enter__(self):
-        return self
+        return None
 
     def __exit__(self, exc_type, exc, tb):
         return False
@@ -270,6 +362,20 @@ class _NestedStageCtx(_StageCtx):
         else:
             _observe(name, t1 - self.t0)
         return False
+
+
+class _NestedRequestCtx(_NestedStageCtx):
+    """A `request()` opened while a trace is already active: records as a
+    nested stage of the outer trace, but yields the OUTER trace (not the
+    stage context) so composing callers — a ClusterScorer inside a
+    federation trace — can keep using the yield for `record_into` and
+    meta updates exactly as they would with a root trace."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        super().__enter__()
+        return _tls.trace
 
 
 class _RequestCtx:
@@ -333,7 +439,7 @@ def request(name: str, meta: Optional[dict] = None):
     if not _config.enabled:
         return _NOOP
     if _tls.trace is not None:
-        return _NestedStageCtx(name)
+        return _NestedRequestCtx(name)
     return _RequestCtx(Trace(name, meta))
 
 
@@ -380,6 +486,30 @@ def record_into(trace: Optional[Trace], name: str, t0: float, t1: float,
         trace.spans.append((name, depth, t0, t1))
     else:
         _observe(name, t1 - t0)
+
+
+def annotate(key: str, value) -> None:
+    """Attach one piece of evidence to the current trace's meta — the
+    data channel for identities that must never become metric labels
+    (peer host:port on a DCN fetch, replica ids on a scatter hop).
+    Repeated keys accumulate into a small bounded list; no trace (or
+    tracing disabled) is a no-op."""
+    if not _config.enabled:
+        return
+    trace = _tls.trace
+    if trace is None:
+        return
+    meta = trace.meta
+    if meta is None:
+        meta = trace.meta = {}
+    cur = meta.get(key)
+    if cur is None:
+        meta[key] = value
+    elif isinstance(cur, list):
+        if value not in cur and len(cur) < 8:
+            cur.append(value)
+    elif cur != value:
+        meta[key] = [cur, value]
 
 
 def split_stage(name: str) -> Tuple[str, str]:

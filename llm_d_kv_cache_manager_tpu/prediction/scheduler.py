@@ -35,6 +35,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, List, Optional
 
+from llm_d_kv_cache_manager_tpu import obs
 from llm_d_kv_cache_manager_tpu.metrics import collector as metrics
 from llm_d_kv_cache_manager_tpu.prediction.sessions import (
     SessionRecord,
@@ -119,30 +120,37 @@ class PrefetchScheduler:
         cfg = self.config
         self.stats["ticks"] += 1
         self.stats["expired"] += self.table.expire_pending(now)
-        submitted = 0
         due = self.table.due_sessions(
             now,
             start_frac=cfg.start_frac,
             cooldown_s=cfg.session_cooldown_s,
             limit=cfg.max_jobs_per_tick,
         )
-        for rec, expected_at in due:
-            if submitted >= cfg.max_jobs_per_tick:
-                break
-            if self._prefetch(rec, now):
-                submitted += 1
-                kvlog.trace(
-                    logger,
-                    "anticipatory prefetch for session %x "
-                    "(expected in %.2fs)",
-                    rec.tail, expected_at - now,
-                )
+        if not due:
+            return 0
+        # Only working ticks trace (prediction plane stage attribution):
+        # an idle tick is the overwhelmingly common case and must not
+        # churn the flight-recorder ring.
+        submitted = 0
+        with obs.request("prediction.tick", {"due": len(due)}):
+            for rec, expected_at in due:
+                if submitted >= cfg.max_jobs_per_tick:
+                    break
+                if self._prefetch(rec, now):
+                    submitted += 1
+                    kvlog.trace(
+                        logger,
+                        "anticipatory prefetch for session %x "
+                        "(expected in %.2fs)",
+                        rec.tail, expected_at - now,
+                    )
         return submitted
 
     def _prefetch(self, rec: SessionRecord, now: float) -> bool:
         if not rec.chain_hashes:
             return False
-        result = self.score_fn(rec.model_name, rec.chain_hashes)
+        with obs.stage("prediction.score_hashes", nested=True):
+            result = self.score_fn(rec.model_name, rec.chain_hashes)
         pod = self.select_fn(result.scores)
         if pod is None:
             self.stats["skipped_no_target"] += 1
@@ -155,7 +163,9 @@ class PrefetchScheduler:
         # and idempotent (prefetch_hashes filters resident blocks;
         # warm_chain materializes only what some tier can supply), so
         # over-submission costs a queue slot, never a wasted transfer.
-        if self.submit_fn(pod, list(rec.chain_hashes)):
+        with obs.stage("prediction.submit"):
+            submitted = self.submit_fn(pod, list(rec.chain_hashes))
+        if submitted:
             self.table.note_prefetch(rec, pod, now)
             self.stats["jobs_submitted"] += 1
             self.stats["blocks_submitted"] += len(rec.chain_hashes)

@@ -14,6 +14,9 @@ Adding a collector with a `pod`/`model`/`hash` label fails here, at review
 time, instead of in production at scrape time.
 """
 
+import re
+from pathlib import Path
+
 import prometheus_client
 from prometheus_client import REGISTRY
 
@@ -21,25 +24,31 @@ from llm_d_kv_cache_manager_tpu.metrics import collector as metrics
 from llm_d_kv_cache_manager_tpu.obs import spans as obs_spans
 
 # Every allowed label name takes values from a FIXED set defined in code:
-#   state   — pod/redis lifecycle states (healthy/suspect/stale, up/down/…)
-#   kind    — stream-anomaly kinds (seq_gap/duplicate/reorder/…)
-#   backend — tokenizer backend names (local/uds/hf)
-#   op      — tokenizer operations (encode/render)
-#   plane   — tracing planes (read/write/transfer/other)
-#   stage   — tracing stage names (fixed by the instrumentation sites)
-#   phase   — fleet-membership lifecycle phases (cluster/membership.py
-#             PHASES tuple: joining/warming/reassigning/serving/
-#             draining/left)
-#   region  — federation region ids (the FIXED configured region set,
-#             FederationConfig.regions / FEDERATION_REGIONS — deployment
-#             topology, never traffic)
-#   source  — prefetch-queue submitter planes (kv_connectors/prefetch.py
-#             PREFETCH_SOURCES tuple: route/replication/prediction)
+#   state     — pod/redis lifecycle states (healthy/suspect/stale, up/down/…)
+#   kind      — stream-anomaly kinds (seq_gap/duplicate/reorder/…)
+#   backend   — tokenizer backend names (local/uds/hf)
+#   op        — tokenizer operations (encode/render)
+#   plane     — tracing planes (obs/spans.py PLANES tuple)
+#   stage     — tracing stage names (fixed by the instrumentation sites;
+#               pinned to the committed SPAN_INVENTORY below)
+#   phase     — fleet-membership lifecycle phases (cluster/membership.py
+#               PHASES tuple: joining/warming/reassigning/serving/
+#               draining/left)
+#   region    — federation region ids (the FIXED configured region set,
+#               FederationConfig.regions / FEDERATION_REGIONS — deployment
+#               topology, never traffic)
+#   source    — prefetch-queue submitter planes (kv_connectors/prefetch.py
+#               PREFETCH_SOURCES tuple: route/replication/prediction)
+#   objective — SLO objective names (obs/slo.py SLO_OBJECTIVES tuple)
+#   window    — SLO evaluation windows (obs/slo.py SLO_WINDOWS: fast/slow)
 ALLOWED_LABELS = {
     "state", "kind", "backend", "op", "plane", "stage", "phase", "region",
-    "source",
+    "source", "objective", "window",
 }
-ALLOWED_PLANES = {"read", "write", "transfer", "cluster", "other"}
+# The plane vocabulary is committed in code (obs/spans.py) — the walk and
+# the span-inventory scan both pin against the same tuple, so a new plane
+# must be added there (one place) to pass here.
+ALLOWED_PLANES = set(obs_spans.PLANES)
 
 
 def _kvcache_collectors():
@@ -103,6 +112,11 @@ def test_collectors_exist():
     assert "prediction_blocks" in collectors
     assert "prediction_mispredicted_blocks" in collectors
     assert "prefetch_drops" in collectors
+    # Fleet-scope distributed tracing + SLO plane (PR 13): carrier-error
+    # evidence and the per-(objective, window) burn-rate gauge — both
+    # inside the walk so their label bounds stay enforced.
+    assert "trace_carrier_errors" in collectors
+    assert "slo_burn_rate" in collectors
 
 
 def test_prefetch_drop_source_values_are_code_defined():
@@ -218,7 +232,7 @@ def test_stage_label_values_are_code_defined():
 
 def test_instrumentation_sites_split_into_known_planes():
     """The span namespace itself stays bounded: split_stage maps every
-    name the code uses into one of the four planes."""
+    name the code uses into one of the committed planes."""
     assert obs_spans.split_stage("read.tokenize") == ("read", "tokenize")
     assert obs_spans.split_stage("write.index_apply") == (
         "write", "index_apply"
@@ -226,7 +240,93 @@ def test_instrumentation_sites_split_into_known_planes():
     assert obs_spans.split_stage("transfer.dcn_fetch") == (
         "transfer", "dcn_fetch"
     )
+    assert obs_spans.split_stage("federation.delegate")[0] == "federation"
+    assert obs_spans.split_stage("prediction.tick")[0] == "prediction"
     # Un-prefixed names fall into the 'other' plane instead of minting a
     # new label value.
     assert obs_spans.split_stage("adhoc")[0] == "other"
     assert obs_spans.split_stage(".weird")[0] == "other"
+
+
+def test_slo_label_values_are_code_defined():
+    """The slo_burn_rate gauge's labels carry only the fixed objective
+    and window vocabularies from obs/slo.py."""
+    from llm_d_kv_cache_manager_tpu.obs.slo import SLO_OBJECTIVES, SLO_WINDOWS
+
+    metrics.register_metrics()
+    for metric in REGISTRY.collect():
+        if metric.name != "kvcache_slo_burn_rate":
+            continue
+        for sample in metric.samples:
+            objective = sample.labels.get("objective")
+            window = sample.labels.get("window")
+            if objective is not None:
+                assert objective in SLO_OBJECTIVES, (
+                    f"unexpected SLO objective {objective!r}"
+                )
+            if window is not None:
+                assert window in SLO_WINDOWS, (
+                    f"unexpected SLO window {window!r}"
+                )
+
+
+# -- span-vocabulary pin -------------------------------------------------------
+
+_PACKAGE_ROOT = (
+    Path(__file__).resolve().parent.parent / "llm_d_kv_cache_manager_tpu"
+)
+# Span-name literals at instrumentation sites: obs.request("x")/
+# obs.stage("x"), obs.record("x", …)/obs.record_into(trace, "x", …)
+# (multiline call sites included), and the hop names passed to
+# graft_remote(hop="x").
+_SPAN_SITE_PATTERNS = (
+    re.compile(r'obs\.(?:request|stage)\(\s*["\']([a-z_][a-z_.]*)["\']'),
+    re.compile(
+        r'obs\.record(?:_into)?\(\s*(?:[\w.\[\]]+\s*,\s*)?'
+        r'["\']([a-z_][a-z_.]*)["\']',
+        re.S,
+    ),
+    re.compile(r'hop=["\']([a-z_][a-z_.]*)["\']'),
+)
+
+
+def _emitted_span_names():
+    names = set()
+    for path in _PACKAGE_ROOT.rglob("*.py"):
+        if path.parent.name == "obs":
+            continue  # the spine's own modules define, not emit
+        text = path.read_text(encoding="utf-8")
+        for pat in _SPAN_SITE_PATTERNS:
+            names.update(pat.findall(text))
+    return names
+
+
+def test_span_vocabulary_is_committed():
+    """Every (plane, stage) emitted ANYWHERE in the package must appear in
+    the committed inventory (obs/spans.py SPAN_INVENTORY). A silent stage
+    rename — the classic way dashboards and the critical-path attribution
+    break without a test noticing — fails here at review time."""
+    emitted = _emitted_span_names()
+    # The scan must actually see the instrumentation (guards against the
+    # regexes silently matching nothing).
+    assert len(emitted) >= 25, sorted(emitted)
+    unknown = emitted - obs_spans.SPAN_INVENTORY
+    assert not unknown, (
+        f"span name(s) {sorted(unknown)} emitted but missing from "
+        "obs/spans.py SPAN_INVENTORY — if this is an intentional "
+        "rename/addition, commit it to the inventory (and update "
+        "docs/observability.md's span table)"
+    )
+
+
+def test_span_inventory_is_well_formed():
+    """Inventory names obey the label contract the registry walk enforces
+    after the fact: a known plane prefix, digit-free stage names."""
+    for name in obs_spans.SPAN_INVENTORY:
+        plane, stage = obs_spans.split_stage(name)
+        assert plane in ALLOWED_PLANES, f"{name!r}: unknown plane {plane!r}"
+        assert stage and not any(ch.isdigit() for ch in stage), (
+            f"{name!r}: stage looks traffic-derived"
+        )
+    for hop in obs_spans.HOP_SPANS:
+        assert hop in obs_spans.SPAN_INVENTORY

@@ -5,8 +5,17 @@ ring-buffer bounds + slow-outlier retention, disabled mode as a shared
 no-op (and score-identical either way), `/debug/traces` +
 `/debug/score_explain` (explain scores bit-identical to `get_pod_scores`),
 the write plane's apply-delay histogram, and the stoppable metrics beat.
-"""
 
+Plus the ISSUE-13 contracts: TraceCarrier round-trips + malformed-carrier
+robustness (a broken carrier NEVER fails a request, it counts into
+kvcache_trace_carrier_errors_total), scores bit-identical with tracing
+on/off × carriers present/absent, ONE assembled cross-process trace for a
+cluster-mode request over real gRPC with critical-path shares summing to
+~100% of root wall time, and the /debug/traces filters +
+/debug/critical_path surfaces."""
+
+import random
+import string
 import threading
 import time
 
@@ -553,6 +562,482 @@ class TestGrpcExplain:
             assert explain["scores"] == scores  # bit-identical over the wire
             assert explain["chosen"] == "pod-grpc"
             assert explain["pods"]["pod-grpc"]["match_blocks"] == n
+            client.close()
+        finally:
+            server.stop(grace=0)
+            indexer.shutdown()
+
+
+def _carrier_errors() -> float:
+    metrics.register_metrics()
+    return metrics.counter_value(metrics.trace_carrier_errors)
+
+
+class TestTraceCarrier:
+    def test_round_trip_and_w3c_interop(self):
+        with obs.request("read.get_pod_scores") as trace:
+            carrier = obs.current_carrier()
+            tid = trace.trace_id
+        assert carrier is not None and carrier.startswith("kvtpu1-")
+        parsed = obs.parse_carrier(carrier)
+        assert parsed.trace_id == tid
+        assert parsed.span_id == tid
+        # W3C traceparent from an upstream gateway joins too (low 64 bits).
+        w3c = f"00-{0:016x}{tid:016x}-{'ab' * 8}-01"
+        assert obs.parse_carrier(w3c).trace_id == tid
+
+    def test_no_carrier_outside_request_or_when_off(self):
+        assert obs.current_carrier() is None  # no trace open
+        obs.configure(ObsConfig(enabled=True, propagate=False))
+        with obs.request("read.get_pod_scores"):
+            assert obs.current_carrier() is None
+        obs.configure(ObsConfig(enabled=False))
+        assert obs.current_carrier() is None
+
+    def test_malformed_carriers_counted_never_raise(self):
+        rng = random.Random(13)
+        junk = [
+            "", "garbage", "kvtpu1", "kvtpu1---", "kvtpu1-12-34-56",
+            "kvtpu1-" + "z" * 16 + "-" + "0" * 16 + "-01",
+            "kvtpu1-" + "0" * 16 + "-" + "0" * 16 + "-01",  # zero trace id
+            "00-shortid-span-01", b"\xff\xfe binary".decode("latin1"),
+            12345, b"\xff\xff\xff",
+        ] + [
+            "".join(rng.choices(string.printable, k=rng.randint(1, 60)))
+            for _ in range(50)
+        ]
+        for value in junk:
+            before = _carrier_errors()
+            assert obs.parse_carrier(value) is None
+            assert _carrier_errors() == before + 1, f"uncounted: {value!r}"
+        # Absent is NOT an error — fresh local trace, silently.
+        before = _carrier_errors()
+        assert obs.parse_carrier(None) is None
+        assert _carrier_errors() == before
+
+    def test_adopt_links_root_to_caller_trace_id(self):
+        with obs.request("read.get_pod_scores") as caller:
+            carrier = obs.current_carrier()
+        with obs.adopt(carrier) as adoption:
+            with obs.request("read.get_pod_scores") as served:
+                assert served.trace_id == caller.trace_id
+                assert served.parent_id == caller.trace_id
+        assert adoption.trace is served
+        payload = obs.export_trace(adoption.trace)
+        assert payload["trace_id"] == f"{caller.trace_id:016x}"
+
+    def test_adopt_malformed_falls_back_to_fresh_local_trace(self):
+        before = _carrier_errors()
+        with obs.adopt("kvtpu1-corrupt-carrier-zz") as adoption:
+            with obs.request("read.get_pod_scores") as served:
+                assert served.trace_id != 0
+                assert served.parent_id == 0  # fresh local root
+        assert adoption.trace is None  # nothing adopted, nothing shipped
+        assert _carrier_errors() == before + 1
+
+    def test_graft_sanitizes_unknown_remote_span_names(self):
+        rec = obs.get_recorder()
+        payload = {
+            "trace_id": "ab" * 8, "root": "read.get_pod_scores",
+            "duration_us": 500.0,
+            "spans": [
+                ["read.lookup", 0, 10.0, 100.0],
+                ["evil.pod_name_12345", 0, 120.0, 50.0],  # label-mint try
+                "not-a-span",  # garbage entry: counted, skipped
+            ],
+        }
+        before = _carrier_errors()
+        with obs.request("cluster.get_pod_scores") as trace:
+            t0 = time.perf_counter()
+            obs.graft_remote(trace, payload, t0, t0 + 0.001)
+        names = {s[0] for s in rec.recent()[-1].spans}
+        assert "read.lookup" in names
+        assert "other.remote_span" in names
+        assert not any("evil" in n for n in names)
+        assert _carrier_errors() == before + 1
+
+
+class TestCriticalPath:
+    def test_partition_is_exact(self):
+        from llm_d_kv_cache_manager_tpu.obs.recorder import critical_path
+
+        t = Trace("read.get_pod_scores")
+        # tokenize [1,4]ms and score [3,9]ms overlap: the critical path
+        # takes score back to 3ms, then tokenize's remainder [1,3]ms.
+        t.spans = [
+            ("read.tokenize", 0, t.t0 + 0.001, t.t0 + 0.004),
+            ("read.score", 0, t.t0 + 0.003, t.t0 + 0.009),
+        ]
+        t.t1 = t.t0 + 0.010
+        cp = critical_path(t)
+        self_us = {(e["span"], e["hop"]): e["self_us"] for e in cp["entries"]}
+        assert self_us[("read.score", "local")] == pytest.approx(6000, abs=1)
+        assert self_us[("read.tokenize", "local")] == pytest.approx(
+            2000, abs=1
+        )
+        assert self_us[("read.get_pod_scores", "local")] == pytest.approx(
+            2000, abs=1
+        )
+        assert cp["share_sum_pct"] == pytest.approx(100.0, abs=0.5)
+
+    def test_hop_attribution_under_rpc_span(self):
+        from llm_d_kv_cache_manager_tpu.obs.recorder import critical_path
+
+        t = Trace("cluster.get_pod_scores")
+        t.spans = [
+            ("cluster.rpc", 1, t.t0 + 0.001, t.t0 + 0.005),
+            ("read.lookup", 2, t.t0 + 0.002, t.t0 + 0.004),
+        ]
+        t.t1 = t.t0 + 0.006
+        cp = critical_path(t)
+        entries = {(e["span"], e["hop"]) for e in cp["entries"]}
+        assert ("read.lookup", "cluster.rpc") in entries
+        assert ("cluster.rpc", "local") in entries  # wire/serialization gap
+        assert cp["share_sum_pct"] == pytest.approx(100.0, abs=0.5)
+
+    def test_aggregate_groups_by_root(self):
+        from llm_d_kv_cache_manager_tpu.obs.recorder import (
+            aggregate_critical_path,
+        )
+
+        traces = []
+        for _ in range(3):
+            t = Trace("read.get_pod_scores")
+            t.spans = [("read.lookup", 0, t.t0 + 0.001, t.t0 + 0.003)]
+            t.t1 = t.t0 + 0.004
+            traces.append(t)
+        agg = aggregate_critical_path(traces)
+        doc = agg["read.get_pod_scores"]
+        assert doc["traces"] == 3
+        shares = {
+            (e["span"], e["hop"]): e["share_pct"] for e in doc["entries"]
+        }
+        assert shares[("read.lookup", "local")] == pytest.approx(50.0, abs=1)
+        assert sum(shares.values()) == pytest.approx(100.0, abs=0.5)
+
+
+class TestDistributedClusterTrace:
+    """The ISSUE-13 acceptance pin: a cluster-mode request produces ONE
+    assembled trace containing replica-side stages under the caller's
+    trace id, critical-path shares summing to ~100% of root wall time."""
+
+    def _replica_indexers(self, n=2):
+        from llm_d_kv_cache_manager_tpu.cluster import ReplicaPartitioner
+
+        partitioner = ReplicaPartitioner(n)
+        indexers = []
+        for _ in range(n):
+            idx = _make_indexer()
+            indexers.append(idx)
+        # Seed every replica with every pod's entries; the ownership merge
+        # only takes pod P's answer from owner(P), so the merged result is
+        # the monolithic answer either way.
+        for idx in indexers:
+            _seed_index(idx, pod="pod-a")
+            _seed_index(idx, pod="pod-b", base=60_000)
+        return partitioner, indexers
+
+    def _assert_assembled(self, scorer, caller_fn, rec):
+        rec.clear()
+        result = caller_fn()
+        assert result.scores  # the request actually scored
+        assembled = [
+            t for t in rec.recent() if t.name == "cluster.get_pod_scores"
+        ]
+        assert assembled, "no cluster root trace recorded"
+        trace = assembled[-1]
+        names = [s[0] for s in trace.spans]
+        # Per-replica rpc hops + replica-side read stages inside them.
+        assert names.count("cluster.rpc") == 2
+        assert "read.lookup" in names and "read.score" in names
+        assert "cluster.fanout" in names and "cluster.merge" in names
+        # Replica-side roots in the ring share the caller's trace id:
+        # one distributed trace, not three unrelated ones.
+        replica_roots = [
+            t for t in rec.recent()
+            if t.name == "read.get_pod_scores"
+            and t.trace_id == trace.trace_id
+        ]
+        assert len(replica_roots) == 2
+        assert all(r.parent_id == trace.trace_id for r in replica_roots)
+        # Critical-path shares sum to ~100% of root wall time, with the
+        # replica hop attributed as such.
+        from llm_d_kv_cache_manager_tpu.obs.recorder import critical_path
+
+        cp = critical_path(trace)
+        assert cp["share_sum_pct"] == pytest.approx(100.0, abs=1.0)
+        hops = {(e["span"], e["hop"]) for e in cp["entries"]}
+        assert any(hop == "cluster.rpc" for _, hop in hops)
+        return trace
+
+    def test_local_transport_assembles_one_trace(self):
+        from llm_d_kv_cache_manager_tpu.cluster import (
+            ClusterConfig,
+            ClusterScorer,
+        )
+        from llm_d_kv_cache_manager_tpu.cluster.scorer import (
+            LocalReplicaTransport,
+        )
+
+        partitioner, indexers = self._replica_indexers()
+        scorer = ClusterScorer(
+            [LocalReplicaTransport(i) for i in indexers],
+            partitioner=partitioner,
+            config=ClusterConfig(num_replicas=2),
+        )
+        try:
+            self._assert_assembled(
+                scorer,
+                lambda: scorer.get_pod_scores_ex(PROMPT, TEST_MODEL_NAME, []),
+                obs.get_recorder(),
+            )
+        finally:
+            scorer.close()
+            for idx in indexers:
+                idx.shutdown()
+
+    @pytest.mark.cluster
+    def test_grpc_transport_assembles_one_trace(self):
+        import socket
+
+        from llm_d_kv_cache_manager_tpu.api.grpc_server import serve_grpc
+        from llm_d_kv_cache_manager_tpu.cluster import (
+            ClusterConfig,
+            ClusterScorer,
+        )
+        from llm_d_kv_cache_manager_tpu.cluster.scorer import (
+            GrpcReplicaTransport,
+        )
+
+        partitioner, indexers = self._replica_indexers()
+        servers, transports = [], []
+        for idx in indexers:
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                port = s.getsockname()[1]
+            servers.append(serve_grpc(idx, f"127.0.0.1:{port}"))
+            transports.append(GrpcReplicaTransport(f"127.0.0.1:{port}"))
+        scorer = ClusterScorer(
+            transports, partitioner=partitioner,
+            config=ClusterConfig(num_replicas=2),
+        )
+        try:
+            rec = obs.get_recorder()
+            trace = self._assert_assembled(
+                scorer,
+                lambda: scorer.get_pod_scores_ex(PROMPT, TEST_MODEL_NAME, []),
+                rec,
+            )
+            # Bit-identity: the assembled-trace run scores exactly like a
+            # propagation-off run over the same state.
+            traced_scores = scorer.get_pod_scores(PROMPT, TEST_MODEL_NAME, [])
+            obs.configure(ObsConfig(enabled=True, propagate=False))
+            plain_scores = scorer.get_pod_scores(PROMPT, TEST_MODEL_NAME, [])
+            assert traced_scores == plain_scores
+            assert trace.meta.get("rpc_replicas")  # hop identity as data
+
+            # Batched surface assembles too (bulk stream ships window
+            # traces back).
+            obs.configure(ObsConfig(enabled=True))
+            from llm_d_kv_cache_manager_tpu.kvcache.indexer import ScoreRequest
+
+            rec.clear()
+            requests = [
+                ScoreRequest(prompt=PROMPT, model_name=TEST_MODEL_NAME)
+                for _ in range(3)
+            ]
+            results = scorer.score_many(requests)
+            assert len(results) == 3 and all(r.scores for r in results)
+            batch_traces = [
+                t for t in rec.recent() if t.name == "cluster.score_many"
+            ]
+            assert batch_traces
+            bnames = [s[0] for s in batch_traces[-1].spans]
+            assert "cluster.rpc" in bnames
+            assert "read.score_many" in bnames  # remote batch root grafted
+        finally:
+            scorer.close()
+            for server in servers:
+                server.stop(grace=0)
+            for idx in indexers:
+                idx.shutdown()
+
+
+class TestTracesEndpointFilters:
+    def test_filters_and_critical_path_endpoint(self):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from llm_d_kv_cache_manager_tpu.api.http_service import ScoringService
+
+        service = ScoringService(env={}, indexer=_make_indexer())
+        _seed_index(service.indexer)
+
+        async def run():
+            async with TestClient(TestServer(service.make_app())) as client:
+                resp = await client.post(
+                    "/score_completions",
+                    json={"prompt": PROMPT, "model": TEST_MODEL_NAME},
+                )
+                assert resp.status == 200
+
+                # plane filter: the read trace is there, the write plane
+                # is empty.
+                resp = await client.get("/debug/traces?plane=read")
+                data = await resp.json()
+                assert data["recent"]
+                assert all(
+                    t["name"].startswith("read.") for t in data["recent"]
+                )
+                resp = await client.get("/debug/traces?plane=write")
+                assert (await resp.json())["recent"] == []
+                resp = await client.get("/debug/traces?plane=bogus")
+                assert resp.status == 400
+
+                # min_ms filter: nothing took 10 minutes.
+                resp = await client.get("/debug/traces?min_ms=600000")
+                data = await resp.json()
+                assert data["recent"] == [] and data["slow"] == []
+
+                # limit alias + crit attachment.
+                resp = await client.get("/debug/traces?limit=1&crit=1")
+                data = await resp.json()
+                assert len(data["recent"]) == 1
+                cp = data["recent"][0]["critical_path"]
+                assert cp["share_sum_pct"] == pytest.approx(100.0, abs=1.0)
+
+                # trace_id exact fetch round-trips through the rendered id.
+                tid = data["recent"][0]["trace_id"]
+                resp = await client.get(f"/debug/traces?trace_id={tid}")
+                data = await resp.json()
+                assert [t["trace_id"] for t in data["recent"]] == [tid]
+                resp = await client.get("/debug/traces?trace_id=ffffffffffffffff")
+                assert (await resp.json())["recent"] == []
+
+                # /debug/critical_path window summary.
+                resp = await client.get("/debug/critical_path")
+                assert resp.status == 200
+                doc = await resp.json()
+                assert doc["traces"] >= 1
+                root = doc["roots"]["read.get_pod_scores"]
+                assert root["entries"][0]["self_us"] > 0
+                resp = await client.get(
+                    "/debug/critical_path?root=write.digest"
+                )
+                assert (await resp.json())["roots"] == {}
+
+        try:
+            asyncio.run(run())
+        finally:
+            service.stop()
+
+
+class TestCarrierRobustnessHttp:
+    """Property: no header value — valid, truncated, malformed, or binary
+    garbage — changes scores or fails a request; malformed ones count."""
+
+    def test_scores_bit_identical_and_errors_counted(self):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from llm_d_kv_cache_manager_tpu.api.http_service import ScoringService
+
+        service = ScoringService(env={}, indexer=_make_indexer())
+        _seed_index(service.indexer)
+        rng = random.Random(29)
+        headers_cases = [None, "kvtpu1-0bad", "", "00-xx-yy-zz"] + [
+            "".join(
+                rng.choices(string.ascii_letters + string.digits + "-", k=30)
+            )
+            for _ in range(8)
+        ]
+
+        async def run():
+            async with TestClient(TestServer(service.make_app())) as client:
+                resp = await client.post(
+                    "/score_completions",
+                    json={"prompt": PROMPT, "model": TEST_MODEL_NAME},
+                )
+                baseline = (await resp.json())["podScores"]
+                assert baseline
+                for value in headers_cases:
+                    headers = (
+                        {"X-Kvtpu-Trace": value} if value is not None else {}
+                    )
+                    before = _carrier_errors()
+                    resp = await client.post(
+                        "/score_completions",
+                        json={"prompt": PROMPT, "model": TEST_MODEL_NAME},
+                        headers=headers,
+                    )
+                    assert resp.status == 200
+                    assert (await resp.json())["podScores"] == baseline
+                    if value is not None:
+                        # every non-absent case here is malformed → counted
+                        assert _carrier_errors() == before + 1
+                    else:
+                        assert _carrier_errors() == before
+
+                # A VALID carrier adopts: the served root carries the
+                # caller's id and still scores identically.
+                with obs.request("read.get_pod_scores") as caller:
+                    carrier = obs.current_carrier()
+                rec = obs.get_recorder()
+                rec.clear()
+                resp = await client.post(
+                    "/score_completions",
+                    json={"prompt": PROMPT, "model": TEST_MODEL_NAME},
+                    headers={"X-Kvtpu-Trace": carrier},
+                )
+                assert (await resp.json())["podScores"] == baseline
+                served = [
+                    t for t in rec.recent()
+                    if t.trace_id == caller.trace_id
+                ]
+                assert served, "served root did not adopt the carrier"
+
+                # Tracing fully off: same scores again.
+                obs.configure(ObsConfig(enabled=False))
+                resp = await client.post(
+                    "/score_completions",
+                    json={"prompt": PROMPT, "model": TEST_MODEL_NAME},
+                    headers={"X-Kvtpu-Trace": carrier},
+                )
+                assert (await resp.json())["podScores"] == baseline
+
+        try:
+            asyncio.run(run())
+        finally:
+            service.stop()
+
+    @pytest.mark.cluster
+    def test_grpc_malformed_metadata_never_fails(self):
+        import socket
+
+        from llm_d_kv_cache_manager_tpu.api.grpc_server import (
+            IndexerGrpcClient,
+            serve_grpc,
+        )
+
+        indexer = _make_indexer()
+        _seed_index(indexer, pod="pod-grpc")
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        server = serve_grpc(indexer, f"127.0.0.1:{port}")
+        try:
+            client = IndexerGrpcClient(f"127.0.0.1:{port}")
+            baseline = client.get_pod_scores_ex(PROMPT, TEST_MODEL_NAME)
+            for junk in ("kvtpu1-br0ken", "x", "kvtpu1----"):
+                before = _carrier_errors()
+                payload = client.get_pod_scores_ex(
+                    PROMPT, TEST_MODEL_NAME, carrier=junk
+                )
+                assert payload["scores"] == baseline["scores"]
+                assert "trace" not in payload  # nothing adopted → no ship
+                assert _carrier_errors() == before + 1
             client.close()
         finally:
             server.stop(grace=0)

@@ -1,0 +1,341 @@
+"""obs/slo.py: declarative objectives + multi-window burn-rate monitoring.
+
+Pins the ISSUE-13 SLO contracts: burn rates computed from REAL registry
+values (no parallel bookkeeping), the multi-window state machine
+(no_data → ok → warning → breaching), the fault-injected breach flip
+(admission sheds driving the shed-rate objective), the /slo/status and
+/readyz surfaces, and the bounded sample ring.
+"""
+
+import asyncio
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.metrics import collector as metrics
+from llm_d_kv_cache_manager_tpu.obs.slo import (
+    OBJECTIVE_HIT_RATE,
+    OBJECTIVE_READ_LATENCY,
+    OBJECTIVE_SHED_RATE,
+    SLO_OBJECTIVES,
+    SLO_WINDOWS,
+    SLOConfig,
+    SLOMonitor,
+    SLOObjective,
+    STATUS_BREACHING,
+    STATUS_NO_DATA,
+    STATUS_OK,
+    STATUS_WARNING,
+    default_objectives,
+)
+
+
+def _gauge(objective: str, window: str):
+    for metric in metrics.slo_burn_rate.collect():
+        for s in metric.samples:
+            if (
+                s.labels.get("objective") == objective
+                and s.labels.get("window") == window
+            ):
+                return s.value
+    return None
+
+
+def _monitor(counts, **cfg_kwargs):
+    """Monitor over one synthetic objective backed by a mutable
+    [bad, total] list, with an injected clock."""
+    now = [1000.0]
+    config = SLOConfig(**{
+        "fast_window_s": 60.0, "slow_window_s": 600.0, **cfg_kwargs,
+    })
+    objective = SLOObjective(
+        name=OBJECTIVE_SHED_RATE,  # label values must stay in-vocabulary
+        description="synthetic",
+        budget=0.01,
+        counts_fn=lambda: tuple(counts),
+    )
+    return SLOMonitor([objective], config, clock=lambda: now[0]), now
+
+
+class TestBurnMath:
+    def test_no_data_then_ok(self):
+        counts = [0.0, 0.0]
+        mon, now = _monitor(counts)
+        doc = mon.evaluate()
+        obj = doc["objectives"][OBJECTIVE_SHED_RATE]
+        assert obj["status"] == STATUS_NO_DATA
+        assert doc["status"] == STATUS_OK
+
+        counts[1] = 1000.0  # traffic arrives, all good
+        now[0] += 10
+        obj = mon.evaluate()["objectives"][OBJECTIVE_SHED_RATE]
+        assert obj["status"] == STATUS_OK
+        assert obj["windows"]["fast"]["burn_rate"] == 0.0
+
+    def test_burn_is_bad_fraction_over_budget(self):
+        counts = [0.0, 0.0]
+        mon, now = _monitor(counts)
+        mon.evaluate()
+        # 2% bad against a 1% budget → burn 2.0 in both windows.
+        counts[0] += 20.0
+        counts[1] += 1000.0
+        now[0] += 30
+        obj = mon.evaluate()["objectives"][OBJECTIVE_SHED_RATE]
+        assert obj["windows"]["fast"]["burn_rate"] == pytest.approx(2.0)
+        assert obj["windows"]["slow"]["burn_rate"] == pytest.approx(2.0)
+        # threshold is exclusive: burn == threshold is not a breach
+        assert obj["status"] == STATUS_OK
+
+    def test_warning_when_only_fast_window_burns(self):
+        counts = [0.0, 0.0]
+        mon, now = _monitor(counts)
+        mon.evaluate()
+        # A long clean history fills the slow window...
+        for _ in range(10):
+            counts[1] += 10_000.0
+            now[0] += 55
+            mon.evaluate()
+        # ...then a short spike: the fast window burns, the slow one is
+        # diluted by the clean history.
+        counts[0] += 400.0
+        counts[1] += 1000.0
+        now[0] += 30
+        obj = mon.evaluate()["objectives"][OBJECTIVE_SHED_RATE]
+        assert obj["windows"]["fast"]["burn_rate"] > 2.0
+        assert obj["windows"]["slow"]["burn_rate"] <= 2.0
+        assert obj["status"] == STATUS_WARNING
+
+    def test_breaching_needs_both_windows(self):
+        counts = [0.0, 0.0]
+        mon, now = _monitor(counts)
+        mon.evaluate()
+        counts[0] += 500.0
+        counts[1] += 1000.0
+        now[0] += 30
+        doc = mon.evaluate()
+        obj = doc["objectives"][OBJECTIVE_SHED_RATE]
+        # Young monitor: both windows clip to its lifetime → both burn.
+        assert obj["status"] == STATUS_BREACHING
+        assert doc["status"] == STATUS_BREACHING
+        assert OBJECTIVE_SHED_RATE in doc["breaching"]
+
+    def test_counters_before_monitor_birth_are_excluded(self):
+        counts = [5000.0, 10_000.0]  # ugly history predating the monitor
+        mon, now = _monitor(counts)
+        counts[1] += 1000.0  # clean traffic after birth
+        now[0] += 30
+        obj = mon.evaluate()["objectives"][OBJECTIVE_SHED_RATE]
+        assert obj["windows"]["fast"]["bad"] == 0.0
+        assert obj["status"] == STATUS_OK
+
+    def test_sample_ring_is_bounded(self):
+        counts = [0.0, 0.0]
+        mon, now = _monitor(counts, max_samples=16)
+        for _ in range(200):
+            counts[1] += 10.0
+            now[0] += 1.0
+            mon.evaluate()
+        assert len(mon._samples) <= 16  # noqa: SLF001 - bound under test
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            SLOConfig(fast_window_s=600, slow_window_s=60)
+        with pytest.raises(ValueError):
+            SLOConfig(burn_threshold=0)
+        with pytest.raises(ValueError):
+            SLOConfig(hit_rate_floor=1.5)
+        with pytest.raises(ValueError):
+            SLOMonitor(
+                default_objectives(SLOConfig())
+                + default_objectives(SLOConfig()),
+                SLOConfig(),
+            )
+
+
+class TestRegistryObjectives:
+    """The default objective set reads the LIVE registry: drive the real
+    counters and watch the burn."""
+
+    def test_shed_storm_flips_shed_rate_to_breaching(self):
+        metrics.register_metrics()
+        now = [5000.0]
+        cfg = SLOConfig(fast_window_s=60.0, slow_window_s=600.0)
+        mon = SLOMonitor(
+            default_objectives(cfg), cfg, clock=lambda: now[0]
+        )
+        doc = mon.evaluate()
+        assert doc["objectives"][OBJECTIVE_SHED_RATE]["status"] in (
+            STATUS_NO_DATA, STATUS_OK,
+        )
+        # Fault injection: the admission gate sheds a storm of requests
+        # (the counter the serving surfaces increment on 429 /
+        # RESOURCE_EXHAUSTED).
+        for _ in range(300):
+            metrics.count_admission_shed("queue_full")
+        now[0] += 30.0
+        doc = mon.evaluate()
+        obj = doc["objectives"][OBJECTIVE_SHED_RATE]
+        assert obj["status"] == STATUS_BREACHING
+        assert obj["windows"]["fast"]["bad"] == pytest.approx(300.0)
+        # Burn-rate gauges exported under the pinned vocabularies.
+        for window in SLO_WINDOWS:
+            value = _gauge(OBJECTIVE_SHED_RATE, window)
+            assert value is not None and value > cfg.burn_threshold
+
+    def test_hit_rate_objective_reads_zero_hit_lookups(self):
+        metrics.register_metrics()
+        now = [9000.0]
+        cfg = SLOConfig(fast_window_s=60.0, slow_window_s=600.0,
+                        hit_rate_floor=0.9)
+        mon = SLOMonitor(
+            default_objectives(cfg), cfg, clock=lambda: now[0]
+        )
+        # Every lookup misses: max-pod-hit-count observes 0.
+        for _ in range(50):
+            metrics.index_max_pod_hits.observe(0)
+        now[0] += 30.0
+        obj = mon.evaluate()["objectives"][OBJECTIVE_HIT_RATE]
+        assert obj["windows"]["fast"]["bad"] == pytest.approx(50.0)
+        assert obj["status"] == STATUS_BREACHING
+        # Now a healthy stretch: long hits dilute below the 10% budget.
+        for _ in range(5000):
+            metrics.index_max_pod_hits.observe(32)
+        now[0] += 10.0
+        obj = mon.evaluate()["objectives"][OBJECTIVE_HIT_RATE]
+        assert obj["windows"]["fast"]["burn_rate"] < 1.0
+
+    def test_read_latency_objective_reads_stage_histogram(self):
+        metrics.register_metrics()
+        now = [12_000.0]
+        cfg = SLOConfig(fast_window_s=60.0, slow_window_s=600.0,
+                        read_p99_ms=5.0)
+        mon = SLOMonitor(
+            default_objectives(cfg), cfg, clock=lambda: now[0]
+        )
+        child = metrics.stage_latency.labels(
+            plane="read", stage="get_pod_scores"
+        )
+        for _ in range(100):
+            child.observe(0.001)  # fast
+        for _ in range(100):
+            child.observe(0.5)    # way past 5ms
+        now[0] += 30.0
+        obj = mon.evaluate()["objectives"][OBJECTIVE_READ_LATENCY]
+        assert obj["windows"]["fast"]["total"] == pytest.approx(200.0)
+        assert obj["windows"]["fast"]["bad"] == pytest.approx(100.0)
+        assert obj["status"] == STATUS_BREACHING
+
+    def test_reader_failure_never_raises(self):
+        def broken():
+            raise RuntimeError("registry on fire")
+
+        mon = SLOMonitor(
+            [SLOObjective(
+                name=OBJECTIVE_READ_LATENCY, description="broken",
+                budget=0.01, counts_fn=broken,
+            )],
+            SLOConfig(fast_window_s=60, slow_window_s=600),
+        )
+        doc = mon.evaluate()
+        assert doc["objectives"][OBJECTIVE_READ_LATENCY]["status"] == (
+            STATUS_NO_DATA
+        )
+
+
+class TestSloHttpSurface:
+    def _service(self, env=None):
+        from tests.conftest import TEST_MODEL_NAME, TEST_TOKENIZER_JSON
+        from llm_d_kv_cache_manager_tpu.api.http_service import ScoringService
+        from llm_d_kv_cache_manager_tpu.kvcache.indexer import (
+            Indexer,
+            IndexerConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+            TokenProcessorConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+            TokenizationPool,
+            TokenizersPoolConfig,
+        )
+
+        indexer = Indexer(
+            config=IndexerConfig(
+                token_processor_config=TokenProcessorConfig(block_size=4),
+            ),
+            tokenization_pool=TokenizationPool(
+                TokenizersPoolConfig(
+                    workers=2,
+                    local_tokenizer_files={
+                        TEST_MODEL_NAME: TEST_TOKENIZER_JSON
+                    },
+                ),
+            ),
+        )
+        indexer.run()
+        return ScoringService(env=env if env is not None else {},
+                              indexer=indexer)
+
+    def test_slo_status_and_readyz_section(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        metrics.register_metrics()
+        service = self._service()
+        assert service.slo is not None  # on by default
+
+        async def run():
+            async with TestClient(TestServer(service.make_app())) as client:
+                resp = await client.get("/slo/status")
+                assert resp.status == 200
+                doc = await resp.json()
+                assert set(doc["objectives"]) == set(SLO_OBJECTIVES)
+                for obj in doc["objectives"].values():
+                    assert set(obj["windows"]) == set(SLO_WINDOWS)
+
+                # Shed storm → the endpoint reports the breach (real
+                # registry values, the service's own monitor).
+                for _ in range(500):
+                    metrics.count_admission_shed("timeout")
+                resp = await client.get("/slo/status")
+                doc = await resp.json()
+                assert OBJECTIVE_SHED_RATE in doc["breaching"]
+
+                # /readyz embeds the same document under `slo` and stays
+                # 200/503 on event-plane grounds alone: a breach is an
+                # alert, not unreadiness.
+                service.start(with_subscriber=False)
+                resp = await client.get("/readyz")
+                data = await resp.json()
+                assert data["slo"] is not None
+                assert data["slo"]["objectives"][OBJECTIVE_SHED_RATE][
+                    "status"
+                ] in (STATUS_BREACHING, STATUS_WARNING, STATUS_OK)
+                assert resp.status == 200
+
+        try:
+            asyncio.run(run())
+        finally:
+            service.stop()
+
+    def test_slo_disabled_is_400_and_absent_from_readyz(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from llm_d_kv_cache_manager_tpu.api.http_service import (
+            config_from_env,
+        )
+
+        env = config_from_env()  # SLO=0 path through the real env plumbing
+        env["slo"] = False
+        service = self._service(env=env)
+        assert service.slo is None
+
+        async def run():
+            async with TestClient(TestServer(service.make_app())) as client:
+                resp = await client.get("/slo/status")
+                assert resp.status == 400
+                service.start(with_subscriber=False)
+                resp = await client.get("/readyz")
+                assert (await resp.json())["slo"] is None
+
+        try:
+            asyncio.run(run())
+        finally:
+            service.stop()
