@@ -2,10 +2,11 @@
 # build/test/bench/lint/image-build/image-push + pre-commit install —
 # /root/reference/Makefile, /root/reference/hooks/pre-commit.sh).
 
-.PHONY: native kvtransfer test bench bench-micro bench-read bench-obs \
-	bench-batch bench-faults bench-replication bench-placement \
-	bench-anticipate bench-autoscale bench-geo bench-transfer clean \
-	proto lint precommit-install image-build image-push
+.PHONY: native native-asan kvtransfer test bench bench-micro bench-read \
+	bench-obs bench-batch bench-faults bench-chaos bench-replication \
+	bench-placement bench-anticipate bench-autoscale bench-geo \
+	bench-transfer clean proto lint precommit-install image-build \
+	image-push
 
 # Container image coordinates (override per environment/registry). The
 # release workflow (.github/workflows/ci-release.yaml) builds the same
@@ -36,6 +37,31 @@ native:
 # a visible reason until this has run.
 kvtransfer:
 	cd kv_connectors/cpp && $(MAKE)
+
+# Sanitizer pass over the native code that touches raw buffers: builds the
+# C hash core and the transfer engine with -fsanitize=address,undefined
+# and runs the native/transfer test subset (wire fuzz included) under
+# them. The ASan runtime must be preloaded into the Python process for a
+# sanitized .so to load; leak detection is off (CPython itself "leaks" at
+# interpreter exit by design). The subset is the socket/hashing tests —
+# JAX device compute is pathologically slow under ASan and adds no
+# coverage of the raw-buffer code under test. The clean (unsanitized)
+# hash core is rebuilt afterwards whatever the test outcome, so this
+# target never leaves a sanitized .so in the package dir.
+native-asan:
+	cd kv_connectors/cpp && $(MAKE) asan
+	cd native && CFLAGS="-fsanitize=address,undefined -g" \
+		python setup.py build_ext
+	status=0; ASAN_OPTIONS=detect_leaks=0 \
+	KVTPU_TRANSFER_LIB=$(PWD)/kv_connectors/cpp/libkvtransfer-asan.so \
+	LD_PRELOAD=$$($(CXX) -print-file-name=libasan.so) \
+	JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+		tests/test_transfer_wire_fuzz.py tests/test_transfer_chaos.py \
+		tests/test_hash_differential.py \
+		"tests/test_kv_connectors.py::TestTransferEngine" \
+		|| status=$$?; \
+	cd native && python setup.py build_ext >/dev/null 2>&1; \
+	exit $$status
 
 test: native
 	python -m pytest tests/ -q
@@ -91,6 +117,14 @@ bench-batch: native
 # Headless; rewrites benchmarking/FLEET_BENCH_FAULTS.json.
 bench-faults:
 	JAX_PLATFORMS=cpu python bench.py --faults
+
+# Transfer-plane chaos scenario (kv_connectors/faults.py): per-peer
+# corrupt/stall transfer faults over the two-tier round-robin replay —
+# end-to-end integrity vs the v1 wire, per-peer breakers vs bare
+# timeouts, half-open recovery. Headless; rewrites
+# benchmarking/FLEET_BENCH_CHAOS.json.
+bench-chaos: kvtransfer
+	JAX_PLATFORMS=cpu python bench.py --chaos
 
 # Indexer kill-and-restart scenario (cluster/): the index service dies
 # mid-ShareGPT-replay; cold restart vs snapshot + seq-tail-replay restore.

@@ -273,6 +273,7 @@ class FleetSim:
         routing_policy=None,
         membership=None,
         verify_cluster_scores: bool = False,
+        transfer_faults=None,
     ):
         self.strategy = strategy
         # Fleet size is a RUNTIME quantity now (--autoscale grows it with
@@ -671,6 +672,75 @@ class FleetSim:
                 pod.set_peer_resolver(IndexBackedPeerResolver(
                     self.indexer.kv_block_index, MODEL, addrs, f"pod-{i}",
                 ))
+        # Transfer-plane chaos (--chaos; kv_connectors/faults.py): every
+        # pod's pooled TransferClient is re-clocked onto the sim clock and
+        # wrapped in a FaultyTransport applying the per-peer plan
+        # (corrupt / stall / blackhole / flap). Synthetic fetch latencies
+        # (the timeout ladders a real flaky peer would cost) accumulate in
+        # the wrappers and are drained into each request's prefill clock
+        # by serve() — so breaker-capped tail latency is a sim-time
+        # quantity, deterministic and replayable.
+        self.faulty = {}
+        self.transfer_fault_plan = None
+        self.breaker_transitions = []  # (sim_t, peer, old, new)
+        if transfer_faults is not None:
+            from llm_d_kv_cache_manager_tpu.kv_connectors import faults as tf
+
+            assert host_tier, "--chaos needs the transfer plane (host_tier)"
+            cfg = dict(transfer_faults)
+            pod_faults = cfg.get("pods", {})
+            plan = tf.TransferFaultPlan(
+                seed=int(cfg.get("seed", seed)),
+                peers={
+                    self._addrs[pod_id]: f for pod_id, f in pod_faults.items()
+                },
+            )
+            self.transfer_fault_plan = plan
+            verify = bool(cfg.get("verify_integrity", True))
+            breaker = cfg.get("breaker")  # None -> breakers disabled
+
+            def make_on_transition(observer: str):
+                # Each pod's client keeps its OWN per-peer breakers (a
+                # client-side failure memory); the observer identity makes
+                # the fleet's transition log readable.
+                def on_transition(peer, old, new):
+                    self.breaker_transitions.append(
+                        (self.now, observer, peer, old, new)
+                    )
+                    if self.health is not None:
+                        self.health.observe_transfer_breaker(peer, old, new)
+
+                return on_transition
+
+            for i, pod in enumerate(self.pods):
+                client = pod.connector.client
+                client.clock = lambda: self.now
+                client.on_breaker_transition = make_on_transition(f"pod-{i}")
+                # Short, sim-scaled timeout ladder: what one fetch to a
+                # dark peer costs before the client gives up.
+                client.config.io_timeout_ms = int(
+                    cfg.get("io_timeout_ms", 1000)
+                )
+                client.config.connect_timeout_ms = int(
+                    cfg.get("connect_timeout_ms", 500)
+                )
+                client.config.retries = int(cfg.get("retries", 0))
+                if breaker:
+                    client.config.breaker_failure_threshold = int(
+                        breaker.get("failure_threshold", 3)
+                    )
+                    client.config.breaker_cooldown_s = float(
+                        breaker.get("cooldown_s", 4.0)
+                    )
+                else:
+                    client.config.breaker_failure_threshold = 0  # disabled
+                wrapper = tf.FaultyTransport(
+                    client, plan, clock=lambda: self.now,
+                    self_addr=self._addrs[f"pod-{i}"],
+                    verify_integrity=verify,
+                )
+                pod.connector.client = wrapper
+                self.faulty[i] = wrapper
         self.pod_free_at = [0.0] * self.n_pods
         self.rr_counter = 0
         self.last_pod_idx = 0
@@ -1315,6 +1385,7 @@ class FleetSim:
                     + self.alpha * len(tokens)
                     + self.gamma * restored * PAGE_SIZE
                     + self.delta * onboarded * PAGE_SIZE
+                    + self._take_fault_charge(pod_idx)
                 )
                 self.pod_free_at[pod_idx] = start + prefill_s + requeue_s
                 return (start - arrival) + prefill_s
@@ -1327,6 +1398,7 @@ class FleetSim:
             + self.alpha * uncached
             + self.gamma * restored * PAGE_SIZE
             + self.delta * onboarded * PAGE_SIZE
+            + self._take_fault_charge(pod_idx)
         )
         start = max(arrival, self.pod_free_at[pod_idx])
         ttft = (start - arrival) + prefill_s
@@ -1347,6 +1419,15 @@ class FleetSim:
         for rpool in self.replica_pools:
             rpool.drain()
         return ttft
+
+    def _take_fault_charge(self, pod_idx: int) -> float:
+        """Drain the synthetic fetch latency the chaos injector charged
+        this pod since the last request (timeout ladders paid to dark
+        peers; 0.0 outside --chaos runs — the healthy path adds nothing)."""
+        if not self.faulty:
+            return 0.0
+        wrapper = self.faulty.get(pod_idx)
+        return wrapper.take_charge() if wrapper is not None else 0.0
 
     # -- proactive replication executor (--placement) --------------------
 
@@ -1883,6 +1964,366 @@ def main_faults(args):
         .get("latency_s"),
         "hit_rate_retention": stats["hit_rate_retention"],
         "source": "benchmarking/FLEET_BENCH_FAULTS.json",
+    }))
+
+
+# Transfer-plane chaos scenario (--chaos; kv_connectors/faults.py +
+# connector.py hardening): the highest-DCN-traffic committed configuration
+# (cache-oblivious round-robin routing over the two-tier fleet, where pods
+# constantly onboard prefixes they never computed from peers) replayed
+# under per-peer transfer faults:
+#   no_fault              integrity + breakers ON, zero faults — must stay
+#                         bit-identical to the committed FLEET_BENCH.json
+#                         two-tier round-robin row (the healthy-fleet
+#                         bit-identity acceptance, checked in-artifact).
+#   corrupt_integrity_on  one peer ships corrupt blocks; every corruption
+#                         is DETECTED (checksum seam), degrades to a
+#                         fallback/recompute, ZERO corrupted blocks land.
+#   corrupt_integrity_off the v1-wire control: same damage sails through
+#                         and LANDS — the silent wrong-model-output
+#                         failure mode the end-to-end checksum kills.
+#   stall_no_breaker      one peer stalls mid-run; every fetch to it pays
+#                         the full timeout ladder for the whole window.
+#   stall_breaker         same stall with per-peer breakers: after
+#                         `failure_threshold` consecutive timeouts the
+#                         breaker opens and fetches skip instantly;
+#                         half-open probes re-close it once the stall
+#                         clears (recovery is part of the arm's evidence).
+CHAOS_CORRUPT_POD = "pod-3"
+CHAOS_CORRUPT_RATE = 0.5
+CHAOS_STALL_POD = "pod-2"
+CHAOS_STALL_FROM_S = 4.0
+CHAOS_STALL_UNTIL_S = 12.0
+CHAOS_IO_TIMEOUT_MS = 1000
+CHAOS_CONNECT_TIMEOUT_MS = 500
+CHAOS_RETRIES = 0
+CHAOS_BREAKER_THRESHOLD = 3
+# Longer than the stall's remainder past detection: the half-open probes
+# (which pay a full ladder against a still-dark peer) land after the
+# stall clears, so the first probe SUCCEEDS and re-closes the breaker —
+# the recovery leg of the arm's evidence.
+CHAOS_BREAKER_COOLDOWN_S = 8.0
+
+
+def run_chaos_arm(pod_faults, breaker: bool, verify_integrity: bool,
+                  qps: float = QPS, chaos_stack: bool = True):
+    """One round-robin two-tier replay of the chat workload under a
+    per-peer transfer fault plan, in the winning-regime model class (the
+    wide-MQA int8-KV constants where the transfer-vs-recompute gate
+    ADMITS peer onboards — the dense-model constants gate the data plane
+    shut, which would hide every fault). Returns TTFTs, hit rate, and the
+    chaos bookkeeping (injector counters, fetch log, breaker
+    transitions). `chaos_stack=False` runs the identical configuration
+    with NO wrapper/breaker/injector at all — the bit-identity control."""
+    alpha_w, gamma_w, delta_w, _src = _winning_regime_constants()
+    requests, conversations, rng = build_workload(qps=qps)
+    sim = FleetSim(
+        "round_robin",
+        pages_per_pod=TWO_TIER_PAGES_PER_POD,
+        host_tier=True,
+        alpha=alpha_w, gamma=gamma_w, delta=delta_w,
+        transfer_faults=(
+            {
+                "pods": pod_faults,
+                "verify_integrity": verify_integrity,
+                "breaker": (
+                    {
+                        "failure_threshold": CHAOS_BREAKER_THRESHOLD,
+                        "cooldown_s": CHAOS_BREAKER_COOLDOWN_S,
+                    }
+                    if breaker else None
+                ),
+                "io_timeout_ms": CHAOS_IO_TIMEOUT_MS,
+                "connect_timeout_ms": CHAOS_CONNECT_TIMEOUT_MS,
+                "retries": CHAOS_RETRIES,
+            }
+            if chaos_stack else None
+        ),
+    )
+    # Order-independent peer choice for EVERY chaos arm (baseline
+    # included): per-key index entry order races with the event pool's
+    # concurrent workers, and the default first-entry primary would make
+    # "which peer serves this block" — and therefore which blocks meet
+    # the corrupt peer — run-to-run noise. Rendezvous-ranked holders are
+    # a pure function of (chunk, pod), so the whole scenario replays
+    # bit-for-bit.
+    for pod in sim.pods:
+        pod.tier_store.peer_resolver.rendezvous_primary = True
+    ttfts = []
+    try:
+        for arrival, conv_id in requests:
+            question = _text(rng, QUESTION_WORDS)
+            prompt = conversations[conv_id] + " [user] " + question
+            ttfts.append(sim.serve(arrival, prompt))
+            conversations[conv_id] = (
+                prompt + " [assistant] " + _text(rng, RESPONSE_WORDS)
+            )
+        hit_rate = sim.hit_tokens / max(sim.total_tokens, 1)
+        injected = {}
+        client_stats = {}
+        fetch_log = []
+        # Address -> pod name, for readable logs/windows.
+        addr_names = {
+            f"{h}:{p}": pod for pod, (h, p) in (sim._addrs or {}).items()
+        }
+        for pod_idx, wrapper in sim.faulty.items():
+            for k, v in wrapper.counters.items():
+                injected[k] = injected.get(k, 0) + v
+            for k, v in wrapper.stats.items():
+                client_stats[k] = client_stats.get(k, 0) + v
+            fetch_log.extend(
+                (t, f"pod-{pod_idx}", addr_names.get(peer, peer), lat, kind)
+                for t, peer, lat, kind in wrapper.fetch_log
+            )
+        fetch_log.sort()
+        return {
+            "ttfts": ttfts,
+            "hit_rate": hit_rate,
+            "restored_blocks": sim.restored_blocks,
+            "onboarded_blocks": sim.onboarded_blocks,
+            "injected": injected,
+            "client_stats": client_stats,
+            "fetch_log": fetch_log,
+            # Unrounded: the stall-window arithmetic compares these against
+            # full-precision fetch timestamps; main_chaos rounds for the
+            # artifact only.
+            "breaker_transitions": [
+                (t, observer, addr_names.get(peer, peer), old, new)
+                for t, observer, peer, old, new in sim.breaker_transitions
+            ],
+            "health": (
+                sim.health.transfer_breaker_summary()
+                if sim.health is not None else None
+            ),
+        }
+    finally:
+        sim.shutdown()
+
+
+def _chaos_fetch_p99(arm, pod: str, open_times, t_until: float):
+    """p99 of per-fetch latencies charged against `pod`, taken per
+    OBSERVER: each fetching pod's fetches count from the moment ITS
+    breaker for `pod` opened (`open_times`: observer -> open_t) until
+    `t_until`. Breakers are client-side failure memory — "after the
+    breaker opens" is only meaningful per observer; a fleet-wide window
+    would keep counting other pods' bounded detection ladders as tail
+    latency the breaker never promised to remove."""
+    # Strictly after the open: sim time is frozen within one request, so
+    # the detection ladders that OPENED the breaker share its timestamp —
+    # they are the (separately reported) detection cost, not post-open
+    # tail. The control arm gets the same strict cutoffs, symmetrically.
+    lats = sorted(
+        lat for t, observer, peer, lat, _kind in arm["fetch_log"]
+        if peer == pod
+        and observer in open_times
+        and open_times[observer] < t < t_until
+    )
+    if not lats:
+        return None, 0
+    return lats[min(int(len(lats) * 0.99), len(lats) - 1)], len(lats)
+
+
+def _chaos_arm_stats(arm):
+    return {
+        "ttft_p50_s": round(p50(arm["ttfts"]), 4),
+        "ttft_p90_s": round(p90(arm["ttfts"]), 4),
+        "prefix_hit_rate": round(arm["hit_rate"], 4),
+        "restored_blocks": arm["restored_blocks"],
+        "onboarded_blocks": arm["onboarded_blocks"],
+        "injected": arm["injected"],
+        "hedges": arm["client_stats"].get("hedges", 0),
+        "hedge_wins": arm["client_stats"].get("hedge_wins", 0),
+        "corrupt_blocks_detected": arm["client_stats"].get(
+            "corrupt_blocks", 0
+        ),
+        "breaker_skipped_blocks": arm["client_stats"].get(
+            "breaker_skipped_blocks", 0
+        ),
+        "transfer_failures": arm["client_stats"].get("failures", 0),
+    }
+
+
+def main_chaos(args):
+    from llm_d_kv_cache_manager_tpu.kv_connectors.faults import (
+        PeerTransferFaults,
+    )
+
+    t_start = time.time()
+    corrupt_faults = {
+        CHAOS_CORRUPT_POD: PeerTransferFaults(
+            corrupt_rate=CHAOS_CORRUPT_RATE
+        ),
+    }
+    stall_faults = {
+        CHAOS_STALL_POD: PeerTransferFaults(
+            stall_from_s=CHAOS_STALL_FROM_S,
+            stall_until_s=CHAOS_STALL_UNTIL_S,
+        ),
+    }
+
+    baseline_plain = run_chaos_arm(
+        {}, breaker=True, verify_integrity=True, chaos_stack=False
+    )
+    no_fault = run_chaos_arm({}, breaker=True, verify_integrity=True)
+    corrupt_on = run_chaos_arm(
+        corrupt_faults, breaker=True, verify_integrity=True
+    )
+    corrupt_off = run_chaos_arm(
+        corrupt_faults, breaker=True, verify_integrity=False
+    )
+    stall_nb = run_chaos_arm(
+        stall_faults, breaker=False, verify_integrity=True
+    )
+    stall_b = run_chaos_arm(
+        stall_faults, breaker=True, verify_integrity=True
+    )
+
+    # Stall tail latency AFTER the breaker opened. Breakers are
+    # CLIENT-side failure memory — every fetching pod keeps its own for
+    # the stalled peer and pays its own bounded detection cost
+    # (threshold x timeout ladder) before opening — so the measurement is
+    # per OBSERVER: each pod's fetches to the stalled peer count from the
+    # moment its own breaker opened. The no-breaker control arm gets the
+    # SAME per-observer cutoffs (the faults-bench precedent), so its p99
+    # reads "what those same fetches would have cost without breakers".
+    # The detection cost the breaker arm DID pay is reported alongside
+    # (detection_fetches = full-ladder fetches before each open).
+    open_times = {}
+    for t, observer, peer, old, new in stall_b["breaker_transitions"]:
+        if (
+            peer == CHAOS_STALL_POD and new == "open" and old == "closed"
+            and observer not in open_times
+        ):
+            open_times[observer] = t
+    stall_window = {}
+    if open_times:
+        p99_b, n_b = _chaos_fetch_p99(
+            stall_b, CHAOS_STALL_POD, open_times, CHAOS_STALL_UNTIL_S
+        )
+        p99_nb, n_nb = _chaos_fetch_p99(
+            stall_nb, CHAOS_STALL_POD, open_times, CHAOS_STALL_UNTIL_S
+        )
+        stall_window = {
+            "first_open_at_s": round(min(open_times.values()), 3),
+            "last_open_at_s": round(max(open_times.values()), 3),
+            "observers_opened": len(open_times),
+            "detection_fetches": stall_b["injected"].get(
+                "stalled_fetches", 0
+            ),
+            "window_until_s": CHAOS_STALL_UNTIL_S,
+            "fetches_with_breaker": n_b,
+            "fetches_no_breaker": n_nb,
+            "p99_fetch_s_with_breaker": (
+                round(p99_b, 4) if p99_b is not None else None
+            ),
+            "p99_fetch_s_no_breaker": (
+                round(p99_nb, 4) if p99_nb is not None else None
+            ),
+            "p99_ratio": (
+                round(p99_b / p99_nb, 4)
+                if p99_b is not None and p99_nb else None
+            ),
+        }
+    # Half-open recovery after the stall clears: the breaker must have
+    # re-closed (a probe succeeded against the recovered peer).
+    reclosed = any(
+        peer == CHAOS_STALL_POD and new == "closed"
+        and t > CHAOS_STALL_UNTIL_S
+        for t, _obs, peer, _old, new in stall_b["breaker_transitions"]
+    )
+
+    arms = {
+        "no_fault": _chaos_arm_stats(no_fault),
+        "corrupt_integrity_on": _chaos_arm_stats(corrupt_on),
+        "corrupt_integrity_off": _chaos_arm_stats(corrupt_off),
+        "stall_no_breaker": _chaos_arm_stats(stall_nb),
+        "stall_breaker": _chaos_arm_stats(stall_b),
+    }
+    arms["stall_breaker"]["breaker_transitions"] = [
+        (round(t, 3), observer, peer, old, new)
+        for t, observer, peer, old, new in stall_b["breaker_transitions"]
+    ]
+    arms["stall_breaker"]["transfer_breaker_recovered"] = reclosed
+
+    nf, con = arms["no_fault"], arms["corrupt_integrity_on"]
+    stats = {
+        "config": {
+            "workload": (
+                "synthetic chat (build_workload), round-robin routing over "
+                "the two-tier fleet in the winning-regime model class "
+                "(wide-MQA int8-KV constants — the gate ADMITS peer "
+                "onboards; the dense-model constants gate the data plane "
+                "shut and would hide every fault). Cache-oblivious routing "
+                "maximizes peer-onboard traffic, the plane under test."
+            ),
+            "requests": len(no_fault["ttfts"]),
+            "qps": QPS,
+            "n_pods": N_PODS,
+            "pages_per_pod": TWO_TIER_PAGES_PER_POD,
+            "seed": args.seed,
+            "corrupt_pod": CHAOS_CORRUPT_POD,
+            "corrupt_rate": CHAOS_CORRUPT_RATE,
+            "stall_pod": CHAOS_STALL_POD,
+            "stall_window_s": [CHAOS_STALL_FROM_S, CHAOS_STALL_UNTIL_S],
+            "io_timeout_ms": CHAOS_IO_TIMEOUT_MS,
+            "retries": CHAOS_RETRIES,
+            "breaker": {
+                "failure_threshold": CHAOS_BREAKER_THRESHOLD,
+                "cooldown_s": CHAOS_BREAKER_COOLDOWN_S,
+            },
+        },
+        "arms": arms,
+        # The headline robustness verdicts.
+        "corrupt_blocks_admitted_with_integrity": corrupt_on["injected"].get(
+            "corrupt_admitted", 0
+        ),
+        "corrupt_blocks_detected": corrupt_on["injected"].get(
+            "corrupt_detected", 0
+        ),
+        "corrupt_blocks_admitted_without_integrity": corrupt_off[
+            "injected"
+        ].get("corrupt_admitted", 0),
+        "hit_rate_retention_corrupt": round(
+            con["prefix_hit_rate"] / max(nf["prefix_hit_rate"], 1e-9), 4
+        ),
+        "stall_tail_latency": stall_window,
+        "wall_s": round(time.time() - t_start, 1),
+    }
+    # Healthy-fleet bit-identity: the no-fault arm (integrity verification,
+    # breakers, and the fault wrapper all ACTIVE — just zero faults) must
+    # reproduce the IDENTICAL run with no chaos stack at all, TTFT stream
+    # and hit rate bit-for-bit — hardening a healthy fleet costs nothing.
+    stats["healthy_bit_identity"] = {
+        "ttft_stream_identical": (
+            no_fault["ttfts"] == baseline_plain["ttfts"]
+        ),
+        "hit_rate_identical": (
+            no_fault["hit_rate"] == baseline_plain["hit_rate"]
+        ),
+        "onboards_identical": (
+            no_fault["onboarded_blocks"] == baseline_plain["onboarded_blocks"]
+            and no_fault["restored_blocks"]
+            == baseline_plain["restored_blocks"]
+        ),
+    }
+    print(json.dumps(stats), file=sys.stderr)
+    artifact = {k: v for k, v in stats.items() if k != "wall_s"}
+    out = os.path.join(REPO, "benchmarking", "FLEET_BENCH_CHAOS.json")
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({
+        "metric": "chaos_corrupt_blocks_admitted",
+        "value": stats["corrupt_blocks_admitted_with_integrity"],
+        "unit": "blocks",
+        "corrupt_detected": stats["corrupt_blocks_detected"],
+        "corrupt_admitted_without_integrity": stats[
+            "corrupt_blocks_admitted_without_integrity"
+        ],
+        "hit_rate_retention_corrupt": stats["hit_rate_retention_corrupt"],
+        "stall_p99_ratio": stall_window.get("p99_ratio"),
+        "breaker_recovered_after_stall": reclosed,
+        "source": "benchmarking/FLEET_BENCH_CHAOS.json",
     }))
 
 
@@ -4129,6 +4570,14 @@ def parse_args(argv=None):
              "workload and write benchmarking/FLEET_BENCH_FAULTS.json",
     )
     ap.add_argument(
+        "--chaos", action="store_true",
+        help="run the transfer-plane chaos scenario (kv_connectors/faults."
+             "py): per-peer corrupt/stall faults over the two-tier "
+             "round-robin replay — end-to-end integrity vs the v1 wire, "
+             "breakers vs bare timeouts — writing "
+             "benchmarking/FLEET_BENCH_CHAOS.json",
+    )
+    ap.add_argument(
         "--placement", action="store_true",
         help="run the multi-tenant hotspot scenario (placement/ "
              "subsystem): Zipf tenant mix over per-tenant LoRA-isolated "
@@ -4199,6 +4648,8 @@ if __name__ == "__main__":
         main_cluster_check(_args)
     elif _args.replication:
         main_replication(_args)
+    elif _args.chaos:
+        main_chaos(_args)
     elif _args.faults:
         main_faults(_args)
     elif _args.workload == "sharegpt":

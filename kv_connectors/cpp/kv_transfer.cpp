@@ -20,9 +20,25 @@
 //   multi-block response:  u32 magic, then per block in request order:
 //                          u8 status, u64 length, payload
 //
+//   checksummed multi-block (v2) request:
+//                          u32 magic 'KVTC', u32 count, count x u64 hashes
+//   checksummed multi-block (v2) response:
+//                          u32 magic, then per block in request order:
+//                          u8 status, u64 length, u64 checksum, payload
+//
 // The multi-block form is the DCN leg's unit of transfer: one round trip
 // moves a whole chain instead of N, and the server assembles the response
 // with scatter-gather writev (headers + payload buffers, zero re-copy).
+//
+// End-to-end integrity (v2): the per-block checksum is FNV-1a 64 over the
+// payload bytes, computed ONCE when the block is registered
+// (kvt_server_put) — not at send time — so corruption anywhere between
+// registration and receipt (server RAM, NIC, wire) fails verification at
+// the client, which reports the block as -4 "corrupt" instead of landing
+// wrong KV bytes into HBM. Both the put-time hash and the receive-side
+// verify run without the GIL (ctypes releases it for the whole call). The
+// v1 'KVTM' frame stays accepted for mixed-version peers; it simply
+// carries no checksum.
 //
 // Exposed as a C ABI for ctypes (no pybind11 in this image).
 
@@ -49,16 +65,37 @@
 
 namespace {
 
-constexpr uint32_t kMagic = 0x4B565442;       // 'KVTB' (single block)
-constexpr uint32_t kMagicMulti = 0x4B56544D;  // 'KVTM' (multi block)
+constexpr uint32_t kMagic = 0x4B565442;        // 'KVTB' (single block)
+constexpr uint32_t kMagicMulti = 0x4B56544D;   // 'KVTM' (multi block, v1)
+constexpr uint32_t kMagicMulti2 = 0x4B565443;  // 'KVTC' (multi block, v2:
+                                               // per-block checksum)
 // Per-request block-count bound: a corrupt/hostile count must not drive a
 // multi-GB allocation. 1<<16 blocks x 4MB pages is already ~256GB of
 // payload — far beyond one request's plausible chain.
 constexpr uint32_t kMaxBlocksPerRequest = 1u << 16;
 
+// FNV-1a 64 — the repo's canonical integrity/sharding hash family
+// (kvblock/hashing.py, native/fnvcbor.c). One pass over the payload.
+uint64_t fnv1a64(const uint8_t* data, uint64_t len) {
+  uint64_t h = 0xcbf29ce484222325ull;
+  for (uint64_t i = 0; i < len; i++) {
+    h ^= data[i];
+    h *= 0x100000001b3ull;
+  }
+  return h;
+}
+
+struct Blob {
+  std::vector<uint8_t> data;
+  // Put-time FNV-1a 64 of `data` — the end-to-end integrity anchor. NOT
+  // recomputed at send time: a bit-flip in server RAM after registration
+  // must fail verification at the client, not be re-blessed on the wire.
+  uint64_t checksum = 0;
+};
+
 struct BlockStore {
   std::mutex mu;
-  std::unordered_map<uint64_t, std::vector<uint8_t>> blocks;
+  std::unordered_map<uint64_t, Blob> blocks;
 };
 
 struct Server {
@@ -131,8 +168,10 @@ bool writev_all(int fd, std::vector<iovec>& iov) {
 
 // One multi-block request: count + hashes in, headers + payloads out via a
 // single scatter-gather writev (header bytes packed per block; payload
-// buffers referenced in place — no reassembly copy).
-bool serve_multi(Server* server, int fd) {
+// buffers referenced in place — no reassembly copy). `with_checksum`
+// selects the v2 header layout (u8 status + u64 length + u64 put-time
+// checksum) and the v2 response magic.
+bool serve_multi(Server* server, int fd, bool with_checksum) {
   uint32_t count = 0;
   if (!read_exact(fd, &count, 4) || count == 0 ||
       count > kMaxBlocksPerRequest)
@@ -140,28 +179,33 @@ bool serve_multi(Server* server, int fd) {
   std::vector<uint64_t> hashes(count);
   if (!read_exact(fd, hashes.data(), 8ull * count)) return false;
 
+  size_t hdr = with_checksum ? 17 : 9;
   std::vector<std::vector<uint8_t>> payloads(count);
-  std::vector<uint8_t> headers(9ull * count);  // u8 status + u64 length
+  std::vector<uint8_t> headers(hdr * count);
   {
     std::lock_guard<std::mutex> lock(server->store.mu);
     for (uint32_t i = 0; i < count; i++) {
       auto it = server->store.blocks.find(hashes[i]);
       uint8_t status = 1;
       uint64_t length = 0;
+      uint64_t checksum = 0;
       if (it != server->store.blocks.end()) {
-        payloads[i] = it->second;  // copy out under lock
+        payloads[i] = it->second.data;  // copy out under lock
         status = 0;
         length = payloads[i].size();
+        checksum = it->second.checksum;
       }
-      headers[9ull * i] = status;
-      std::memcpy(&headers[9ull * i + 1], &length, 8);
+      headers[hdr * i] = status;
+      std::memcpy(&headers[hdr * i + 1], &length, 8);
+      if (with_checksum) std::memcpy(&headers[hdr * i + 9], &checksum, 8);
     }
   }
+  const uint32_t* magic = with_checksum ? &kMagicMulti2 : &kMagicMulti;
   std::vector<iovec> iov;
   iov.reserve(1 + 2ull * count);
-  iov.push_back({const_cast<uint32_t*>(&kMagicMulti), 4});
+  iov.push_back({const_cast<uint32_t*>(magic), 4});
   for (uint32_t i = 0; i < count; i++) {
-    iov.push_back({&headers[9ull * i], 9});
+    iov.push_back({&headers[hdr * i], hdr});
     if (!payloads[i].empty())
       iov.push_back({payloads[i].data(), payloads[i].size()});
   }
@@ -172,8 +216,8 @@ void serve_conn(Server* server, int fd) {
   for (;;) {
     uint32_t magic = 0;
     if (!read_exact(fd, &magic, 4)) break;
-    if (magic == kMagicMulti) {
-      if (!serve_multi(server, fd)) break;
+    if (magic == kMagicMulti || magic == kMagicMulti2) {
+      if (!serve_multi(server, fd, magic == kMagicMulti2)) break;
       continue;
     }
     if (magic != kMagic) break;
@@ -186,7 +230,7 @@ void serve_conn(Server* server, int fd) {
       std::lock_guard<std::mutex> lock(server->store.mu);
       auto it = server->store.blocks.find(hash);
       if (it != server->store.blocks.end()) {
-        payload = it->second;  // copy out under lock
+        payload = it->second.data;  // copy out under lock
         status = 0;
       }
     }
@@ -289,14 +333,40 @@ int kvt_server_port(void* handle) {
   return handle ? static_cast<Server*>(handle)->port : -1;
 }
 
-// Registers (or replaces) a block in the server's host-RAM store.
+// Registers (or replaces) a block in the server's host-RAM store. The
+// integrity checksum is computed HERE, outside the store lock (and without
+// the GIL — ctypes releases it for the call), so send-time stays a pure
+// memory copy and a later in-RAM bit-flip cannot re-bless itself.
 int kvt_server_put(void* handle, uint64_t hash, const uint8_t* data,
                    uint64_t len) {
   if (!handle) return -1;
   auto* server = static_cast<Server*>(handle);
+  uint64_t checksum = fnv1a64(data, len);
   std::lock_guard<std::mutex> lock(server->store.mu);
-  server->store.blocks[hash].assign(data, data + len);
+  Blob& blob = server->store.blocks[hash];
+  blob.data.assign(data, data + len);
+  blob.checksum = checksum;
   return 0;
+}
+
+// Fault-injection/test hook: flip one byte of a stored block WITHOUT
+// updating its put-time checksum — exactly the silent in-RAM/NIC bit-flip
+// the end-to-end integrity check exists to catch. Returns 0 on success,
+// 1 when the block is absent or empty (nothing to corrupt).
+int kvt_server_corrupt(void* handle, uint64_t hash) {
+  if (!handle) return 1;
+  auto* server = static_cast<Server*>(handle);
+  std::lock_guard<std::mutex> lock(server->store.mu);
+  auto it = server->store.blocks.find(hash);
+  if (it == server->store.blocks.end() || it->second.data.empty()) return 1;
+  it->second.data[0] ^= 0xFF;
+  return 0;
+}
+
+// The wire's integrity hash, exported so Python tests/tools can compute
+// the same FNV-1a 64 the client verifies.
+uint64_t kvt_checksum(const uint8_t* data, uint64_t len) {
+  return fnv1a64(data, len);
 }
 
 int kvt_server_remove(void* handle, uint64_t hash) {
@@ -428,6 +498,54 @@ int kvt_fetch_many(int fd, uint64_t n, const uint64_t* hashes, uint8_t* out,
     }
     if (length > 0 && !read_exact(fd, out + i * cap_per_block, length))
       return -1;
+    out_lens[i] = static_cast<int64_t>(length);
+  }
+  return 0;
+}
+
+// Checksummed multi-block fetch (v2 'KVTC' wire): identical shape to
+// kvt_fetch_many, plus per-block end-to-end integrity. Each received
+// payload is re-hashed (FNV-1a 64, GIL-free) and compared against the
+// peer's put-time checksum; a mismatch yields out_lens[i] = -4 "corrupt"
+// — the payload bytes were fully consumed, so the connection stays
+// usable, but the caller must treat the block exactly like a miss (fall
+// back to another source or recompute, never land it).
+int kvt_fetch_many2(int fd, uint64_t n, const uint64_t* hashes, uint8_t* out,
+                    uint64_t cap_per_block, int64_t* out_lens,
+                    int timeout_ms) {
+  if (fd < 0 || n == 0 || n > kMaxBlocksPerRequest) return -1;
+  set_io_timeout(fd, timeout_ms);
+  uint32_t magic = kMagicMulti2;
+  uint32_t count = static_cast<uint32_t>(n);
+  std::vector<iovec> req{
+      {&magic, 4},
+      {&count, 4},
+      {const_cast<uint64_t*>(hashes), 8ull * n},
+  };
+  if (!writev_all(fd, req)) return -1;
+  if (!read_exact(fd, &magic, 4) || magic != kMagicMulti2) return -1;
+  for (uint64_t i = 0; i < n; i++) {
+    uint8_t status = 1;
+    uint64_t length = 0;
+    uint64_t checksum = 0;
+    if (!read_exact(fd, &status, 1) || !read_exact(fd, &length, 8) ||
+        !read_exact(fd, &checksum, 8))
+      return -1;
+    if (status != 0) {
+      out_lens[i] = -2;
+      continue;
+    }
+    if (length > cap_per_block) {
+      if (!drain_exact(fd, length)) return -1;
+      out_lens[i] = -3;
+      continue;
+    }
+    uint8_t* dst = out + i * cap_per_block;
+    if (length > 0 && !read_exact(fd, dst, length)) return -1;
+    if (fnv1a64(dst, length) != checksum) {
+      out_lens[i] = -4;  // corrupt: detected, consumed, never landed
+      continue;
+    }
     out_lens[i] = static_cast<int64_t>(length);
   }
   return 0;

@@ -544,6 +544,11 @@ class ScoringService:
         self.session_table = None
         self.prefetch_scheduler = None
         self.route_prefetcher = None
+        # Data-plane client for the /readyz `transfer` section. Embedders
+        # that own a KVConnector assign its TransferClient here; otherwise
+        # the section reports the process-wide default client if (and only
+        # if) something in this process created one.
+        self.transfer_client = None
         if env.get("prediction"):
             from llm_d_kv_cache_manager_tpu.prediction import (
                 PredictionConfig,
@@ -1013,7 +1018,24 @@ class ScoringService:
             # route-prefetch drop. Never gates readiness: a cold (or
             # absent) predictor is a correct predictor.
             "prediction": self._prediction_section(),
+            # Data-plane health: per-peer breaker state + consecutive
+            # failures + EWMA fetch latency, and the hedge/corrupt/
+            # oversized counters (previously a single opaque failure
+            # counter). Never gates readiness — an open breaker means a
+            # PEER is dark; this process degrades those fetches to misses
+            # and keeps serving.
+            "transfer": self._transfer_section(),
         }
+
+    def _transfer_section(self) -> Optional[dict]:
+        from llm_d_kv_cache_manager_tpu.kv_connectors import (
+            connector as conn_mod,
+        )
+
+        client = self.transfer_client or conn_mod.peek_default_client()
+        if client is None:
+            return None
+        return client.status()
 
     def _prediction_section(self) -> Optional[dict]:
         if self.session_table is None and self.route_prefetcher is None:

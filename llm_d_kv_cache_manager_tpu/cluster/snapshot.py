@@ -31,6 +31,7 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.hashing import fnv64a
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import Index, IndexView
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import base_pod_identifier
 from llm_d_kv_cache_manager_tpu.utils import cbor
@@ -39,7 +40,11 @@ from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
 logger = kvlog.get_logger("cluster.snapshot")
 
 SNAPSHOT_MAGIC = b"KVTPUSNAP"
-SNAPSHOT_VERSION = 1
+# Version 2 appends a little-endian FNV-1a 64 checksum of the CBOR body
+# after the document, so a torn write or bit-flipped file fails LOUDLY as
+# SnapshotFormatError instead of warm-restarting a silently corrupt index.
+# Version-1 files (no checksum) still load.
+SNAPSHOT_VERSION = 2
 
 
 class SnapshotFormatError(ValueError):
@@ -71,6 +76,8 @@ class Snapshot:
 #  [[pod, topic, seq], ...],
 #  [[model, chunk_hash, [[pod, tier], ...]], ...],
 #  [[engine_model, engine_hash, request_model, request_hash], ...]]
+# Version 2 appends u64-LE FNV-1a 64 of the CBOR document bytes (the bytes
+# between the magic and the checksum) after the document.
 
 
 def encode_snapshot(
@@ -87,8 +94,11 @@ def encode_snapshot(
         [[model, h, [[p, t] for p, t in pods]] for model, h, pods in view.entries],
         [list(row) for row in view.engine_map],
     ]
+    body = bytearray()
+    cbor.encode_into(doc, body)
     out = bytearray(SNAPSHOT_MAGIC)
-    cbor.encode_into(doc, out)
+    out += body
+    out += fnv64a(bytes(body)).to_bytes(8, "little")
     return bytes(out)
 
 
@@ -99,15 +109,32 @@ def decode_snapshot(data: bytes) -> Snapshot:
         doc, end = cbor.decode(data, len(SNAPSHOT_MAGIC))
     except cbor.CborDecodeError as e:
         raise SnapshotFormatError(str(e)) from None
-    if end != len(data):
-        raise SnapshotFormatError(f"{len(data) - end} trailing byte(s)")
     if not isinstance(doc, list) or len(doc) != 5:
         raise SnapshotFormatError("malformed snapshot document")
     version = doc[0]
-    if version != SNAPSHOT_VERSION:
+    if version == 1:
+        # Pre-integrity snapshots carry no checksum; the document must
+        # consume the whole file.
+        if end != len(data):
+            raise SnapshotFormatError(f"{len(data) - end} trailing byte(s)")
+    elif version == SNAPSHOT_VERSION:
+        trailing = len(data) - end
+        if trailing != 8:
+            raise SnapshotFormatError(
+                "missing or malformed snapshot checksum "
+                f"({trailing} trailing byte(s), expected 8)"
+            )
+        expected = int.from_bytes(data[end:], "little")
+        actual = fnv64a(bytes(data[len(SNAPSHOT_MAGIC):end]))
+        if actual != expected:
+            raise SnapshotFormatError(
+                "snapshot checksum mismatch (torn or bit-flipped file) — "
+                "refusing to warm-restart from a corrupt index view"
+            )
+    else:
         raise SnapshotFormatError(
             f"unsupported snapshot version {version} "
-            f"(this build reads version {SNAPSHOT_VERSION})"
+            f"(this build reads versions 1..{SNAPSHOT_VERSION})"
         )
     seq_counters = {(pod, topic): seq for pod, topic, seq in doc[2]}
     view = IndexView(

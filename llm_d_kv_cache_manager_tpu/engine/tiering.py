@@ -343,12 +343,16 @@ class TieredKVStore:
             # Peer (DCN) leg. Batch the run of consecutive chain blocks
             # that miss the local tiers and resolve to the SAME peer into
             # one multi-block round trip — the serial protocol paid one
-            # RTT per block per chain.
+            # RTT per block per chain. When the index shows additional
+            # holders for the run's head, they ride along as hedge/
+            # fallback targets (first valid reply wins; see
+            # _fetch_peer_many).
             if self.peer_resolver is None:
                 break
             addr = self.peer_resolver(chunk_hash)
             if addr is None:
                 break
+            candidates = self._peer_candidates(chunk_hash, addr)
             run = [chunk_hash]
             j = i + 1
             while j < n and len(run) < self.fetch_batch_blocks:
@@ -369,7 +373,9 @@ class TieredKVStore:
                 if admitted <= 0:
                     break
                 run = run[:admitted]
-            payloads = self._fetch_peer_many(addr, run, max_size)
+            payloads = self._fetch_peer_many(
+                addr, run, max_size, candidates=candidates
+            )
             miss = False
             for payload in payloads:
                 if payload is None:
@@ -385,13 +391,50 @@ class TieredKVStore:
         land_wave()
         return landed
 
+    def _peer_candidates(
+        self, chunk_hash: int, primary: Tuple[str, int]
+    ) -> List[Tuple[str, int]]:
+        """Holder list for a hedged fetch: the resolver's primary pick
+        first (bit-identical healthy-path behavior), then the remaining
+        holders in the resolver's rendezvous ranking. Resolvers without a
+        `candidates` form (fakes, plain callables) yield just the
+        primary — no hedging."""
+        candidates_fn = getattr(self.peer_resolver, "candidates", None)
+        if candidates_fn is None:
+            return [primary]
+        try:
+            ranked = candidates_fn(chunk_hash)
+        except Exception:  # noqa: BLE001 - hedging is an optimization
+            return [primary]
+        out = [primary]
+        for addr in ranked:
+            if addr != primary:
+                out.append(addr)
+        return out
+
     def _fetch_peer_many(
-        self, addr: Tuple[str, int], hashes: List[int], max_size: int,
+        self,
+        addr: Tuple[str, int],
+        hashes: List[int],
+        max_size: int,
+        candidates: Optional[List[Tuple[str, int]]] = None,
     ) -> List[Optional[bytes]]:
         """One multi-block DCN round trip when the connector supports it
         (KVConnector.onboard_payloads); per-block fetches otherwise (fake
-        connectors in tests, stale .so builds)."""
+        connectors in tests, stale .so builds). With >= 2 candidate
+        holders and a hedging-capable connector, the fetch is hedged: the
+        primary gets an adaptive latency budget, then the next
+        rendezvous-ranked holder is raced — the first valid reply wins,
+        so a slow/corrupt/broken peer costs the hedge delay instead of
+        the full timeout ladder."""
         with obs.stage("transfer.peer_fetch"):
+            if candidates is not None and len(candidates) > 1:
+                hedged = getattr(
+                    self.connector, "onboard_payloads_hedged", None
+                )
+                if hedged is not None:
+                    self.stats["batched_fetches"] += 1
+                    return hedged(candidates, hashes, max_size)
             batched = getattr(self.connector, "onboard_payloads", None)
             if batched is not None and len(hashes) > 1:
                 self.stats["batched_fetches"] += 1
@@ -829,17 +872,44 @@ class IndexBackedPeerResolver:
         pod_addrs: Mapping[str, Tuple[str, int]],
         self_pod_id: str,
         host_tier: str = "host",
+        rendezvous_primary: bool = False,
     ):
         self.index = index
         self.model_name = model_name
         self.pod_addrs = pod_addrs
         self.self_pod_id = self_pod_id
         self.host_tier = host_tier
+        # False (default): the primary holder is the index's first
+        # matching entry — the historical behavior, byte-compatible with
+        # every committed bench. True: the primary is the per-(chunk,
+        # pod) rendezvous winner, which is ORDER-INDEPENDENT — per-key
+        # entry order races with the event pool's concurrent workers, so
+        # replayable scenarios (the chaos bench) need a peer choice that
+        # does not depend on worker interleaving.
+        self.rendezvous_primary = rendezvous_primary
 
     def __call__(self, chunk_hash: int) -> Optional[Tuple[str, int]]:
+        ranked = self.candidates(chunk_hash)
+        return ranked[0] if ranked else None
+
+    def candidates(self, chunk_hash: int) -> List[Tuple[str, int]]:
+        """Every fetchable holder of a block, primary first. By default
+        the primary is the index's first matching entry (the historical
+        `__call__` pick — the healthy path stays bit-identical) and the
+        remaining holders follow in per-(chunk, pod) rendezvous order, so
+        hedge traffic for a hot block spreads across its replicas instead
+        of piling onto one alternate. With `rendezvous_primary` the WHOLE
+        list is rendezvous-ordered (order-independent peer choice)."""
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.hashing import (
+            fnv64a,
+            fold64,
+        )
+
         key = Key(self.model_name, chunk_hash)
         hits = self.index.lookup([key], set())
-        for entry in hits.get(key, []):
+        holders = []  # (rendezvous weight, index order, addr)
+        seen = set()
+        for order, entry in enumerate(hits.get(key, [])):
             # Compare/resolve by bare pod identity: DP-ranked engines index
             # as "pod@dpR" but the address map (and we) know bare pod ids.
             bare = base_pod_identifier(entry.pod_identifier)
@@ -847,7 +917,19 @@ class IndexBackedPeerResolver:
                 continue
             if entry.device_tier != self.host_tier:
                 continue  # only staged blocks are fetchable
-            addr = self.pod_addrs.get(entry.pod_identifier) or self.pod_addrs.get(bare)
-            if addr is not None:
-                return addr
-        return None
+            addr = (
+                self.pod_addrs.get(entry.pod_identifier)
+                or self.pod_addrs.get(bare)
+            )
+            if addr is None or addr in seen:
+                continue
+            seen.add(addr)
+            holders.append((fold64(fnv64a(bare.encode()), chunk_hash), order, addr))
+        if not holders:
+            return []
+        if self.rendezvous_primary:
+            holders.sort()
+            return [addr for _w, _o, addr in holders]
+        first = holders[0]
+        rest = sorted(holders[1:])
+        return [first[2]] + [addr for _w, _o, addr in rest]

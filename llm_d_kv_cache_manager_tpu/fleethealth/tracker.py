@@ -148,6 +148,13 @@ class FleetHealthTracker:
         # Subscriber stream state (surfaced by /readyz).
         self._subscriber_failures = 0
         self._subscriber_connected: Optional[bool] = None
+        # Data-plane peer breaker states (kv_connectors TransferClient
+        # transitions), keyed by peer "host:port". A peer whose transfer
+        # breaker is open is a different signal from a stale event stream
+        # — the pod may still be scoring fresh placements while its
+        # transfer NIC is dark — so it is reported alongside, not merged
+        # into, the pod liveness state machine.
+        self._transfer_peers: Dict[str, dict] = {}
 
     def bind_index(self, index) -> None:
         """Late-bind the index quarantine target (Indexer wiring order)."""
@@ -229,6 +236,39 @@ class FleetHealthTracker:
         with self._mu:
             self._subscriber_failures = 0
             self._subscriber_connected = True
+
+    # -- data-plane breaker feed (kv_connectors/connector.py) --------------
+
+    def observe_transfer_breaker(
+        self, peer: str, old_state: str, new_state: str
+    ) -> None:
+        """One per-peer transfer-breaker transition (the TransferClient's
+        `on_breaker_transition` callback lands here). Kept as a bounded
+        per-peer record for /readyz and the fault bench — peers are fleet
+        topology, never traffic."""
+        now = self.clock()
+        with self._mu:
+            rec = self._transfer_peers.get(peer)
+            if rec is None:
+                rec = self._transfer_peers[peer] = {
+                    "state": new_state, "since": now, "transitions": 0,
+                    "opens": 0,
+                }
+            rec["state"] = new_state
+            rec["since"] = now
+            rec["transitions"] += 1
+            if new_state == "open":
+                rec["opens"] += 1
+        log = logger.info if new_state == "closed" else logger.warning
+        log("transfer breaker for peer %s: %s -> %s", peer, old_state,
+            new_state)
+
+    def transfer_breaker_summary(self) -> dict:
+        with self._mu:
+            return {
+                peer: dict(rec)
+                for peer, rec in sorted(self._transfer_peers.items())
+            }
 
     # -- state machine -----------------------------------------------------
 
@@ -370,7 +410,7 @@ class FleetHealthTracker:
                 d["last_event_age_s"] = round(now - rec.last_event_t, 3)
                 pods[pod] = d
                 counts[rec.state] += 1
-            return {
+            out = {
                 "pods": pods,
                 "counts": counts,
                 "subscriber": {
@@ -378,6 +418,12 @@ class FleetHealthTracker:
                     "consecutive_failures": self._subscriber_failures,
                 },
             }
+            if self._transfer_peers:
+                out["transfer_breakers"] = {
+                    peer: dict(rec)
+                    for peer, rec in sorted(self._transfer_peers.items())
+                }
+            return out
 
     def seq_snapshot(self) -> Dict[str, Dict[str, int]]:
         """Per-(pod, topic) last-applied wire seq: {pod: {topic: seq}}.

@@ -2,12 +2,26 @@ from llm_d_kv_cache_manager_tpu.kv_connectors.connector import (
     BlockTransferServer,
     KVConnector,
     KVConnectorConfig,
+    PeerBreaker,
+    TransferClient,
+    TransferClientConfig,
     fetch_block,
+)
+from llm_d_kv_cache_manager_tpu.kv_connectors.faults import (
+    FaultyTransport,
+    PeerTransferFaults,
+    TransferFaultPlan,
 )
 
 __all__ = [
     "BlockTransferServer",
+    "FaultyTransport",
     "KVConnector",
     "KVConnectorConfig",
+    "PeerBreaker",
+    "PeerTransferFaults",
+    "TransferClient",
+    "TransferClientConfig",
+    "TransferFaultPlan",
     "fetch_block",
 ]

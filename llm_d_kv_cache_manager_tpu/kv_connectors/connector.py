@@ -35,6 +35,7 @@ from __future__ import annotations
 import ctypes
 import os
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
@@ -143,6 +144,22 @@ def _configure_lib(lib: ctypes.CDLL) -> None:
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
         ]
+    # Integrity ABI (this build): the v2 checksummed wire plus the
+    # corruption test hook. Guarded separately so a pre-integrity .so
+    # still serves the v1 paths.
+    if hasattr(lib, "kvt_fetch_many2"):
+        lib.kvt_fetch_many2.restype = ctypes.c_int
+        lib.kvt_fetch_many2.argtypes = [
+            ctypes.c_int, ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ]
+        lib.kvt_server_corrupt.restype = ctypes.c_int
+        lib.kvt_server_corrupt.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.kvt_checksum.restype = ctypes.c_uint64
+        lib.kvt_checksum.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+        ]
 
 
 _lib = _load_lib()
@@ -155,6 +172,11 @@ def native_available() -> bool:
 def client_api_available() -> bool:
     """True when the loaded .so carries the pooled/batched client ABI."""
     return _lib is not None and hasattr(_lib, "kvt_fetch_many")
+
+
+def integrity_api_available() -> bool:
+    """True when the loaded .so carries the v2 checksummed wire."""
+    return _lib is not None and hasattr(_lib, "kvt_fetch_many2")
 
 
 class BlockTransferServer:
@@ -181,6 +203,17 @@ class BlockTransferServer:
     def remove(self, block_hash: int) -> bool:
         return _lib.kvt_server_remove(self._handle, block_hash & (2**64 - 1)) == 0
 
+    def corrupt(self, block_hash: int) -> bool:
+        """Fault-injection hook: flip a byte of the stored block WITHOUT
+        touching its put-time checksum (the silent bit-flip the end-to-end
+        integrity check exists to catch). False when the block is absent,
+        empty, or the loaded .so predates the integrity ABI."""
+        if not integrity_api_available():
+            return False
+        return _lib.kvt_server_corrupt(
+            self._handle, block_hash & (2**64 - 1)
+        ) == 0
+
     def block_count(self) -> int:
         return _lib.kvt_server_block_count(self._handle)
 
@@ -199,6 +232,20 @@ class BlockTransferServer:
 # -- pooled keep-alive DCN client ---------------------------------------------
 
 
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
 @dataclass
 class TransferClientConfig:
     connect_timeout_ms: int = 2000
@@ -210,6 +257,214 @@ class TransferClientConfig:
     # Blocks per wire request; longer chains split into multiple round
     # trips (still 1/max_batch of the serial count).
     max_batch: int = 256
+    # End-to-end integrity: fetch over the v2 checksummed wire when the
+    # loaded .so carries it; a failed per-block check degrades to a miss
+    # (counted), never a landed corrupt block. False restores the v1 wire
+    # byte-for-byte (mixed-version peers).
+    verify_integrity: bool = True
+    # Per-peer circuit breaker: `breaker_failure_threshold` consecutive
+    # failed results (timeouts, transport errors, corruption) open the
+    # peer's breaker; while open every fetch is skipped instantly (a
+    # counted miss — no timeout paid). After `breaker_cooldown_s` the
+    # breaker goes half-open and admits ONE probe fetch: success closes
+    # it, failure re-opens with a fresh cooldown. Threshold <= 0 disables.
+    breaker_failure_threshold: int = 5
+    breaker_cooldown_s: float = 30.0
+    # Hedged fetches (fetch_many_hedged): when a chain run has >= 2
+    # holders, a hedge to the next holder launches after an adaptive
+    # delay tracking the primary peer's latency tail (EWMA mean + 4x EWMA
+    # deviation — a p99 proxy), clamped to [floor, cap].
+    hedge_delay_floor_s: float = 0.005
+    hedge_delay_cap_s: float = 2.0
+
+    @classmethod
+    def from_env(cls) -> "TransferClientConfig":
+        """Env-tunable form for the process-wide default client (the knobs
+        a deployment flips without code: see docs/configuration.md)."""
+        return cls(
+            connect_timeout_ms=_env_int("KVTPU_TRANSFER_CONNECT_TIMEOUT_MS", 2000),
+            io_timeout_ms=_env_int("KVTPU_TRANSFER_IO_TIMEOUT_MS", 5000),
+            retries=_env_int("KVTPU_TRANSFER_RETRIES", 1),
+            verify_integrity=_env_int("KVTPU_TRANSFER_VERIFY_INTEGRITY", 1) != 0,
+            breaker_failure_threshold=_env_int(
+                "KVTPU_TRANSFER_BREAKER_THRESHOLD", 5
+            ),
+            breaker_cooldown_s=_env_float(
+                "KVTPU_TRANSFER_BREAKER_COOLDOWN_S", 30.0
+            ),
+            hedge_delay_floor_s=_env_float(
+                "KVTPU_TRANSFER_HEDGE_FLOOR_MS", 5.0
+            ) / 1e3,
+            hedge_delay_cap_s=_env_float(
+                "KVTPU_TRANSFER_HEDGE_CAP_MS", 2000.0
+            ) / 1e3,
+        )
+
+
+# Breaker states — the fixed vocabulary the transition metric's `state`
+# label carries (pinned in tests/test_metrics_hygiene.py).
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
+BREAKER_STATES = (BREAKER_CLOSED, BREAKER_OPEN, BREAKER_HALF_OPEN)
+
+# Per-block error kinds — the fixed vocabulary of
+# kvcache_transfer_block_errors_total{kind} (pinned in the hygiene walk).
+# `transport`: the whole round trip failed its bounded timeout/retry
+# budget; `oversized`: the peer answered with a block over the caller's
+# cap (drained, dropped); `corrupt`: the end-to-end checksum failed on
+# receipt; `breaker_open`: skipped instantly because the peer's breaker
+# was open.
+TRANSFER_ERROR_KINDS = ("transport", "oversized", "corrupt", "breaker_open")
+
+# Sentinels for per-block wire statuses inside _transport_fetch results.
+_OVERSIZED = object()  # -3: present remotely but over the caller's cap
+_CORRUPT = object()    # -4: failed the end-to-end checksum on receipt
+
+
+class PeerBreaker:
+    """Per-peer circuit breaker: closed -> open on consecutive failures,
+    half-open single-probe recovery. Clock-driven (the owner passes `now`
+    into every call), so transitions are deterministic under test and
+    under the simulated fleet clock."""
+
+    def __init__(self, failure_threshold: int, cooldown_s: float):
+        self.failure_threshold = failure_threshold
+        self.cooldown_s = cooldown_s
+        self.state = BREAKER_CLOSED
+        self.consecutive_failures = 0
+        self.opened_at: Optional[float] = None
+        self.opens = 0
+        self._probe_inflight = False
+        self._mu = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return self.failure_threshold > 0
+
+    def allow(self, now: float):
+        """(allowed, transition): whether a fetch may proceed now, plus the
+        (old, new) state transition this call performed (open -> half_open
+        when the cooldown elapsed), if any."""
+        if not self.enabled:
+            return True, None
+        with self._mu:
+            if self.state == BREAKER_CLOSED:
+                return True, None
+            if self.state == BREAKER_OPEN:
+                if now - (self.opened_at or 0.0) < self.cooldown_s:
+                    return False, None
+                # Cooldown over: half-open, this caller becomes the probe.
+                self.state = BREAKER_HALF_OPEN
+                self._probe_inflight = True
+                return True, (BREAKER_OPEN, BREAKER_HALF_OPEN)
+            # half-open: exactly one probe at a time.
+            if self._probe_inflight:
+                return False, None
+            self._probe_inflight = True
+            return True, None
+
+    def record_success(self, now: float):
+        """Returns the (old, new) transition, if any."""
+        with self._mu:
+            self.consecutive_failures = 0
+            self._probe_inflight = False
+            if self.state == BREAKER_CLOSED:
+                return None
+            old, self.state = self.state, BREAKER_CLOSED
+            self.opened_at = None
+            return (old, BREAKER_CLOSED)
+
+    def record_failure(self, now: float):
+        """Returns the (old, new) transition, if any."""
+        if not self.enabled:
+            return None
+        with self._mu:
+            self.consecutive_failures += 1
+            self._probe_inflight = False
+            if self.state == BREAKER_HALF_OPEN:
+                # Failed probe: straight back to open, fresh cooldown.
+                self.state = BREAKER_OPEN
+                self.opened_at = now
+                self.opens += 1
+                return (BREAKER_HALF_OPEN, BREAKER_OPEN)
+            if (
+                self.state == BREAKER_CLOSED
+                and self.consecutive_failures >= self.failure_threshold
+            ):
+                self.state = BREAKER_OPEN
+                self.opened_at = now
+                self.opens += 1
+                return (BREAKER_CLOSED, BREAKER_OPEN)
+            return None
+
+    def status(self, now: Optional[float] = None) -> dict:
+        with self._mu:
+            out = {
+                "state": self.state,
+                "consecutive_failures": self.consecutive_failures,
+                "opens": self.opens,
+            }
+            if self.state == BREAKER_OPEN and now is not None:
+                out["cooldown_remaining_s"] = round(
+                    max(
+                        self.cooldown_s - (now - (self.opened_at or 0.0)), 0.0
+                    ),
+                    3,
+                )
+            return out
+
+
+class _PeerState:
+    """Per-(host, port) client-side failure memory: the breaker plus an
+    EWMA latency profile (mean + mean-absolute-deviation — the hedge
+    delay's p99 proxy) and per-peer counters."""
+
+    __slots__ = (
+        "key", "breaker", "lock", "lat_ewma", "lat_dev", "lat_n",
+        "fetches", "failures", "corrupt_blocks", "breaker_skips",
+    )
+
+    _ALPHA = 0.2  # EWMA smoothing for the latency profile
+
+    def __init__(self, key: str, config: TransferClientConfig):
+        self.key = key
+        self.breaker = PeerBreaker(
+            config.breaker_failure_threshold, config.breaker_cooldown_s
+        )
+        self.lock = threading.Lock()
+        self.lat_ewma = 0.0
+        self.lat_dev = 0.0
+        self.lat_n = 0
+        self.fetches = 0
+        self.failures = 0
+        self.corrupt_blocks = 0
+        self.breaker_skips = 0
+
+    def note_latency(self, seconds: float) -> None:
+        with self.lock:
+            if self.lat_n == 0:
+                self.lat_ewma = seconds
+                self.lat_dev = 0.0
+            else:
+                err = seconds - self.lat_ewma
+                self.lat_ewma += self._ALPHA * err
+                self.lat_dev += self._ALPHA * (abs(err) - self.lat_dev)
+            self.lat_n += 1
+
+    def status(self, now: Optional[float] = None) -> dict:
+        with self.lock:
+            out = {
+                "fetches": self.fetches,
+                "failures": self.failures,
+                "corrupt_blocks": self.corrupt_blocks,
+                "breaker_skips": self.breaker_skips,
+                "ewma_fetch_latency_ms": round(self.lat_ewma * 1e3, 3),
+                "ewma_latency_dev_ms": round(self.lat_dev * 1e3, 3),
+                "latency_samples": self.lat_n,
+            }
+        out.update(self.breaker.status(now))
+        return out
 
 
 class _Conn:
@@ -229,15 +484,47 @@ class TransferClient:
     on exhaustion the blocks come back as None (a miss the tiering layer
     already handles) and `transfer_failures` counts the event, so a dead
     peer can never wedge the serving thread on a stuck socket.
+
+    Chaos hardening on top of the pooled protocol:
+
+    - **End-to-end integrity**: fetches ride the v2 checksummed wire
+      (put-time FNV-1a 64 per block, verified GIL-free on receipt); a
+      failed check degrades the block to a miss — counted in
+      `kvcache_transfer_corrupt_blocks_total` — and is NEVER landed.
+    - **Per-peer circuit breakers**: consecutive failures (timeouts,
+      transport errors, corruption) open the peer's breaker; open peers
+      are skipped instantly instead of paying the full timeout, with
+      half-open single-probe recovery. Transitions are observable
+      (`on_breaker_transition` callback + the transitions metric).
+    - **Hedged fetches** (`fetch_many_hedged`): given several holders of
+      a chain run, a hedge launches to the next holder after an adaptive
+      per-peer-latency delay; the first valid reply wins and the loser's
+      reply is drained and discarded (a fetch is idempotent — nothing can
+      double-land).
+
+    The clock is injectable (breaker windows + latency profile), so every
+    transition is deterministic under test and the fleet-sim clock.
     """
 
-    def __init__(self, config: Optional[TransferClientConfig] = None):
+    def __init__(
+        self,
+        config: Optional[TransferClientConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+        on_breaker_transition: Optional[Callable[[str, str, str], None]] = None,
+    ):
         self.config = config or TransferClientConfig()
+        self.clock = clock
+        # Called as (peer_key, old_state, new_state) on every breaker
+        # transition — the FleetHealthTracker feed.
+        self.on_breaker_transition = on_breaker_transition
         self._pool: Dict[Tuple[str, int], _Conn] = {}
-        self._mu = threading.Lock()  # pool map only
+        self._peers: Dict[Tuple[str, int], _PeerState] = {}
+        self._mu = threading.Lock()  # pool/peer maps only
         self.stats: Dict[str, int] = {
             "connects": 0, "reconnects": 0, "failures": 0,
             "batch_fetches": 0, "blocks_fetched": 0,
+            "corrupt_blocks": 0, "oversized_blocks": 0,
+            "breaker_skipped_blocks": 0, "hedges": 0, "hedge_wins": 0,
         }
 
     def _conn(self, host: str, port: int) -> _Conn:
@@ -246,6 +533,15 @@ class TransferClient:
             if conn is None:
                 conn = self._pool[(host, port)] = _Conn()
             return conn
+
+    def peer_state(self, host: str, port: int) -> _PeerState:
+        with self._mu:
+            peer = self._peers.get((host, port))
+            if peer is None:
+                peer = self._peers[(host, port)] = _PeerState(
+                    f"{host}:{port}", self.config
+                )
+            return peer
 
     def _ensure_connected(self, conn: _Conn, host: str, port: int) -> bool:
         if conn.fd >= 0:
@@ -272,49 +568,114 @@ class TransferClient:
             self.config.retries + 1, n,
         )
 
+    def _has_client_api(self) -> bool:
+        """Seam for tests/fakes: a subclass that overrides
+        `_transport_fetch` with scripted outcomes returns True here so the
+        breaker/hedge/integrity logic runs without the native lib."""
+        return client_api_available()
+
+    # -- per-peer bookkeeping seam ----------------------------------------
+
+    def _note_transition(self, peer: _PeerState, transition) -> None:
+        if transition is None:
+            return
+        old, new = transition
+        metrics.count_breaker_transition(new)
+        log = logger.info if new == BREAKER_CLOSED else logger.warning
+        log("transfer breaker for %s: %s -> %s", peer.key, old, new)
+        if self.on_breaker_transition is not None:
+            try:
+                self.on_breaker_transition(peer.key, old, new)
+            except Exception as e:  # noqa: BLE001 - observer must not
+                logger.debug("breaker transition callback failed: %s", e)
+
+    def allow_peer(self, host: str, port: int) -> bool:
+        """Breaker gate: False means the peer must be skipped right now
+        (its breaker is open, or half-open with the probe slot taken)."""
+        peer = self.peer_state(host, port)
+        allowed, transition = peer.breaker.allow(self.clock())
+        self._note_transition(peer, transition)
+        return allowed
+
+    def note_result(
+        self,
+        host: str,
+        port: int,
+        ok: bool,
+        latency_s: float,
+        corrupt_blocks: int = 0,
+        blocks: int = 1,
+    ) -> None:
+        """Record one fetch outcome against the peer's failure memory:
+        latency EWMA (successes only — a timeout is not a latency sample),
+        corruption counters, and the breaker (corruption counts as a
+        failure: a peer shipping garbage is as untrustworthy as a dead
+        one). Public because the chaos fault injector
+        (kv_connectors/faults.py) stands in for the wire and reports the
+        outcomes it synthesizes through the SAME seam."""
+        peer = self.peer_state(host, port)
+        now = self.clock()
+        if ok:
+            peer.note_latency(latency_s)
+            with peer.lock:
+                peer.fetches += 1
+        else:
+            with peer.lock:
+                peer.failures += 1
+            metrics.count_transfer_block_error("transport", blocks)
+        if corrupt_blocks:
+            with peer.lock:
+                peer.corrupt_blocks += corrupt_blocks
+            self.stats["corrupt_blocks"] += corrupt_blocks
+            metrics.count_transfer_corrupt(corrupt_blocks)
+            metrics.count_transfer_block_error("corrupt", corrupt_blocks)
+            logger.warning(
+                "%d corrupt block(s) detected from %s:%d — discarded "
+                "(checksum mismatch), falling back", corrupt_blocks, host,
+                port,
+            )
+        if ok and not corrupt_blocks:
+            self._note_transition(peer, peer.breaker.record_success(now))
+        else:
+            self._note_transition(peer, peer.breaker.record_failure(now))
+
+    def _breaker_skip(self, host: str, port: int, n: int) -> List[None]:
+        peer = self.peer_state(host, port)
+        with peer.lock:
+            peer.breaker_skips += 1
+        self.stats["breaker_skipped_blocks"] += n
+        metrics.count_transfer_block_error("breaker_open", n)
+        return [None] * n
+
+    # -- fetch paths -------------------------------------------------------
+
     def fetch_one(
         self, host: str, port: int, block_hash: int, max_size: int,
     ) -> Optional[bytes]:
         """One block over the pooled connection. None when missing remotely
-        OR when every attempt failed (counted in `transfer_failures`)."""
-        if not client_api_available():
+        OR when every attempt failed (counted in `transfer_failures`).
+        Rides the same breaker-gated, integrity-checked path as
+        `fetch_many` (an n=1 multi-block round trip)."""
+        if not self._has_client_api():
             return _legacy_fetch(host, port, block_hash, max_size)
-        cap = max(max_size, 1)
-        buf = (ctypes.c_uint8 * cap)()
-        conn = self._conn(host, port)
-        # Peer identity rides the trace META (data), never a metric label.
-        obs.annotate("peer", f"{host}:{port}")
-        with obs.stage("transfer.dcn_fetch"), conn.lock:
-            for attempt in range(self.config.retries + 1):
-                if attempt:
-                    self.stats["reconnects"] += 1
-                if not self._ensure_connected(conn, host, port):
-                    continue
-                n = _lib.kvt_fetch_conn(
-                    conn.fd, block_hash & (2**64 - 1), buf, cap,
-                    self.config.io_timeout_ms,
-                )
-                if n == -2:
-                    return None  # present nowhere — a genuine miss
-                if n >= 0:
-                    return ctypes.string_at(buf, n)
-                self._drop(conn)  # transport error: reconnect and retry
-        self._fail(host, port, 1, "fetch")
-        return None
+        return self.fetch_many(host, port, [block_hash], max_size)[0]
 
     def fetch_many(
         self, host: str, port: int, block_hashes: List[int], max_size: int,
     ) -> List[Optional[bytes]]:
         """Fetch a chain in one round trip per `max_batch` blocks. Returns
         payloads aligned with `block_hashes`; None marks a block missing
-        remotely or lost to a (bounded, retried, counted) transport
+        remotely, failed-integrity (detected corrupt), skipped behind an
+        open breaker, or lost to a (bounded, retried, counted) transport
         failure."""
         if not block_hashes:
             return []
-        if not client_api_available():
+        if not self._has_client_api():
             return [
                 _legacy_fetch(host, port, h, max_size) for h in block_hashes
             ]
+        if not self.allow_peer(host, port):
+            return self._breaker_skip(host, port, len(block_hashes))
         out: List[Optional[bytes]] = []
         mb = max(1, self.config.max_batch)
         for i in range(0, len(block_hashes), mb):
@@ -323,47 +684,211 @@ class TransferClient:
             )
         return out
 
-    def _fetch_chunk(
-        self, host: str, port: int, hashes: List[int], max_size: int,
-    ) -> List[Optional[bytes]]:
+    def _transport_fetch(self, host, port, hashes, max_size):
+        """The lib-touching leg of one chunk: (ok, entries). `entries` is
+        aligned with `hashes`: payload bytes, None (missing remotely), or
+        the _OVERSIZED/_CORRUPT sentinels. ok=False means the whole round
+        trip failed its bounded retry budget (entries is None). Overridden
+        by tests and the chaos fault injector."""
         n = len(hashes)
         cap = max(max_size, 1)
         arr = (ctypes.c_uint64 * n)(*[h & (2**64 - 1) for h in hashes])
         buf = (ctypes.c_uint8 * (n * cap))()
         lens = (ctypes.c_int64 * n)()
+        use_v2 = self.config.verify_integrity and integrity_api_available()
+        fetch_fn = _lib.kvt_fetch_many2 if use_v2 else _lib.kvt_fetch_many
         conn = self._conn(host, port)
-        obs.annotate("peer", f"{host}:{port}")
-        with obs.stage("transfer.dcn_fetch"), conn.lock:
+        with conn.lock:
             for attempt in range(self.config.retries + 1):
                 if attempt:
                     self.stats["reconnects"] += 1
                 if not self._ensure_connected(conn, host, port):
                     continue
-                rc = _lib.kvt_fetch_many(
+                rc = fetch_fn(
                     conn.fd, n, arr, buf, cap, lens, self.config.io_timeout_ms
                 )
                 if rc == 0:
-                    self.stats["batch_fetches"] += 1
-                    self.stats["blocks_fetched"] += n
                     base = ctypes.addressof(buf)
-                    result: List[Optional[bytes]] = []
+                    entries = []
                     for i in range(n):
                         ln = lens[i]
                         if ln >= 0:
-                            result.append(
-                                ctypes.string_at(base + i * cap, ln)
-                            )
+                            entries.append(ctypes.string_at(base + i * cap, ln))
+                        elif ln == -3:
+                            entries.append(_OVERSIZED)
+                        elif ln == -4:
+                            entries.append(_CORRUPT)
                         else:
-                            if ln == -3:
-                                logger.warning(
-                                    "block %x from %s:%d exceeds cap %d — "
-                                    "dropped", hashes[i], host, port, cap,
-                                )
-                            result.append(None)
-                    return result
-                self._drop(conn)
-        self._fail(host, port, n, "batch fetch")
-        return [None] * n
+                            entries.append(None)
+                    return True, entries
+                self._drop(conn)  # transport error: reconnect and retry
+        return False, None
+
+    def _fetch_chunk(
+        self, host: str, port: int, hashes: List[int], max_size: int,
+    ) -> List[Optional[bytes]]:
+        n = len(hashes)
+        # Peer identity rides the trace META (data), never a metric label.
+        obs.annotate("peer", f"{host}:{port}")
+        with obs.stage("transfer.dcn_fetch"):
+            t0 = self.clock()
+            ok, entries = self._transport_fetch(host, port, hashes, max_size)
+            latency = max(self.clock() - t0, 0.0)
+        if not ok:
+            self.note_result(host, port, ok=False, latency_s=latency, blocks=n)
+            self._fail(host, port, n, "batch fetch")
+            return [None] * n
+        corrupt = 0
+        result: List[Optional[bytes]] = []
+        for h, entry in zip(hashes, entries):
+            if entry is _CORRUPT:
+                corrupt += 1
+                result.append(None)  # detected — treated exactly like a miss
+            elif entry is _OVERSIZED:
+                self.stats["oversized_blocks"] += 1
+                metrics.count_transfer_block_error("oversized", 1)
+                logger.warning(
+                    "block %x from %s:%d exceeds cap %d — dropped",
+                    h, host, port, max(max_size, 1),
+                )
+                result.append(None)
+            else:
+                result.append(entry)
+        self.stats["batch_fetches"] += 1
+        self.stats["blocks_fetched"] += n
+        self.note_result(
+            host, port, ok=True, latency_s=latency,
+            corrupt_blocks=corrupt, blocks=n,
+        )
+        return result
+
+    # -- hedged fetches ----------------------------------------------------
+
+    def hedge_delay_s(self, host: str, port: int) -> float:
+        """Adaptive hedge trigger for a peer: EWMA latency mean + 4x EWMA
+        mean-absolute-deviation (a p99 proxy that needs no sample ring),
+        clamped to [hedge_delay_floor_s, hedge_delay_cap_s]."""
+        peer = self.peer_state(host, port)
+        with peer.lock:
+            if peer.lat_n == 0:
+                est = self.config.hedge_delay_floor_s
+            else:
+                est = peer.lat_ewma + 4.0 * peer.lat_dev
+        return min(
+            max(est, self.config.hedge_delay_floor_s),
+            self.config.hedge_delay_cap_s,
+        )
+
+    def fetch_many_hedged(
+        self,
+        addrs: List[Tuple[str, int]],
+        block_hashes: List[int],
+        max_size: int,
+    ) -> List[Optional[bytes]]:
+        """Fetch a chain run that has several holders. The first holder is
+        the primary; if it has not answered within the adaptive hedge
+        delay — or answered with holes (transport failure, corruption,
+        open breaker) — a hedge launches to the next holder. The first
+        COMPLETE reply (every block present) wins; a losing fetch still
+        runs to completion on its own pooled connection (the reply is
+        drained, keeping the connection usable) and its payloads are
+        discarded, so a block can never be returned twice. With no
+        complete reply anywhere, the reply covering the most blocks wins
+        (primary on ties) — the caller's chain-cut logic handles the
+        holes."""
+        if not block_hashes:
+            return []
+        if not addrs:
+            return [None] * len(block_hashes)
+        primary, backups = addrs[0], list(addrs[1:])
+        if not backups:
+            return self.fetch_many(
+                primary[0], primary[1], block_hashes, max_size
+            )
+
+        cv = threading.Condition()
+        replies: List[tuple] = []  # (addr, result), completion order
+        inflight = [0]
+
+        def run(addr):
+            result = self.fetch_many(
+                addr[0], addr[1], list(block_hashes), max_size
+            )
+            with cv:
+                replies.append((addr, result))
+                inflight[0] -= 1
+                cv.notify_all()
+
+        def launch(addr):
+            inflight[0] += 1
+            threading.Thread(
+                target=run, args=(addr,), name="kv-hedge-fetch", daemon=True
+            ).start()
+
+        def complete(result):
+            return all(payload is not None for payload in result)
+
+        with cv:
+            launch(primary)
+            examined = 0
+            cv.wait_for(
+                lambda: len(replies) > 0,
+                timeout=self.hedge_delay_s(*primary),
+            )
+            backup_iter = iter(backups)
+            while True:
+                while examined < len(replies):
+                    addr, result = replies[examined]
+                    examined += 1
+                    if complete(result):
+                        if addr != primary:
+                            self.stats["hedge_wins"] += 1
+                        return result
+                nxt = next(backup_iter, None)
+                if nxt is not None:
+                    # Primary (or an earlier hedge) is slow or answered
+                    # with holes: fan to the next rendezvous-ranked holder.
+                    launch(nxt)
+                    self.stats["hedges"] += 1
+                    metrics.count_transfer_hedge()
+                elif inflight[0] == 0:
+                    break
+                done = examined  # rebind for the closure below
+                cv.wait_for(
+                    lambda: len(replies) > done or inflight[0] == 0
+                )
+            # No complete reply: most-covered wins, primary on ties
+            # (replies is completion-ordered, primary launched first).
+            best: Optional[List[Optional[bytes]]] = None
+            best_cover = -1
+            for addr, result in replies:
+                cover = sum(payload is not None for payload in result)
+                if cover > best_cover:
+                    best, best_cover = result, cover
+            return best if best is not None else [None] * len(block_hashes)
+
+    # -- introspection -----------------------------------------------------
+
+    def status(self) -> dict:
+        """Transfer-plane health snapshot (the /readyz `transfer` section):
+        aggregate counters plus per-peer breaker state, consecutive
+        failures, and the EWMA fetch-latency profile."""
+        now = self.clock()
+        with self._mu:
+            peers = dict(self._peers)
+        return {
+            "stats": dict(self.stats),
+            "breaker": {
+                "failure_threshold": self.config.breaker_failure_threshold,
+                "cooldown_s": self.config.breaker_cooldown_s,
+            },
+            "verify_integrity": (
+                self.config.verify_integrity and integrity_api_available()
+            ),
+            "peers": {
+                peer.key: peer.status(now) for peer in peers.values()
+            },
+        }
 
     def close(self) -> None:
         with self._mu:
@@ -379,11 +904,22 @@ _default_client_mu = threading.Lock()
 
 
 def default_client() -> TransferClient:
-    """Process-wide pooled client (module-level fetch_block/fetch_blocks)."""
+    """Process-wide pooled client (module-level fetch_block/fetch_blocks).
+    Env-tunable: KVTPU_TRANSFER_{CONNECT_TIMEOUT_MS, IO_TIMEOUT_MS,
+    RETRIES, VERIFY_INTEGRITY, BREAKER_THRESHOLD, BREAKER_COOLDOWN_S,
+    HEDGE_FLOOR_MS, HEDGE_CAP_MS}."""
     global _default_client
     with _default_client_mu:
         if _default_client is None:
-            _default_client = TransferClient()
+            _default_client = TransferClient(TransferClientConfig.from_env())
+        return _default_client
+
+
+def peek_default_client() -> Optional[TransferClient]:
+    """The process-wide client if one exists — WITHOUT creating it (the
+    /readyz transfer section must not conjure a transfer plane into a
+    process that never used one)."""
+    with _default_client_mu:
         return _default_client
 
 
@@ -438,6 +974,11 @@ class KVConnectorConfig:
     fetch_timeout_ms: int = 5000
     fetch_retries: int = 1
     fetch_batch_size: int = 256
+    # Chaos hardening (threaded into the TransferClient; see
+    # TransferClientConfig for semantics).
+    verify_integrity: bool = True
+    breaker_failure_threshold: int = 5
+    breaker_cooldown_s: float = 30.0
 
 
 class KVConnector:
@@ -457,6 +998,9 @@ class KVConnector:
             io_timeout_ms=self.config.fetch_timeout_ms,
             retries=self.config.fetch_retries,
             max_batch=self.config.fetch_batch_size,
+            verify_integrity=self.config.verify_integrity,
+            breaker_failure_threshold=self.config.breaker_failure_threshold,
+            breaker_cooldown_s=self.config.breaker_cooldown_s,
         ))
         # Dispatched-but-undrained offload snapshots, FIFO. Entries hold
         # the device arrays whose copy_to_host_async is in flight.
@@ -586,6 +1130,17 @@ class KVConnector:
         """Batched onboard_payload: one multi-block round trip per chain
         instead of one per block — the DCN leg's unit of transfer."""
         return self.client.fetch_many(host, port, block_hashes, max_size)
+
+    def onboard_payloads_hedged(
+        self,
+        addrs: List[Tuple[str, int]],
+        block_hashes: List[int],
+        max_size: int,
+    ) -> List[Optional[bytes]]:
+        """Batched onboard with fallback holders: primary first, hedge to
+        the next holder on latency or failure (TransferClient semantics —
+        first valid reply wins, never double-lands)."""
+        return self.client.fetch_many_hedged(addrs, block_hashes, max_size)
 
     def fetch_staged(self, block_hash: int, max_size: int) -> Optional[bytes]:
         """Local host-store lookup; None if the block is not staged."""

@@ -58,6 +58,17 @@ redis_state_transitions: Optional[Counter] = None
 # queued by the route-driven prefetcher (kv_connectors/prefetch.py).
 transfer_failures: Optional[Counter] = None
 route_prefetch_blocks: Optional[Counter] = None
+# Chaos-hardened data plane (kv_connectors/connector.py): blocks whose
+# end-to-end checksum failed on receipt (detected, discarded, NEVER
+# landed), per-block error outcomes by fixed kind
+# (connector.TRANSFER_ERROR_KINDS: transport/oversized/corrupt/
+# breaker_open), hedged fetches launched to an alternate holder, and
+# per-peer circuit-breaker transitions by the state entered
+# (connector.BREAKER_STATES: closed/open/half_open).
+transfer_corrupt_blocks: Optional[Counter] = None
+transfer_block_errors: Optional[Counter] = None
+transfer_hedges: Optional[Counter] = None
+transfer_breaker_transitions: Optional[Counter] = None
 # Tracing spine (obs/): per-stage latency across the three planes. Labels
 # are the fixed `plane.stage` names from the instrumentation sites —
 # bounded by code, never by traffic (tests/test_metrics_hygiene.py walks
@@ -156,6 +167,8 @@ def register_metrics(registry=None) -> None:
     global pod_state_transitions, stale_entries_purged
     global event_stream_anomalies, redis_state_transitions
     global transfer_failures, route_prefetch_blocks
+    global transfer_corrupt_blocks, transfer_block_errors
+    global transfer_hedges, transfer_breaker_transitions
     global stage_latency, event_apply_delay
     global replica_partitions, replica_snapshot_age, replica_replay_lag
     global replica_state_transitions, replica_scatter_errors
@@ -280,6 +293,32 @@ def register_metrics(registry=None) -> None:
         route_prefetch_blocks = Counter(
             "kvcache_route_prefetch_blocks_total",
             "KV blocks queued for prefetch by the route-driven prefetcher",
+            registry=reg,
+        )
+        transfer_corrupt_blocks = Counter(
+            "kvcache_transfer_corrupt_blocks_total",
+            "KV blocks whose end-to-end checksum failed on receipt — "
+            "detected and discarded, never landed into HBM",
+            registry=reg,
+        )
+        transfer_block_errors = Counter(
+            "kvcache_transfer_block_errors_total",
+            "Per-block transfer error outcomes, labeled by the fixed kind "
+            "vocabulary (transport/oversized/corrupt/breaker_open)",
+            labelnames=("kind",),
+            registry=reg,
+        )
+        transfer_hedges = Counter(
+            "kvcache_transfer_hedged_fetches_total",
+            "Hedged fetches launched to an alternate holder (primary slow "
+            "past its adaptive latency bound, or answered with holes)",
+            registry=reg,
+        )
+        transfer_breaker_transitions = Counter(
+            "kvcache_transfer_breaker_transitions_total",
+            "Per-peer transfer circuit-breaker transitions, labeled by "
+            "the state entered (closed/open/half_open)",
+            labelnames=("state",),
             registry=reg,
         )
         stage_latency = Histogram(
@@ -544,6 +583,26 @@ def count_transfer_failure(n: int = 1) -> None:
 def count_route_prefetch(n: int) -> None:
     if route_prefetch_blocks is not None and n:
         route_prefetch_blocks.inc(n)
+
+
+def count_transfer_corrupt(n: int = 1) -> None:
+    if transfer_corrupt_blocks is not None and n:
+        transfer_corrupt_blocks.inc(n)
+
+
+def count_transfer_block_error(kind: str, n: int = 1) -> None:
+    if transfer_block_errors is not None and n:
+        transfer_block_errors.labels(kind=kind).inc(n)
+
+
+def count_transfer_hedge() -> None:
+    if transfer_hedges is not None:
+        transfer_hedges.inc()
+
+
+def count_breaker_transition(state: str) -> None:
+    if transfer_breaker_transitions is not None:
+        transfer_breaker_transitions.labels(state=state).inc()
 
 
 def observe_stage(plane: str, stage: str, seconds: float) -> None:

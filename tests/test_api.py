@@ -194,6 +194,50 @@ class TestHttp:
             service.stop()
             # stop() is safe even if start() never ran in a failed test.
 
+    def test_readyz_transfer_section_reports_breakers(self):
+        """The `transfer` section surfaces the wired client's per-peer
+        breaker state + failure memory; absent a transfer plane it is
+        null (and never conjures one into the process)."""
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from llm_d_kv_cache_manager_tpu.kv_connectors.connector import (
+            TransferClient,
+            TransferClientConfig,
+        )
+
+        service = self._service()
+        client_obj = TransferClient(TransferClientConfig(
+            breaker_failure_threshold=1, breaker_cooldown_s=60.0,
+        ))
+        # Seed per-peer state without touching any socket.
+        client_obj.note_result("10.9.9.9", 7, ok=False, latency_s=0.2)
+        client_obj.note_result(
+            "10.9.9.8", 7, ok=True, latency_s=0.01, corrupt_blocks=2,
+            blocks=4,
+        )
+        service.transfer_client = client_obj
+
+        async def run():
+            async with TestClient(TestServer(service.make_app())) as client:
+                service.start(with_subscriber=False)
+                resp = await client.get("/readyz")
+                data = await resp.json()
+                section = data["transfer"]
+                dead = section["peers"]["10.9.9.9:7"]
+                assert dead["state"] == "open"  # threshold 1: one strike
+                assert dead["consecutive_failures"] == 1
+                corrupt = section["peers"]["10.9.9.8:7"]
+                assert corrupt["corrupt_blocks"] == 2
+                assert corrupt["ewma_fetch_latency_ms"] == 10.0
+                assert section["breaker"]["failure_threshold"] == 1
+                # Breaker state never gates THIS process's readiness.
+                assert resp.status == 200
+
+        try:
+            asyncio.run(run())
+        finally:
+            service.stop()
+
     def test_score_chat_completions_renders_template(self):
         from aiohttp.test_utils import TestClient, TestServer
 

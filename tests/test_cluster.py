@@ -486,7 +486,7 @@ class TestSnapshotRoundTrip:
 
         data = encode_snapshot(IndexView(), {})
         snap = decode_snapshot(data)
-        assert snap.version == 1
+        assert snap.version == 2
         with pytest.raises(SnapshotFormatError):
             decode_snapshot(b"NOTASNAP" + data)
         # Flip the version byte (first CBOR uint after the magic+array head).
@@ -498,6 +498,66 @@ class TestSnapshotRoundTrip:
             decode_snapshot(bytes(bad))
         with pytest.raises(SnapshotFormatError):
             decode_snapshot(data[:-3])  # truncated
+
+    def test_snapshot_checksum_catches_bit_flips_and_torn_tails(self):
+        """Every v2 snapshot carries a trailing FNV-1a 64 of its CBOR body:
+        a bit-flip anywhere in the document (even one that still decodes as
+        valid CBOR) fails LOUDLY instead of warm-restarting a silently
+        corrupt index view."""
+        from llm_d_kv_cache_manager_tpu.cluster.snapshot import SNAPSHOT_MAGIC
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import IndexView
+
+        view = IndexView(
+            entries=[(TEST_MODEL_NAME, 42, (("pod-0", "hbm"),))],
+            engine_map=[],
+        )
+        data = encode_snapshot(view, {("pod-0", "t"): 7})
+        assert decode_snapshot(data).seq_counters == {("pod-0", "t"): 7}
+        # Flip one payload bit (a seq value byte): still-valid CBOR, wrong
+        # content — the checksum is the only thing that can catch it.
+        for flip_at in range(len(SNAPSHOT_MAGIC) + 2, len(data) - 8, 7):
+            bad = bytearray(data)
+            bad[flip_at] ^= 0x01
+            with pytest.raises(SnapshotFormatError):
+                decode_snapshot(bytes(bad))
+        # Torn checksum tail.
+        with pytest.raises(SnapshotFormatError) as err:
+            decode_snapshot(data[:-1])
+        assert "checksum" in str(err.value)
+        # Flipped checksum itself.
+        bad = bytearray(data)
+        bad[-1] ^= 0xFF
+        with pytest.raises(SnapshotFormatError):
+            decode_snapshot(bytes(bad))
+
+    def test_v1_snapshot_without_checksum_still_loads(self):
+        """Pre-integrity snapshot files (version 1, no trailing checksum)
+        must keep loading — a fleet upgrades its snapshot format without
+        losing its last warm-restart point."""
+        from llm_d_kv_cache_manager_tpu.cluster.snapshot import SNAPSHOT_MAGIC
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import IndexView
+        from llm_d_kv_cache_manager_tpu.utils import cbor
+
+        v2 = encode_snapshot(
+            IndexView(entries=[(TEST_MODEL_NAME, 5, (("pod-1", "hbm"),))],
+                      engine_map=[]),
+            {("pod-1", "t"): 3},
+        )
+        doc, _end = cbor.decode(v2, len(SNAPSHOT_MAGIC))
+        doc[0] = 1  # re-encode as the v1 writer would have (no checksum)
+        body = bytearray()
+        cbor.encode_into(doc, body)
+        v1 = SNAPSHOT_MAGIC + bytes(body)
+        snap = decode_snapshot(v1)
+        assert snap.version == 1
+        assert snap.seq_counters == {("pod-1", "t"): 3}
+        assert snap.view.entries == [
+            (TEST_MODEL_NAME, 5, (("pod-1", "hbm"),))
+        ]
+        # v1 carries no checksum, so a v1 bit-flip is NOT detectable —
+        # but a trailing-garbage v1 file still errors.
+        with pytest.raises(SnapshotFormatError):
+            decode_snapshot(v1 + b"xx")
 
     def test_atomic_write_leaves_no_tmp(self, tmp_path):
         source = InMemoryIndex(InMemoryIndexConfig(size=64))

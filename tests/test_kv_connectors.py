@@ -95,12 +95,90 @@ class TestTransferEngine:
         never a hang (the seed raised here and hung on a stuck socket)."""
         client = TransferClient(TransferClientConfig(
             connect_timeout_ms=300, io_timeout_ms=300, retries=1,
+            breaker_failure_threshold=0,
         ))
         before = client.stats["failures"]
         assert client.fetch_one("127.0.0.1", 1, 1, 64) is None  # port 1: dead
         assert client.stats["failures"] == before + 1
         # The module-level helper shares the same None-on-failure contract.
         assert fetch_block("127.0.0.1", 1, 1, 64) is None
+
+    def test_breaker_opens_on_dead_peer_then_skips_without_connecting(self):
+        """Consecutive connect failures open the peer's breaker; further
+        fetches return instantly (no connect timeout paid) until the
+        cooldown's half-open probe."""
+        import time as _time
+
+        client = TransferClient(TransferClientConfig(
+            connect_timeout_ms=200, io_timeout_ms=200, retries=0,
+            breaker_failure_threshold=2, breaker_cooldown_s=60.0,
+        ))
+        try:
+            for _ in range(2):
+                assert client.fetch_many("127.0.0.1", 1, [1, 2], 64) == [
+                    None, None,
+                ]
+            state = client.peer_state("127.0.0.1", 1)
+            assert state.breaker.state == "open"
+            t0 = _time.monotonic()
+            assert client.fetch_many("127.0.0.1", 1, [3], 64) == [None]
+            # An open breaker skips instantly instead of paying the
+            # 200ms connect timeout again.
+            assert _time.monotonic() - t0 < 0.1
+            assert client.stats["breaker_skipped_blocks"] == 1
+        finally:
+            client.close()
+
+    def test_end_to_end_corruption_detected_and_counted(self):
+        """A put-time-checksummed block corrupted in server RAM comes back
+        as a miss on the pooled client (v2 wire), with the corruption
+        counted and charged to the peer's breaker."""
+        server = BlockTransferServer()
+        client = TransferClient(TransferClientConfig(
+            breaker_failure_threshold=0,
+        ))
+        try:
+            data = os.urandom(1024)
+            server.put(11, data)
+            assert client.fetch_one("127.0.0.1", server.port, 11, 4096) == data
+            assert server.corrupt(11)
+            assert client.fetch_one(
+                "127.0.0.1", server.port, 11, 4096
+            ) is None
+            assert client.stats["corrupt_blocks"] == 1
+            peer = client.peer_state("127.0.0.1", server.port)
+            assert peer.corrupt_blocks == 1
+        finally:
+            client.close()
+            server.close()
+
+    def test_hedged_fetch_wins_from_second_holder_when_primary_dead(self):
+        """Two real holders of the same chain: with the primary gone, the
+        hedged fetch returns the second holder's (byte-identical) payloads
+        — exactly once each, never doubled."""
+        pod_a = BlockTransferServer()
+        pod_b = BlockTransferServer()
+        data = {h: os.urandom(256 + h) for h in (1, 2, 3)}
+        for h, payload in data.items():
+            pod_a.put(h, payload)
+            pod_b.put(h, payload)
+        port_a = pod_a.port
+        pod_a.close()  # primary dies
+        client = TransferClient(TransferClientConfig(
+            connect_timeout_ms=200, io_timeout_ms=200, retries=0,
+            breaker_failure_threshold=0,
+        ))
+        try:
+            out = client.fetch_many_hedged(
+                [("127.0.0.1", port_a), ("127.0.0.1", pod_b.port)],
+                [1, 2, 3], 4096,
+            )
+            assert out == [data[1], data[2], data[3]]
+            assert client.stats["hedges"] >= 1
+            assert client.stats["hedge_wins"] == 1
+        finally:
+            client.close()
+            pod_b.close()
 
     def test_batched_fetch_matches_serial_byte_for_byte(self):
         """The multi-block protocol is a pure batching of the single-block

@@ -117,6 +117,16 @@ def test_collectors_exist():
     # inside the walk so their label bounds stay enforced.
     assert "trace_carrier_errors" in collectors
     assert "slo_burn_rate" in collectors
+    # Chaos-hardened data plane (kv_connectors/): end-to-end corruption
+    # detections, per-block error outcomes by bounded kind, hedged
+    # fetches, and per-peer breaker transitions by bounded state — all
+    # inside the walk so their label bounds stay enforced. Previously the
+    # -3/-4 per-block statuses vanished into a single opaque failure
+    # counter.
+    assert "transfer_corrupt_blocks" in collectors
+    assert "transfer_block_errors" in collectors
+    assert "transfer_hedges" in collectors
+    assert "transfer_breaker_transitions" in collectors
 
 
 def test_prefetch_drop_source_values_are_code_defined():
@@ -136,6 +146,49 @@ def test_prefetch_drop_source_values_are_code_defined():
             if source is not None:
                 assert source in PREFETCH_SOURCES, (
                     f"unexpected prefetch source {source!r}"
+                )
+
+
+def test_transfer_block_error_kind_values_are_code_defined():
+    """The transfer_block_errors `kind` label carries only the fixed
+    per-block outcome vocabulary (transport/oversized/corrupt/
+    breaker_open) — wire statuses, never traffic."""
+    from llm_d_kv_cache_manager_tpu.kv_connectors.connector import (
+        TRANSFER_ERROR_KINDS,
+    )
+
+    assert set(TRANSFER_ERROR_KINDS) == {
+        "transport", "oversized", "corrupt", "breaker_open",
+    }
+    metrics.register_metrics()
+    for metric in REGISTRY.collect():
+        if metric.name != "kvcache_transfer_block_errors":
+            continue
+        for sample in metric.samples:
+            kind = sample.labels.get("kind")
+            if kind is not None:
+                assert kind in TRANSFER_ERROR_KINDS, (
+                    f"unexpected transfer error kind {kind!r}"
+                )
+
+
+def test_transfer_breaker_state_label_values_are_code_defined():
+    """The breaker-transition `state` label carries only the fixed
+    breaker vocabulary (closed/open/half_open)."""
+    from llm_d_kv_cache_manager_tpu.kv_connectors.connector import (
+        BREAKER_STATES,
+    )
+
+    assert set(BREAKER_STATES) == {"closed", "open", "half_open"}
+    metrics.register_metrics()
+    for metric in REGISTRY.collect():
+        if metric.name != "kvcache_transfer_breaker_transitions":
+            continue
+        for sample in metric.samples:
+            state = sample.labels.get("state")
+            if state is not None:
+                assert state in BREAKER_STATES, (
+                    f"unexpected breaker state {state!r}"
                 )
 
 

@@ -1,0 +1,565 @@
+"""Chaos-hardened data plane: breaker/hedge/injector policy tests.
+
+Everything in this file runs WITHOUT libkvtransfer.so: the breaker state
+machine is pure policy, and the hedge/integrity/injector logic is driven
+through `_ScriptedClient`, a TransferClient whose `_transport_fetch` seam
+is scripted per peer (the same seam the chaos fault injector and the ASan
+wire tests exercise with real bytes). The byte-moving counterparts live in
+tests/test_transfer_wire_fuzz.py and test_kv_connectors.py (`transfer`/
+`chaos`-marked, auto-skipped until `make kvtransfer`).
+"""
+
+import threading
+import time
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.kv_connectors.connector import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    PeerBreaker,
+    TransferClient,
+    TransferClientConfig,
+    _CORRUPT,
+    _OVERSIZED,
+)
+from llm_d_kv_cache_manager_tpu.kv_connectors.faults import (
+    FaultyTransport,
+    PeerTransferFaults,
+    TransferFaultPlan,
+)
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- breaker state machine -----------------------------------------------------
+
+
+class TestPeerBreaker:
+    def test_opens_on_consecutive_failures_only(self):
+        b = PeerBreaker(failure_threshold=3, cooldown_s=10.0)
+        assert b.allow(0.0) == (True, None)
+        assert b.record_failure(0.0) is None
+        assert b.record_failure(0.1) is None
+        # A success resets the consecutive count: no transition at 3 total.
+        assert b.record_success(0.2) is None
+        assert b.record_failure(0.3) is None
+        assert b.record_failure(0.4) is None
+        assert b.record_failure(0.5) == (BREAKER_CLOSED, BREAKER_OPEN)
+        assert b.state == BREAKER_OPEN
+        assert b.opens == 1
+
+    def test_open_blocks_until_cooldown_then_single_probe(self):
+        b = PeerBreaker(failure_threshold=1, cooldown_s=5.0)
+        b.record_failure(0.0)
+        assert b.state == BREAKER_OPEN
+        assert b.allow(1.0) == (False, None)
+        assert b.allow(4.999) == (False, None)
+        allowed, transition = b.allow(5.0)
+        assert allowed and transition == (BREAKER_OPEN, BREAKER_HALF_OPEN)
+        # Half-open admits exactly ONE probe; others are refused until the
+        # probe resolves.
+        assert b.allow(5.1) == (False, None)
+        assert b.allow(5.2) == (False, None)
+
+    def test_probe_success_closes_probe_failure_reopens(self):
+        b = PeerBreaker(failure_threshold=1, cooldown_s=5.0)
+        b.record_failure(0.0)
+        b.allow(5.0)  # the probe
+        assert b.record_success(5.1) == (BREAKER_HALF_OPEN, BREAKER_CLOSED)
+        assert b.state == BREAKER_CLOSED
+        assert b.allow(5.2) == (True, None)
+
+        b.record_failure(6.0)  # threshold 1: straight back open
+        assert b.state == BREAKER_OPEN
+        b.allow(11.0)  # half-open probe
+        assert b.record_failure(11.1) == (BREAKER_HALF_OPEN, BREAKER_OPEN)
+        # Fresh cooldown from the failed probe.
+        assert b.allow(15.0) == (False, None)
+        allowed, _t = b.allow(16.2)
+        assert allowed
+
+    def test_transitions_deterministic_under_injected_clock(self):
+        """Same clock schedule -> same transition sequence, twice."""
+
+        def run():
+            b = PeerBreaker(failure_threshold=2, cooldown_s=3.0)
+            log = []
+            schedule = [
+                ("fail", 0.0), ("fail", 0.5), ("allow", 1.0),
+                ("allow", 3.6), ("fail", 3.7), ("allow", 6.8),
+                ("ok", 6.9), ("allow", 7.0),
+            ]
+            for op, t in schedule:
+                if op == "fail":
+                    tr = b.record_failure(t)
+                elif op == "ok":
+                    tr = b.record_success(t)
+                else:
+                    _allowed, tr = b.allow(t)
+                if tr is not None:
+                    log.append((t, tr))
+            return log, b.state, b.opens
+
+        assert run() == run()
+
+    def test_disabled_breaker_never_opens(self):
+        b = PeerBreaker(failure_threshold=0, cooldown_s=1.0)
+        for i in range(50):
+            assert b.record_failure(float(i)) is None
+        assert b.allow(100.0) == (True, None)
+        assert b.state == BREAKER_CLOSED
+
+
+# -- scripted client: breaker + integrity + hedging through the real paths ----
+
+
+class _ScriptedClient(TransferClient):
+    """TransferClient with a scripted `_transport_fetch`: per (host, port),
+    a list of outcomes consumed one per call. Outcome forms:
+      ("ok", [entries...])  — entries may be bytes/None/_CORRUPT/_OVERSIZED
+      ("fail",)             — total transport failure
+      ("slow", seconds, [entries...]) — sleeps (real time) then succeeds
+    An exhausted script repeats its last outcome.
+    """
+
+    def __init__(self, script, **kwargs):
+        super().__init__(**kwargs)
+        self.script = {k: list(v) for k, v in script.items()}
+        self.calls = []
+
+    def _has_client_api(self):
+        return True
+
+    def _transport_fetch(self, host, port, hashes, max_size):
+        self.calls.append((host, port, tuple(hashes)))
+        outcomes = self.script[(host, port)]
+        outcome = outcomes.pop(0) if len(outcomes) > 1 else outcomes[0]
+        if outcome[0] == "slow":
+            time.sleep(outcome[1])
+            outcome = ("ok", outcome[2])
+        if outcome[0] == "fail":
+            return False, None
+        entries = list(outcome[1])
+        # Shape-flexible: scripts give a payload pool; the reply is
+        # aligned with however many hashes the call asked for.
+        while len(entries) < len(hashes):
+            entries.append(entries[-1] if entries else None)
+        return True, entries[: len(hashes)]
+
+
+PEER_A = ("10.0.0.1", 9)
+PEER_B = ("10.0.0.2", 9)
+
+
+class TestClientBreakerIntegration:
+    def test_consecutive_failures_open_then_skip_instantly(self):
+        clock = _Clock()
+        client = _ScriptedClient(
+            {PEER_A: [("fail",)]},
+            config=TransferClientConfig(
+                breaker_failure_threshold=3, breaker_cooldown_s=10.0,
+                retries=0,
+            ),
+            clock=clock,
+        )
+        for _ in range(3):
+            assert client.fetch_many(*PEER_A, [1, 2], 64) == [None, None]
+            clock.advance(0.1)
+        assert client.peer_state(*PEER_A).breaker.state == BREAKER_OPEN
+        calls_before = len(client.calls)
+        # Open: the transport is never touched; blocks come back as
+        # instant (counted) misses.
+        assert client.fetch_many(*PEER_A, [3, 4, 5], 64) == [None] * 3
+        assert len(client.calls) == calls_before
+        assert client.stats["breaker_skipped_blocks"] == 3
+
+    def test_half_open_probe_recovers_after_cooldown(self):
+        clock = _Clock()
+        client = _ScriptedClient(
+            {PEER_A: [("fail",), ("ok", [b"x"])]},
+            config=TransferClientConfig(
+                breaker_failure_threshold=1, breaker_cooldown_s=5.0,
+                retries=0,
+            ),
+            clock=clock,
+        )
+        transitions = []
+        client.on_breaker_transition = (
+            lambda peer, old, new: transitions.append((old, new))
+        )
+        assert client.fetch_many(*PEER_A, [1], 64) == [None]
+        clock.advance(5.5)
+        assert client.fetch_many(*PEER_A, [1], 64) == [b"x"]
+        assert client.peer_state(*PEER_A).breaker.state == BREAKER_CLOSED
+        assert (BREAKER_OPEN, BREAKER_HALF_OPEN) in transitions
+        assert (BREAKER_HALF_OPEN, BREAKER_CLOSED) in transitions
+
+    def test_corruption_counts_as_breaker_failure_and_never_lands(self):
+        clock = _Clock()
+        client = _ScriptedClient(
+            {PEER_A: [("ok", [b"good", _CORRUPT])]},
+            config=TransferClientConfig(
+                breaker_failure_threshold=2, breaker_cooldown_s=5.0,
+            ),
+            clock=clock,
+        )
+        out = client.fetch_many(*PEER_A, [1, 2], 64)
+        assert out == [b"good", None]  # corrupt block = a miss, never bytes
+        assert client.stats["corrupt_blocks"] == 1
+        breaker = client.peer_state(*PEER_A).breaker
+        assert breaker.consecutive_failures == 1
+        out = client.fetch_many(*PEER_A, [1, 2], 64)
+        assert breaker.state == BREAKER_OPEN  # corruption opened it
+
+    def test_oversized_blocks_drop_without_breaker_failure(self):
+        client = _ScriptedClient(
+            {PEER_A: [("ok", [_OVERSIZED, b"ok"])]},
+            config=TransferClientConfig(breaker_failure_threshold=2),
+            clock=_Clock(),
+        )
+        assert client.fetch_many(*PEER_A, [1, 2], 64) == [None, b"ok"]
+        assert client.stats["oversized_blocks"] == 1
+        assert client.peer_state(*PEER_A).breaker.consecutive_failures == 0
+
+    def test_latency_ewma_tracks_successes_only(self):
+        clock = _Clock()
+        client = _ScriptedClient(
+            {PEER_A: [("ok", [b"x"])]},
+            config=TransferClientConfig(), clock=clock,
+        )
+
+        real = client._transport_fetch
+
+        def timed(host, port, hashes, max_size):
+            clock.advance(0.010)  # the fetch "takes" 10ms of clock
+            return real(host, port, hashes, max_size)
+
+        client._transport_fetch = timed
+        for _ in range(5):
+            client.fetch_many(*PEER_A, [1], 64)
+        peer = client.peer_state(*PEER_A)
+        assert peer.lat_n == 5
+        assert peer.lat_ewma == pytest.approx(0.010)
+        # Hedge delay floors at the config floor but tracks the profile.
+        assert client.hedge_delay_s(*PEER_A) >= 0.010
+
+
+class TestHedgedFetch:
+    def test_primary_complete_wins_no_hedge(self):
+        client = _ScriptedClient(
+            {PEER_A: [("ok", [b"a1", b"a2"])], PEER_B: [("ok", [b"b1", b"b2"])]},
+            config=TransferClientConfig(), clock=_Clock(),
+        )
+        out = client.fetch_many_hedged([PEER_A, PEER_B], [1, 2], 64)
+        assert out == [b"a1", b"a2"]
+        assert client.stats["hedges"] == 0
+        # The backup was never fetched.
+        assert all(call[0] == PEER_A[0] for call in client.calls)
+
+    def test_slow_primary_loses_to_hedge_and_loser_is_discarded(self):
+        client = _ScriptedClient(
+            {
+                PEER_A: [("slow", 0.25, [b"a1", b"a2"])],
+                PEER_B: [("ok", [b"b1", b"b2"])],
+            },
+            config=TransferClientConfig(
+                hedge_delay_floor_s=0.02, hedge_delay_cap_s=0.02
+            ),
+        )
+        out = client.fetch_many_hedged([PEER_A, PEER_B], [1, 2], 64)
+        assert out == [b"b1", b"b2"]  # first valid reply wins
+        assert client.stats["hedges"] == 1
+        assert client.stats["hedge_wins"] == 1
+        # The loser's reply arrives later and is dropped on the floor —
+        # never merged, never double-landed.
+        time.sleep(0.3)
+        assert out == [b"b1", b"b2"]
+
+    def test_failed_primary_falls_back_without_waiting_for_timer(self):
+        client = _ScriptedClient(
+            {PEER_A: [("fail",)], PEER_B: [("ok", [b"b"])]},
+            config=TransferClientConfig(
+                retries=0, hedge_delay_floor_s=5.0, hedge_delay_cap_s=5.0
+            ),
+        )
+        t0 = time.monotonic()
+        out = client.fetch_many_hedged([PEER_A, PEER_B], [7], 64)
+        assert out == [b"b"]
+        # The primary ANSWERED (with a failure) — the hedge fires on the
+        # reply, not on the 5s timer.
+        assert time.monotonic() - t0 < 2.0
+        assert client.stats["hedges"] == 1
+
+    def test_all_holders_fail_returns_most_covered(self):
+        client = _ScriptedClient(
+            {
+                PEER_A: [("ok", [b"a", None, None])],
+                PEER_B: [("ok", [b"b1", b"b2", None])],
+            },
+            config=TransferClientConfig(
+                hedge_delay_floor_s=0.01, hedge_delay_cap_s=0.01
+            ),
+        )
+        out = client.fetch_many_hedged([PEER_A, PEER_B], [1, 2, 3], 64)
+        assert out == [b"b1", b"b2", None]  # most blocks covered wins
+
+    def test_single_holder_is_a_plain_fetch(self):
+        client = _ScriptedClient(
+            {PEER_A: [("ok", [b"x"])]}, config=TransferClientConfig(),
+            clock=_Clock(),
+        )
+        assert client.fetch_many_hedged([PEER_A], [1], 64) == [b"x"]
+        assert client.stats["hedges"] == 0
+
+    def test_result_always_aligned_with_request(self):
+        """Property: whatever the script does, the hedged result has
+        exactly one slot per requested hash (never doubled, never
+        truncated)."""
+        import random
+
+        rng = random.Random(7)
+        for trial in range(20):
+            n = rng.randint(1, 6)
+
+            def entries():
+                return [
+                    rng.choice([b"p", None, _CORRUPT]) for _ in range(n)
+                ]
+
+            client = _ScriptedClient(
+                {
+                    PEER_A: [rng.choice([("fail",), ("ok", entries())])],
+                    PEER_B: [rng.choice([("fail",), ("ok", entries())])],
+                },
+                config=TransferClientConfig(
+                    retries=0, hedge_delay_floor_s=0.001,
+                    hedge_delay_cap_s=0.001,
+                ),
+            )
+            out = client.fetch_many_hedged(
+                [PEER_A, PEER_B], list(range(n)), 64
+            )
+            assert len(out) == n
+            assert all(p is None or isinstance(p, bytes) for p in out)
+
+
+# -- fault injector ------------------------------------------------------------
+
+
+def _scripted_ok(payloads):
+    return {PEER_A: [("ok", payloads)], PEER_B: [("ok", payloads)]}
+
+
+class TestFaultyTransport:
+    def _make(self, faults, verify=True, clock=None, script=None,
+              breaker_threshold=3):
+        clock = clock or _Clock()
+        inner = _ScriptedClient(
+            script or _scripted_ok([b"x1", b"x2", b"x3", b"x4"]),
+            config=TransferClientConfig(
+                retries=0, io_timeout_ms=1000, connect_timeout_ms=500,
+                breaker_failure_threshold=breaker_threshold,
+                breaker_cooldown_s=5.0,
+            ),
+            clock=clock,
+        )
+        plan = TransferFaultPlan(seed=11, peers={PEER_A: faults})
+        return FaultyTransport(
+            inner, plan, clock=clock, verify_integrity=verify
+        ), clock
+
+    def test_corruption_detected_with_integrity_on(self):
+        ft, _clock = self._make(PeerTransferFaults(corrupt_rate=1.0))
+        out = ft.fetch_many(*PEER_A, [1, 2, 3, 4], 64)
+        assert out == [None] * 4  # every corrupt block degraded to a miss
+        assert ft.counters["corrupt_injected"] == 4
+        assert ft.counters["corrupt_detected"] == 4
+        assert ft.counters["corrupt_admitted"] == 0
+        assert ft.inner.stats["corrupt_blocks"] == 4
+
+    def test_corruption_admitted_with_integrity_off(self):
+        """The v1-wire control: damage sails through — the failure mode
+        the checksum kills."""
+        ft, _clock = self._make(
+            PeerTransferFaults(corrupt_rate=1.0), verify=False
+        )
+        out = ft.fetch_many(*PEER_A, [1, 2, 3, 4], 64)
+        assert out == [b"x1", b"x2", b"x3", b"x4"]  # wrong bytes, landed
+        assert ft.counters["corrupt_admitted"] == 4
+        assert ft.counters["corrupt_detected"] == 0
+
+    def test_unfaulted_peer_passes_through_untouched(self):
+        ft, _clock = self._make(PeerTransferFaults(corrupt_rate=1.0))
+        assert ft.fetch_many(*PEER_B, [1, 2, 3, 4], 64) == [
+            b"x1", b"x2", b"x3", b"x4"
+        ]
+        assert ft.counters["corrupt_injected"] == 0
+
+    def test_stall_charges_timeout_ladder_and_feeds_breaker(self):
+        ft, clock = self._make(
+            PeerTransferFaults(stall_from_s=1.0, stall_until_s=9.0),
+            breaker_threshold=0,  # disabled: every fetch pays the ladder
+        )
+        clock.t = 0.5
+        assert ft.fetch_many(*PEER_A, [1], 64)[0] is not None  # pre-window
+        clock.t = 2.0
+        for _ in range(3):
+            assert ft.fetch_many(*PEER_A, [1, 2], 64) == [None, None]
+        assert ft.counters["stalled_fetches"] == 3
+        # retries=0, io_timeout 1000ms -> 1.0s charged per stalled fetch.
+        assert ft.take_charge() == pytest.approx(3.0)
+        assert ft.take_charge() == 0.0  # drained
+
+    def test_breaker_caps_the_stall_cost(self):
+        ft, clock = self._make(
+            PeerTransferFaults(stall_from_s=0.0, stall_until_s=100.0),
+            breaker_threshold=3,
+        )
+        for i in range(10):
+            clock.t = float(i) * 0.1
+            ft.fetch_many(*PEER_A, [1], 64)
+        # 3 ladders to open, then instant skips.
+        assert ft.counters["stalled_fetches"] == 3
+        assert ft.counters["breaker_skipped_fetches"] == 7
+        assert ft.take_charge() == pytest.approx(3.0)
+
+    def test_flap_windows_and_recovery(self):
+        ft, clock = self._make(
+            PeerTransferFaults(
+                flap_from_s=0.0, flap_period_s=10.0, flap_down_frac=0.5
+            ),
+            breaker_threshold=0,
+        )
+        clock.t = 2.0  # down phase
+        assert ft.fetch_many(*PEER_A, [1], 64) == [None]
+        clock.t = 7.0  # up phase
+        assert ft.fetch_many(*PEER_A, [1], 64)[0] is not None
+        clock.t = 12.0  # down again
+        assert ft.fetch_many(*PEER_A, [1], 64) == [None]
+
+    def test_blackhole_charges_connect_ladder(self):
+        ft, clock = self._make(
+            PeerTransferFaults(blackhole_from_s=0.0),
+            breaker_threshold=0,
+        )
+        ft.fetch_many(*PEER_A, [1], 64)
+        assert ft.counters["blackholed_fetches"] == 1
+        assert ft.take_charge() == pytest.approx(0.5)  # connect 500ms
+
+    def test_seeded_corruption_is_deterministic(self):
+        def run():
+            ft, _clock = self._make(PeerTransferFaults(corrupt_rate=0.5))
+            outcomes = []
+            for i in range(20):
+                outcomes.append(
+                    tuple(
+                        p is None
+                        for p in ft.fetch_many(*PEER_A, [1, 2, 3, 4], 64)
+                    )
+                )
+            return outcomes, dict(ft.counters)
+
+        assert run() == run()
+
+    def test_self_addr_is_exempt(self):
+        clock = _Clock()
+        inner = _ScriptedClient(
+            _scripted_ok([b"x"]),
+            config=TransferClientConfig(retries=0), clock=clock,
+        )
+        plan = TransferFaultPlan(
+            seed=1, peers={PEER_A: PeerTransferFaults(stall_from_s=0.0)}
+        )
+        ft = FaultyTransport(inner, plan, clock=clock, self_addr=PEER_A)
+        # Loopback restores bypass the peer's fault windows: a stalled NIC
+        # doesn't break a pod's fetches from its own host store.
+        assert ft.fetch_many(*PEER_A, [1], 64) == [b"x"]
+
+
+# -- fleethealth feed ----------------------------------------------------------
+
+
+def test_tracker_records_transfer_breaker_transitions():
+    from llm_d_kv_cache_manager_tpu.fleethealth import (
+        FleetHealthConfig,
+        FleetHealthTracker,
+    )
+
+    clock = _Clock()
+    tracker = FleetHealthTracker(FleetHealthConfig(), clock=clock)
+    tracker.observe_transfer_breaker("10.0.0.1:9", "closed", "open")
+    clock.advance(3.0)
+    tracker.observe_transfer_breaker("10.0.0.1:9", "open", "half_open")
+    tracker.observe_transfer_breaker("10.0.0.1:9", "half_open", "closed")
+    summary = tracker.summary(now=clock())
+    rec = summary["transfer_breakers"]["10.0.0.1:9"]
+    assert rec["state"] == "closed"
+    assert rec["transitions"] == 3
+    assert rec["opens"] == 1
+    # And through the client callback end-to-end.
+    client = _ScriptedClient(
+        {PEER_A: [("fail",)]},
+        config=TransferClientConfig(
+            breaker_failure_threshold=1, retries=0
+        ),
+        clock=clock,
+        on_breaker_transition=tracker.observe_transfer_breaker,
+    )
+    client.fetch_many(*PEER_A, [1], 64)
+    assert (
+        tracker.transfer_breaker_summary()[f"{PEER_A[0]}:{PEER_A[1]}"]["state"]
+        == "open"
+    )
+
+
+# -- status surfaces -----------------------------------------------------------
+
+
+def test_client_status_reports_peers_and_counters():
+    clock = _Clock()
+    client = _ScriptedClient(
+        {PEER_A: [("ok", [b"x", _CORRUPT])], PEER_B: [("fail",)]},
+        config=TransferClientConfig(
+            breaker_failure_threshold=2, retries=0
+        ),
+        clock=clock,
+    )
+    client.fetch_many(*PEER_A, [1, 2], 64)
+    client.fetch_many(*PEER_B, [3], 64)
+    status = client.status()
+    assert status["verify_integrity"] in (True, False)
+    a = status["peers"]["10.0.0.1:9"]
+    b = status["peers"]["10.0.0.2:9"]
+    assert a["corrupt_blocks"] == 1 and a["consecutive_failures"] == 1
+    assert b["failures"] == 1
+    assert status["stats"]["corrupt_blocks"] == 1
+    assert status["breaker"]["failure_threshold"] == 2
+
+
+def test_faulty_transport_status_embeds_injector_counters():
+    clock = _Clock()
+    inner = _ScriptedClient(
+        _scripted_ok([b"x"]), config=TransferClientConfig(), clock=clock
+    )
+    ft = FaultyTransport(
+        inner,
+        TransferFaultPlan(
+            seed=1, peers={PEER_A: PeerTransferFaults(corrupt_rate=1.0)}
+        ),
+        clock=clock,
+    )
+    ft.fetch_many(*PEER_A, [1], 64)
+    status = ft.status()
+    assert status["injected_faults"]["corrupt_detected"] == 1
+    assert "peers" in status

@@ -1,0 +1,301 @@
+"""KVTM/KVTC wire-framing fuzz: hostile bytes never crash the data plane.
+
+The event-plane mirror is tests/test_event_wire_fuzz.py; this is the same
+stance for the TRANSFER wire. Two directions:
+
+- **Client vs hostile server**: a fake "server" (raw Python socket)
+  answers fetches with truncated frames, wrong magics, random garbage,
+  hostile length fields, and wrong checksums. The client must come back
+  with None/error statuses within its timeout budget — never crash, never
+  hang past the bound, never allocate from a wire-supplied length (the
+  C client only ever writes into the caller's buffer and drains the rest
+  through a fixed scratch).
+- **Server vs hostile client**: random garbage frames against the real
+  C++ server must leave it serving (a good fetch works afterwards).
+
+Plus the end-to-end integrity leg the fuzz exists to protect: a stored
+block corrupted in server RAM (kvt_server_corrupt — checksum NOT updated)
+must come back as a detected miss on the v2 wire while the v1 wire
+delivers the wrong bytes (the failure mode v2 kills).
+"""
+
+import os
+import random
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.kv_connectors.connector import (
+    BlockTransferServer,
+    TransferClient,
+    TransferClientConfig,
+)
+
+pytestmark = [pytest.mark.transfer, pytest.mark.chaos]
+
+MAGIC_SINGLE = 0x4B565442  # 'KVTB'
+MAGIC_MULTI = 0x4B56544D   # 'KVTM'
+MAGIC_MULTI2 = 0x4B565443  # 'KVTC'
+
+
+class _HostileServer:
+    """One-shot scripted TCP endpoint: accepts connections and answers
+    every request with the scripted bytes (ignoring what was asked)."""
+
+    def __init__(self, reply: bytes, close_after: bool = True):
+        self.reply = reply
+        self.close_after = close_after
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.port = self.sock.getsockname()[1]
+        self._stop = False
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+        self.thread.start()
+
+    def _loop(self):
+        while not self._stop:
+            try:
+                conn, _addr = self.sock.accept()
+            except OSError:
+                return
+            try:
+                conn.settimeout(2.0)
+                try:
+                    conn.recv(65536)  # swallow the request
+                except OSError:
+                    pass
+                if self.reply:
+                    conn.sendall(self.reply)
+                if self.close_after:
+                    conn.close()
+                else:
+                    time.sleep(2.0)
+                    conn.close()
+            except OSError:
+                pass
+
+    def close(self):
+        self._stop = True
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.sock.close()
+
+
+def _client(timeout_ms=400, verify=True):
+    return TransferClient(TransferClientConfig(
+        connect_timeout_ms=timeout_ms,
+        io_timeout_ms=timeout_ms,
+        retries=0,
+        verify_integrity=verify,
+        breaker_failure_threshold=0,  # fuzz every frame, no skipping
+    ))
+
+
+def _v2_frame(blocks):
+    """Well-formed v2 reply for `blocks`: list of (status, payload,
+    checksum_override|None)."""
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.hashing import fnv64a
+
+    out = struct.pack("<I", MAGIC_MULTI2)
+    for status, payload, checksum in blocks:
+        if checksum is None:
+            checksum = fnv64a(payload)
+        out += struct.pack("<BQQ", status, len(payload), checksum)
+        out += payload
+    return out
+
+
+HOSTILE_REPLIES = [
+    b"",  # connection closed with no reply
+    b"\x00",  # truncated magic
+    struct.pack("<I", 0xDEADBEEF),  # wrong magic
+    struct.pack("<I", MAGIC_MULTI2),  # magic then EOF
+    struct.pack("<I", MAGIC_MULTI2) + b"\x00",  # truncated header
+    # status ok, huge length field, no payload: the drain must hit the
+    # timeout/EOF bound, never allocate 2^60 bytes.
+    struct.pack("<IBQQ", MAGIC_MULTI2, 0, 1 << 60, 0),
+    # status ok, plausible length, truncated payload.
+    struct.pack("<IBQQ", MAGIC_MULTI2, 0, 4096, 0) + b"xx",
+    # valid frame with a WRONG checksum (detected corrupt, not an error).
+    _v2_frame([(0, b"payload-bytes", 0x1234)]),
+    # v1 magic answered to a v2 request (protocol confusion).
+    struct.pack("<IBQ", MAGIC_MULTI, 0, 0),
+]
+
+
+class TestClientAgainstHostileServer:
+    def test_hostile_replies_return_none_within_bound_never_crash(self):
+        rng = random.Random(1337)
+        for i, reply in enumerate(HOSTILE_REPLIES):
+            server = _HostileServer(reply)
+            client = _client()
+            try:
+                t0 = time.monotonic()
+                out = client.fetch_many("127.0.0.1", server.port, [1, 2], 4096)
+                elapsed = time.monotonic() - t0
+                assert out == [None, None], f"reply #{i}"
+                # Bounded: io timeout 0.4s + slack; never a hang.
+                assert elapsed < 3.0, f"reply #{i} took {elapsed:.1f}s"
+            finally:
+                client.close()
+                server.close()
+        # Seeded random garbage frames.
+        for _ in range(12):
+            blob = bytes(rng.randrange(256) for _ in range(rng.randrange(64)))
+            server = _HostileServer(blob)
+            client = _client()
+            try:
+                assert client.fetch_many(
+                    "127.0.0.1", server.port, [9], 4096
+                ) == [None]
+            finally:
+                client.close()
+                server.close()
+
+    def test_wrong_checksum_is_corrupt_not_transport_error(self):
+        server = _HostileServer(
+            _v2_frame([(0, b"wrong-bytes", 0xBAD)]), close_after=False
+        )
+        client = _client()
+        try:
+            out = client.fetch_many("127.0.0.1", server.port, [5], 4096)
+            assert out == [None]
+            assert client.stats["corrupt_blocks"] == 1
+            assert client.stats["failures"] == 0  # the frame itself was fine
+        finally:
+            client.close()
+            server.close()
+
+    def test_valid_v2_frame_roundtrips_through_hostile_rig(self):
+        """Control: the rig itself can serve a well-formed reply."""
+        server = _HostileServer(
+            _v2_frame([(0, b"good-bytes", None)]), close_after=False
+        )
+        client = _client()
+        try:
+            out = client.fetch_many("127.0.0.1", server.port, [5], 4096)
+            assert out == [b"good-bytes"]
+        finally:
+            client.close()
+            server.close()
+
+    def test_stalled_server_fails_within_timeout_not_hang(self):
+        server = _HostileServer(b"", close_after=False)  # reads, says nothing
+        client = _client(timeout_ms=300)
+        try:
+            t0 = time.monotonic()
+            assert client.fetch_many(
+                "127.0.0.1", server.port, [1], 4096
+            ) == [None]
+            assert time.monotonic() - t0 < 2.5
+        finally:
+            client.close()
+            server.close()
+
+
+class TestServerAgainstHostileClient:
+    def test_garbage_frames_leave_server_serving(self):
+        rng = random.Random(99)
+        server = BlockTransferServer()
+        payload = os.urandom(2048)
+        server.put(42, payload)
+        try:
+            for _ in range(25):
+                with socket.create_connection(
+                    ("127.0.0.1", server.port), timeout=2.0
+                ) as conn:
+                    mode = rng.randrange(4)
+                    if mode == 0:  # pure garbage
+                        conn.sendall(bytes(
+                            rng.randrange(256)
+                            for _ in range(rng.randrange(1, 64))
+                        ))
+                    elif mode == 1:  # v2 magic + hostile count
+                        conn.sendall(struct.pack(
+                            "<II", MAGIC_MULTI2, rng.choice(
+                                [0, 1 << 31, 0xFFFFFFFF]
+                            )
+                        ))
+                    elif mode == 2:  # truncated v2 request
+                        conn.sendall(struct.pack("<II", MAGIC_MULTI2, 4))
+                    else:  # truncated single-block request
+                        conn.sendall(struct.pack("<I", MAGIC_SINGLE) + b"\x01")
+            # The server survived the flood and still serves good requests.
+            client = _client()
+            try:
+                assert client.fetch_many(
+                    "127.0.0.1", server.port, [42], 4096
+                ) == [payload]
+            finally:
+                client.close()
+        finally:
+            server.close()
+
+
+class TestEndToEndIntegrity:
+    def test_ram_corruption_detected_on_v2_delivered_on_v1(self):
+        server = BlockTransferServer()
+        data = os.urandom(4096)
+        server.put(7, data)
+        v2 = _client()
+        v1 = _client(verify=False)
+        try:
+            # Healthy: both wires byte-identical.
+            assert v2.fetch_many("127.0.0.1", server.port, [7], 8192) == [data]
+            assert v1.fetch_many("127.0.0.1", server.port, [7], 8192) == [data]
+            # Flip a byte in server RAM — checksum NOT re-blessed.
+            assert server.corrupt(7)
+            got_v2 = v2.fetch_many("127.0.0.1", server.port, [7], 8192)
+            got_v1 = v1.fetch_many("127.0.0.1", server.port, [7], 8192)
+            assert got_v2 == [None]  # detected: degraded to a miss
+            assert v2.stats["corrupt_blocks"] == 1
+            assert got_v1[0] is not None and got_v1[0] != data  # silently wrong
+        finally:
+            v2.close()
+            v1.close()
+            server.close()
+
+    def test_mixed_statuses_with_corruption_keep_alignment(self):
+        server = BlockTransferServer()
+        blocks = {h: os.urandom(256 + h) for h in (1, 2, 3)}
+        for h, payload in blocks.items():
+            server.put(h, payload)
+        server.put(4, b"")  # present-but-empty (cannot corrupt)
+        assert server.corrupt(2)
+        assert not server.corrupt(4)  # empty: nothing to flip
+        assert not server.corrupt(99)  # absent
+        client = _client()
+        try:
+            out = client.fetch_many(
+                "127.0.0.1", server.port, [1, 2, 99, 4, 3], 4096
+            )
+            assert out[0] == blocks[1]
+            assert out[1] is None       # corrupted: detected
+            assert out[2] is None       # missing
+            assert out[3] == b""        # empty is NOT missing
+            assert out[4] == blocks[3]  # later blocks unaffected
+        finally:
+            client.close()
+            server.close()
+
+    def test_v1_and_v2_wire_byte_identical_on_healthy_blocks(self):
+        server = BlockTransferServer()
+        data = {h: os.urandom(512 + h) for h in range(1, 9)}
+        for h, payload in data.items():
+            server.put(h, payload)
+        hashes = [3, 1, 99, 5, 8, 2, 77, 4]
+        v2 = _client()
+        v1 = _client(verify=False)
+        try:
+            assert v2.fetch_many("127.0.0.1", server.port, hashes, 4096) == \
+                v1.fetch_many("127.0.0.1", server.port, hashes, 4096)
+        finally:
+            v2.close()
+            v1.close()
+            server.close()
