@@ -3,10 +3,10 @@
 # /root/reference/Makefile, /root/reference/hooks/pre-commit.sh).
 
 .PHONY: native native-asan kvtransfer test bench bench-micro bench-read \
-	bench-obs bench-batch bench-faults bench-chaos bench-replication \
-	bench-placement bench-anticipate bench-autoscale bench-geo \
-	bench-transfer clean proto lint precommit-install image-build \
-	image-push
+	bench-obs bench-batch bench-faults bench-chaos bench-divergence \
+	bench-replication bench-placement bench-anticipate bench-autoscale \
+	bench-geo bench-transfer clean proto lint precommit-install \
+	image-build image-push
 
 # Container image coordinates (override per environment/registry). The
 # release workflow (.github/workflows/ci-release.yaml) builds the same
@@ -125,6 +125,15 @@ bench-faults:
 # benchmarking/FLEET_BENCH_CHAOS.json.
 bench-chaos: kvtransfer
 	JAX_PLATFORMS=cpu python bench.py --chaos
+
+# Index anti-entropy scenario (antientropy/): a silent-evictor pod (cache
+# wiped, event stream seamless) under precise routing + a phantom-
+# advertiser pod on the two-tier data plane; reconciliation (fetch-miss
+# feedback, sampled residency audits, truth-weighted scoring) vs
+# unreconciled controls. Headless; rewrites
+# benchmarking/FLEET_BENCH_DIVERGENCE.json.
+bench-divergence: kvtransfer
+	JAX_PLATFORMS=cpu python bench.py --divergence
 
 # Indexer kill-and-restart scenario (cluster/): the index service dies
 # mid-ShareGPT-replay; cold restart vs snapshot + seq-tail-replay restore.

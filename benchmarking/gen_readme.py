@@ -331,6 +331,94 @@ def fleet_chaos_section() -> str:
     ])
 
 
+def fleet_divergence_section() -> str:
+    """Index anti-entropy scenario (bench.py --divergence / antientropy/
+    subsystem): what fetch-miss feedback, sampled residency audits, and
+    truth-weighted scoring buy when the index silently diverges from
+    reality inside healthy-looking pods."""
+    path = os.path.join(HERE, "FLEET_BENCH_DIVERGENCE.json")
+    if not os.path.exists(path):
+        raise SystemExit(
+            "benchmarking/FLEET_BENCH_DIVERGENCE.json missing — run "
+            "`python bench.py --divergence`"
+        )
+    stats = _load(path)
+    cfg = stats["config"]
+    arms = stats["arms"]
+    rows = []
+    for name, label in (
+        ("scoring_no_fault_plain", "no faults (scoring family)"),
+        ("silent_evict_antientropy", "**silent evictor + anti-entropy**"),
+        ("silent_evict_control", "silent evictor (control)"),
+    ):
+        a = arms[name]
+        rows.append(
+            f"| {label} | {a['ttft_p50_s']} | {a['ttft_p90_s']} "
+            f"| {a['prefix_hit_rate']:.1%} | {a['post_fault_hit_rate']:.1%} "
+            f"| {a.get('phantoms_purged', '—')} "
+            f"| {a.get('first_repair_at_s', '—')} |"
+        )
+    ph_rows = []
+    for name, label in (
+        ("dataplane_no_fault_plain", "no faults (data-plane family)"),
+        ("phantom_antientropy", "**phantom advertiser + anti-entropy**"),
+        ("phantom_control", "phantom advertiser (control)"),
+    ):
+        a = arms[name]
+        ph_rows.append(
+            f"| {label} | {a['ttft_p50_s']} | {a['prefix_hit_rate']:.1%} "
+            f"| {a['wasted_fetch_blocks']} "
+            f"| {a['wasted_fetch_blocks_late_window']} "
+            f"| {a.get('purged_entries', '—')} |"
+        )
+    ident = stats.get("healthy_bit_identity", {})
+    identical = all(ident.values()) if ident else False
+    wipe = cfg["wipe_plan"]["pods"][next(iter(cfg["wipe_plan"]["pods"]))]
+    return "\n".join([
+        f"Silent index-vs-reality divergence over the synthetic chat "
+        f"workload ({cfg['requests']} requests): a **silent evictor** "
+        f"(one pod's cache wiped every {wipe['silent_wipe_every_s']}s "
+        f"from {wipe['silent_wipe_at_s']}s while its event stream "
+        "continues seamlessly — every pre-wipe entry phantom) under "
+        "precise routing with two-holder group prefixes, and a "
+        "**phantom advertiser** (one pod re-advertising peers' staged "
+        "chains as its own) on the two-tier data plane. Reconciliation "
+        f"= residency audits every "
+        f"{cfg['antientropy']['audit_interval_s']}s (sample "
+        f"{cfg['antientropy']['audit_sample']}/pod, escalating to a full "
+        "audit once a pod is distrusted) + fetch-miss feedback purges + "
+        "truth-weighted score demotion.",
+        "",
+        "| Scoring arm | TTFT p50 (s) | TTFT p90 (s) | Hit rate "
+        "| Post-fault hit | Phantoms purged | First repair (s) |",
+        "|---|---:|---:|---:|---:|---:|---:|",
+        *rows,
+        "",
+        "| Data-plane arm | TTFT p50 (s) | Hit rate | Wasted fetches "
+        "| Wasted (late window) | Entries purged |",
+        "|---|---:|---:|---:|---:|---:|",
+        *ph_rows,
+        "",
+        f"Post-fault hit-rate retention with anti-entropy "
+        f"**{stats['silent_evict_hit_retention_antientropy']:.1%}** vs "
+        f"**{stats['silent_evict_hit_retention_control']:.1%}** "
+        "unreconciled (the control keeps chasing the wiped pod's phantom "
+        "full-chain scores into full recomputes), with the wiped pod's "
+        "trust factor recovered to 1.0 by clean audits after the wipes "
+        f"stop ({'recovered' if stats['silent_evict_trust_recovered'] else 'NOT recovered'}; "
+        "timeline committed in the artifact). Phantom advertiser: wasted "
+        "fetches (explicit per-block \"missing\" answers) after the "
+        f"late-window mark — "
+        f"**{stats['phantom_wasted_fetches_late_window_antientropy']}** "
+        "reconciled vs "
+        f"**{stats['phantom_wasted_fetches_late_window_control']}** "
+        "control. Healthy-fleet bit-identity (full stack attached, zero "
+        f"faults, both families): "
+        f"**{'bit-identical' if identical else 'DRIFTED'}**. "
+        "Source: `FLEET_BENCH_DIVERGENCE.json`.",
+    ])
+
+
 def fleet_replication_section() -> str:
     """Indexer kill-and-restart scenario (bench.py --replication /
     cluster/ subsystem): what snapshot + seq-tail replay buys over a cold
@@ -1349,6 +1437,7 @@ def regenerate(text: str) -> str:
         ("fleet", fleet_section()),
         ("fleet-faults", fleet_faults_section()),
         ("fleet-chaos", fleet_chaos_section()),
+        ("fleet-divergence", fleet_divergence_section()),
         ("fleet-replication", fleet_replication_section()),
         ("fleet-placement", fleet_placement_section()),
         ("fleet-anticipate", fleet_anticipate_section()),

@@ -57,6 +57,12 @@ the ZMQ KVEvents write plane, and Prometheus metrics behind HTTP:
                                 counters, and — when an embedder wires
                                 them — the scheduler's policy stats and
                                 the prefetch queue's per-source drops
+  GET  /antientropy/status      index anti-entropy introspection: per-pod
+                                advertised-vs-verified accuracy EWMA +
+                                demotion factor, purge/readmit counters,
+                                auditor + fetch-feedback stats when an
+                                embedder wired them (also the /readyz
+                                `index_health` section)
   GET  /slo/status              SLO burn-rate evaluation (obs/slo.py):
                                 per-objective fast/slow-window burn
                                 rates off the live registry, breach
@@ -99,7 +105,12 @@ FEDERATION_DIGEST_STALE_S, and the session predictor PREDICTION /
 PREDICTION_MAX_SESSIONS / PREDICTION_ETA_ALPHA /
 PREDICTION_MAX_CHAIN_BLOCKS / PREDICTION_DEFAULT_ETA_S (PREDICTION=0,
 the default, keeps the read path byte-for-byte — the table is pure
-observation even when on).
+observation even when on), and the index anti-entropy loop ANTIENTROPY /
+ANTIENTROPY_ACCURACY_ALPHA / ANTIENTROPY_DISTRUST_THRESHOLD /
+ANTIENTROPY_MIN_FACTOR / ANTIENTROPY_AUDIT_INTERVAL_S /
+ANTIENTROPY_AUDIT_SAMPLE (ANTIENTROPY=0 default; on, scores stay
+bit-identical while the fleet stays truthful — the tracker only demotes
+on verified divergence).
 
 Run: python -m llm_d_kv_cache_manager_tpu.api.http_service
 """
@@ -301,6 +312,30 @@ def config_from_env() -> dict:
         ),
         "prediction_default_eta_s": float(
             os.environ.get("PREDICTION_DEFAULT_ETA_S", "8")
+        ),
+        # Index anti-entropy (antientropy/): ANTIENTROPY=1 attaches the
+        # per-pod trust tracker at the score-filter seam (truth-weighted
+        # demotion; bit-identical while the fleet stays truthful) and the
+        # orphan-removal probe in the event pool. The residency auditor
+        # and fetch-miss feedback need pod digest / data-plane seams only
+        # an embedder owns — assign to `self.auditor` /
+        # `self.fetch_feedback` to surface them through /readyz
+        # `index_health`. ANTIENTROPY=0 (default) leaves every hook None.
+        "antientropy": os.environ.get("ANTIENTROPY", "0") == "1",
+        "antientropy_accuracy_alpha": float(
+            os.environ.get("ANTIENTROPY_ACCURACY_ALPHA", "0.3")
+        ),
+        "antientropy_distrust_threshold": float(
+            os.environ.get("ANTIENTROPY_DISTRUST_THRESHOLD", "0.9")
+        ),
+        "antientropy_min_factor": float(
+            os.environ.get("ANTIENTROPY_MIN_FACTOR", "0.25")
+        ),
+        "antientropy_audit_interval_s": float(
+            os.environ.get("ANTIENTROPY_AUDIT_INTERVAL_S", "10")
+        ),
+        "antientropy_audit_sample": int(
+            os.environ.get("ANTIENTROPY_AUDIT_SAMPLE", "16")
         ),
     }
 
@@ -505,6 +540,32 @@ class ScoringService:
         # scores are untouched).
         if self.load_tracker is not None:
             self.event_pool.load_tracker = self.load_tracker
+
+        # Index anti-entropy (antientropy/): ANTIENTROPY=1 attaches the
+        # trust tracker at the indexer's score-filter seam and the event
+        # pool's orphan-removal probe. The auditor / fetch-miss feedback
+        # are embedder-wired (they need the pod digest surface and the
+        # data-plane client) and surface through /readyz `index_health`.
+        self.antientropy = None
+        self.auditor = None
+        self.fetch_feedback = None
+        if env.get("antientropy"):
+            from llm_d_kv_cache_manager_tpu.antientropy import (
+                AntiEntropyConfig,
+                AntiEntropyTracker,
+            )
+
+            self.antientropy = AntiEntropyTracker(AntiEntropyConfig(
+                accuracy_alpha=float(
+                    env.get("antientropy_accuracy_alpha", 0.3)
+                ),
+                distrust_threshold=float(
+                    env.get("antientropy_distrust_threshold", 0.9)
+                ),
+                min_factor=float(env.get("antientropy_min_factor", 0.25)),
+            ))
+            self.indexer.antientropy = self.antientropy
+            self.event_pool.divergence = self.antientropy
         # Optional scatter-gather front (embedders wire a ClusterScorer
         # over peer replicas); surfaces through /cluster/status only.
         self.cluster_scorer = None
@@ -1025,7 +1086,22 @@ class ScoringService:
             # PEER is dark; this process degrades those fetches to misses
             # and keeps serving.
             "transfer": self._transfer_section(),
+            # Index anti-entropy: per-pod advertised-vs-verified accuracy
+            # EWMA + demotion factor, last audit time, and the purge/
+            # readmit counters. Never gates readiness — a divergent POD
+            # is being demoted and repaired; this process is fine.
+            "index_health": self._index_health_section(),
         }
+
+    def _index_health_section(self) -> Optional[dict]:
+        if self.antientropy is None:
+            return None
+        section = self.antientropy.status()
+        if self.auditor is not None:
+            section["auditor"] = self.auditor.status()
+        if self.fetch_feedback is not None:
+            section["fetch_feedback"] = self.fetch_feedback.status()
+        return section
 
     def _transfer_section(self) -> Optional[dict]:
         from llm_d_kv_cache_manager_tpu.kv_connectors import (
@@ -1095,6 +1171,21 @@ class ScoringService:
             return section
 
         return web.json_response(await asyncio.to_thread(build))
+
+    async def handle_antientropy_status(
+        self, request: web.Request
+    ) -> web.Response:
+        """Anti-entropy introspection: the same document the /readyz
+        `index_health` section embeds (per-pod trust evidence, auditor
+        and fetch-feedback stats when an embedder wired them)."""
+        if self.antientropy is None:
+            return web.json_response(
+                {"error": "anti-entropy disabled (set ANTIENTROPY=1)"},
+                status=400,
+            )
+        return web.json_response(
+            await asyncio.to_thread(self._index_health_section)
+        )
 
     async def handle_placement_status(self, request: web.Request) -> web.Response:
         """Placement introspection: tracker occupancy/ingest counters, the
@@ -1330,6 +1421,9 @@ class ScoringService:
         app.router.add_get("/routing/status", self.handle_routing_status)
         app.router.add_post("/pod_load", self.handle_pod_load)
         app.router.add_get("/placement/status", self.handle_placement_status)
+        app.router.add_get(
+            "/antientropy/status", self.handle_antientropy_status
+        )
         app.router.add_get("/prediction/status", self.handle_prediction_status)
         app.router.add_get(
             "/federation/status", self.handle_federation_status

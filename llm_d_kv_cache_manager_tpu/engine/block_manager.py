@@ -153,6 +153,16 @@ class BlockManager:
     def num_cached_pages(self) -> int:
         return len(self._hash_to_page)
 
+    def cached_hashes(self, limit: Optional[int] = None) -> List[int]:
+        """Bounded enumeration of device-resident chunk hashes (insertion
+        order). The residency-audit re-admit direction: blocks this
+        engine holds that the fleet index may have lost."""
+        import itertools
+
+        if limit is None:
+            return list(self._hash_to_page)
+        return list(itertools.islice(self._hash_to_page, max(limit, 0)))
+
     def is_cached(self, chunk_hash: int) -> bool:
         """True when the block is HBM-resident (committed and reusable)."""
         return chunk_hash in self._hash_to_page

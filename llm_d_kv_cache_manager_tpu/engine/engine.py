@@ -730,6 +730,38 @@ class EnginePod:
             n += 1
         return n
 
+    def resident_block_digest(
+        self,
+        device_hashes: List[int] = (),
+        host_hashes: List[int] = (),
+        max_extra: int = 0,
+    ) -> dict:
+        """Compact resident-set digest — the anti-entropy audit challenge
+        surface (antientropy/auditor.py). Answers, per tier family:
+        which of the CHALLENGED hashes are resident right now (`device`
+        against the block manager's committed cache — the same membership
+        `resident_prefix_blocks` walks — and `host` against the staged
+        store, the fetchable tier), plus bounded `extra_*` samples of
+        resident hashes for the re-admit direction. Membership checks
+        only: no bytes move, no pages allocate, so a pod can answer this
+        on every audit round for free. The sim and a real pod's sidecar
+        expose the same dict over their respective transports."""
+        out = {
+            "device": {
+                h for h in device_hashes if self.block_manager.is_cached(h)
+            },
+            "host": set(),
+            "extra_device": [],
+            "extra_host": [],
+        }
+        if self.tier_store is not None:
+            out["host"] = self.tier_store.staged_subset(host_hashes)
+            if max_extra > 0:
+                out["extra_host"] = self.tier_store.staged_sample(max_extra)
+        if max_extra > 0:
+            out["extra_device"] = self.block_manager.cached_hashes(max_extra)
+        return out
+
     def warm_chain(self, tokens: List[int], lora_id: Optional[int] = None) -> int:
         """Replication warm admission (placement/): materialize the longest
         *restorable* prefix of this token chain through the data plane

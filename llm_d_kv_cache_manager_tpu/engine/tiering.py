@@ -42,6 +42,7 @@ from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import (
     Key,
     base_pod_identifier,
 )
+from llm_d_kv_cache_manager_tpu.metrics import collector as metrics
 from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
 
 if TYPE_CHECKING:  # kv_connectors loads the ctypes lib; keep it optional at
@@ -858,6 +859,26 @@ class TieredKVStore:
         with self._mu:
             return len(self._staged)
 
+    # -- residency-digest surface (antientropy/auditor.py) -----------------
+
+    def staged_subset(self, chunk_hashes) -> set:
+        """Membership answer over the challenged hashes: which of them are
+        host-resident (staged, hence fetchable) RIGHT NOW. One lock
+        crossing, no bytes moved — the cheap audit-challenge primitive."""
+        with self._mu:
+            return {h for h in chunk_hashes if h in self._staged}
+
+    def staged_sample(self, limit: int) -> List[int]:
+        """Bounded sample of host-resident hashes, oldest-staged first
+        (the re-admit direction of a residency audit: blocks this pod
+        holds that the index may have lost)."""
+        if limit <= 0:
+            return []
+        import itertools
+
+        with self._mu:
+            return list(itertools.islice(self._staged, limit))
+
 
 class IndexBackedPeerResolver:
     """Resolve a block hash to a peer pod's transfer address through the
@@ -873,6 +894,8 @@ class IndexBackedPeerResolver:
         self_pod_id: str,
         host_tier: str = "host",
         rendezvous_primary: bool = False,
+        negative_ttl_s: float = 3.0,
+        clock: Callable[[], float] = None,
     ):
         self.index = index
         self.model_name = model_name
@@ -887,6 +910,51 @@ class IndexBackedPeerResolver:
         # replayable scenarios (the chaos bench) need a peer choice that
         # does not depend on worker interleaving.
         self.rendezvous_primary = rendezvous_primary
+        # Negative-result cache: a peer that just answered "missing" for
+        # a block (note_miss — wired off the TransferClient's
+        # on_fetch_misses seam) is demoted from primary for THAT block
+        # until the TTL lapses, instead of being re-picked on the very
+        # next request while its phantom index entry awaits repair. Other
+        # holders move ahead; a peer that is the ONLY holder is still
+        # tried (a stale negative must not turn a fetchable block into a
+        # permanent miss). With nothing calling note_miss the cache stays
+        # empty and candidate order is byte-identical to the historical
+        # behavior. <=0 disables.
+        self.negative_ttl_s = negative_ttl_s
+        import time as _time
+
+        self.clock = clock or _time.monotonic
+        self._negative: Dict[Tuple[Tuple[str, int], int], float] = {}
+        self.negative_skips = 0
+
+    def note_miss(
+        self,
+        addr: Tuple[str, int],
+        chunk_hashes,
+        now: Optional[float] = None,
+    ) -> None:
+        """Record per-(peer, block) explicit-miss answers for the TTL."""
+        if self.negative_ttl_s <= 0:
+            return
+        if now is None:
+            now = self.clock()
+        for h in chunk_hashes:
+            self._negative[(addr, h)] = now + self.negative_ttl_s
+        if len(self._negative) > 4096:
+            self._negative = {
+                k: t for k, t in self._negative.items() if t > now
+            }
+
+    def _negatively_cached(
+        self, addr: Tuple[str, int], chunk_hash: int, now: float
+    ) -> bool:
+        expiry = self._negative.get((addr, chunk_hash))
+        if expiry is None:
+            return False
+        if expiry <= now:
+            self._negative.pop((addr, chunk_hash), None)
+            return False
+        return True
 
     def __call__(self, chunk_hash: int) -> Optional[Tuple[str, int]]:
         ranked = self.candidates(chunk_hash)
@@ -929,7 +997,22 @@ class IndexBackedPeerResolver:
             return []
         if self.rendezvous_primary:
             holders.sort()
-            return [addr for _w, _o, addr in holders]
-        first = holders[0]
-        rest = sorted(holders[1:])
-        return [first[2]] + [addr for _w, _o, addr in rest]
+            ranked = [addr for _w, _o, addr in holders]
+        else:
+            first = holders[0]
+            rest = sorted(holders[1:])
+            ranked = [first[2]] + [addr for _w, _o, addr in rest]
+        if not self._negative:
+            return ranked
+        # Negative-result demotion: holders that just disclaimed this
+        # block drop behind the fresh ones (kept — they may be the only
+        # holder, and the TTL bounds how long a stale negative can lie).
+        now = self.clock()
+        fresh = [
+            a for a in ranked if not self._negatively_cached(a, chunk_hash, now)
+        ]
+        if not fresh or fresh[0] == ranked[0]:
+            return ranked
+        self.negative_skips += 1
+        metrics.count_negative_cache_skip()
+        return fresh + [a for a in ranked if a not in fresh]

@@ -511,12 +511,24 @@ class TransferClient:
         config: Optional[TransferClientConfig] = None,
         clock: Callable[[], float] = time.monotonic,
         on_breaker_transition: Optional[Callable[[str, str, str], None]] = None,
+        on_fetch_misses: Optional[
+            Callable[[str, int, List[int], List[int]], None]
+        ] = None,
     ):
         self.config = config or TransferClientConfig()
         self.clock = clock
         # Called as (peer_key, old_state, new_state) on every breaker
         # transition — the FleetHealthTracker feed.
         self.on_breaker_transition = on_breaker_transition
+        # Called as (host, port, requested_hashes, missing_hashes) when a
+        # SUCCESSFUL round trip came back with per-block "missing"
+        # answers (-2 on the wire: the peer is healthy and explicitly
+        # disclaims the blocks). This is ground truth against whatever
+        # advertised the peer as a holder — the anti-entropy fetch-miss
+        # feedback seam (antientropy/feedback.py). Transport failures,
+        # corruption, and breaker skips never fire it: those say nothing
+        # about what the peer holds.
+        self.on_fetch_misses = on_fetch_misses
         self._pool: Dict[Tuple[str, int], _Conn] = {}
         self._peers: Dict[Tuple[str, int], _PeerState] = {}
         self._mu = threading.Lock()  # pool/peer maps only
@@ -525,6 +537,7 @@ class TransferClient:
             "batch_fetches": 0, "blocks_fetched": 0,
             "corrupt_blocks": 0, "oversized_blocks": 0,
             "breaker_skipped_blocks": 0, "hedges": 0, "hedge_wins": 0,
+            "missing_blocks": 0,
         }
 
     def _conn(self, host: str, port: int) -> _Conn:
@@ -739,6 +752,7 @@ class TransferClient:
             self._fail(host, port, n, "batch fetch")
             return [None] * n
         corrupt = 0
+        missing: List[int] = []
         result: List[Optional[bytes]] = []
         for h, entry in zip(hashes, entries):
             if entry is _CORRUPT:
@@ -753,6 +767,12 @@ class TransferClient:
                 )
                 result.append(None)
             else:
+                if entry is None:
+                    # Explicit per-block miss on a healthy round trip:
+                    # the peer disclaims the block (-2). The one wire
+                    # status that is EVIDENCE rather than damage — fed to
+                    # the anti-entropy seam below.
+                    missing.append(h)
                 result.append(entry)
         self.stats["batch_fetches"] += 1
         self.stats["blocks_fetched"] += n
@@ -760,6 +780,13 @@ class TransferClient:
             host, port, ok=True, latency_s=latency,
             corrupt_blocks=corrupt, blocks=n,
         )
+        if missing:
+            self.stats["missing_blocks"] += len(missing)
+            if self.on_fetch_misses is not None:
+                try:
+                    self.on_fetch_misses(host, port, list(hashes), missing)
+                except Exception as e:  # noqa: BLE001 - observer must not
+                    logger.debug("fetch-miss callback failed: %s", e)
         return result
 
     # -- hedged fetches ----------------------------------------------------

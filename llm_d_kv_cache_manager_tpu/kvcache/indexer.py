@@ -111,6 +111,7 @@ class Indexer:
         popularity=None,
         routing_policy=None,
         prediction=None,
+        antientropy=None,
     ):
         self.config = config or IndexerConfig()
         # Optional fleethealth.FleetHealthTracker: when wired, scores pass
@@ -125,6 +126,16 @@ class Indexer:
         # prefix_only policy — and None, the default — return the scores
         # dict unchanged, pinning the pure-prefix path bit-identical.
         self.routing_policy = routing_policy
+        # Optional antientropy.AntiEntropyTracker: truth-weighted score
+        # demotion, applied between fleet-health filtering (is the pod's
+        # STREAM alive?) and the routing policy (is the pod affordable?):
+        # a pod whose advertised-vs-verified accuracy EWMA fell below the
+        # distrust threshold has its prefix scores decayed like a suspect
+        # pod's, recovering as audits come back clean. A clean (or absent)
+        # tracker returns the scores dict unchanged — the SAME object —
+        # so attachment is bit-identical on a truthful fleet (pinned by
+        # tests/test_antientropy.py).
+        self.antientropy = antientropy
         # Optional placement.ChainPopularityTracker: every scored request
         # reports its chain head + tenant/LoRA extra to the hot-prefix
         # detector (placement/popularity.py). Observation only — scores are
@@ -314,6 +325,8 @@ class Indexer:
                 # answer — the caller's load/round-robin fallback takes over
                 # instead of routing to phantom placements.
                 scores = self.fleet_health.filter_scores(scores)
+            if self.antientropy is not None:
+                scores = self.antientropy.adjust_scores(scores)
             if self.routing_policy is not None:
                 scores = self.routing_policy.adjust(scores, _explain=_explain)
         kvlog.trace(logger, "pod scores: %s", scores)
@@ -498,10 +511,13 @@ class Indexer:
                         ))
                 scored = self.scorer.score_plan(plan)
                 fleet_health = self.fleet_health
+                antientropy = self.antientropy
                 routing_policy = self.routing_policy
                 for spec, (scores, match_blocks) in zip(plan_specs, scored):
                     if fleet_health is not None:
                         scores = fleet_health.filter_scores(scores)
+                    if antientropy is not None:
+                        scores = antientropy.adjust_scores(scores)
                     if routing_policy is not None:
                         scores = routing_policy.adjust(scores)
                     results[spec["item"]] = PodScores(
@@ -541,6 +557,8 @@ class Indexer:
         scores, match_blocks = self.scorer.score_ex(block_keys, key_to_pods)
         if self.fleet_health is not None:
             scores = self.fleet_health.filter_scores(scores)
+        if self.antientropy is not None:
+            scores = self.antientropy.adjust_scores(scores)
         if self.routing_policy is not None:
             scores = self.routing_policy.adjust(scores)
         return PodScores(

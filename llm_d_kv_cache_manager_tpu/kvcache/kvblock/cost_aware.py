@@ -308,6 +308,44 @@ class CostAwareMemoryIndex(Index):
                     self._drop_engine_mappings(request_key)
         return removed
 
+    def remove_entries(
+        self, pod_identifier: str, request_keys, device_tiers=None
+    ) -> int:
+        """Targeted purge (Index.remove_entries contract); each touched
+        key is re-costed and the byte budget re-credited as entries leave
+        — a phantom purge frees exactly the budget those entries were
+        charged. Plain dict gets, so untouched keys keep recency order."""
+        target = {pod_identifier}
+        removed = 0
+        with self._mu:
+            for request_key in request_keys:
+                pod_cache = self._data.get(request_key)
+                if pod_cache is None:
+                    continue
+                self._total_cost -= pod_cache.cost
+                with pod_cache.mu:
+                    victims = [
+                        e for e in pod_cache.cache.keys()
+                        if pod_matches(e.pod_identifier, target)
+                        and (
+                            device_tiers is None
+                            or e.device_tier in device_tiers
+                        )
+                    ]
+                    for entry in victims:
+                        pod_cache.cache.remove(entry)
+                    removed += len(victims)
+                    is_empty = len(pod_cache.cache) == 0
+                    pod_cache.cost = calculate_byte_size(
+                        request_key, pod_cache.cache.keys()
+                    )
+                self._total_cost += pod_cache.cost
+                if is_empty:
+                    self._data.pop(request_key, None)
+                    self._total_cost -= pod_cache.cost
+                    self._drop_engine_mappings(request_key)
+        return removed
+
     def export_view(self) -> IndexView:
         """Snapshot oldest-first (Index.export_view contract); cost
         bookkeeping is derived state and is recomputed on import."""

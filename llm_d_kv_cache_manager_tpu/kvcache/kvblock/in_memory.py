@@ -226,6 +226,39 @@ class InMemoryIndex(Index):
                     self._engine_to_request.remove(engine_key)
         return removed
 
+    def remove_entries(
+        self, pod_identifier: str, request_keys, device_tiers=None
+    ) -> int:
+        """Targeted purge (Index.remove_entries contract): only the given
+        request keys are touched, via `peek` so untouched keys keep their
+        recency order — the purge must not perturb what the LRU evicts
+        next."""
+        target = {pod_identifier}
+        removed = 0
+        emptied = set()
+        for request_key in request_keys:
+            pod_cache = self._data.peek(request_key)
+            if pod_cache is None:
+                continue
+            with pod_cache.mu:
+                victims = [
+                    e for e in pod_cache.cache.keys()
+                    if pod_matches(e.pod_identifier, target)
+                    and (device_tiers is None or e.device_tier in device_tiers)
+                ]
+                for entry in victims:
+                    pod_cache.cache.remove(entry)
+                removed += len(victims)
+                is_empty = victims and len(pod_cache.cache) == 0
+            if is_empty:
+                self._data.remove(request_key)
+                emptied.add(request_key)
+        if emptied:
+            for engine_key, request_key in self._engine_to_request.items():
+                if request_key in emptied:
+                    self._engine_to_request.remove(engine_key)
+        return removed
+
     def export_view(self) -> IndexView:
         """Snapshot both LRUs oldest-first (Index.export_view contract)."""
         entries = []

@@ -66,6 +66,32 @@ class Index(abc.ABC):
     def get_request_key(self, engine_key: Key) -> Optional[Key]:
         """Resolve an engine key to its request key, or None if unknown."""
 
+    def remove_entries(
+        self,
+        pod_identifier: str,
+        request_keys: Sequence[Key],
+        device_tiers: Optional[Set[str]] = None,
+    ) -> int:
+        """Targeted purge: remove `pod_identifier`'s entries for exactly
+        the given request keys (optionally only entries whose tier is in
+        `device_tiers`; None = all tiers).
+
+        The anti-entropy repair primitive (antientropy/): where
+        `remove_pod` quarantines a whole pod, this surgically drops the
+        specific (pod, block) placements that fetch-miss feedback or a
+        residency audit proved phantom — the pod's OTHER placements keep
+        scoring. Pod matching follows `remove_pod` semantics (a bare pod
+        name also matches its DP-ranked identities; `key.pod_matches`).
+        Keys left with no pods are dropped from both key spaces, exactly
+        as if the view had been exported, filtered, and re-imported
+        (pinned per backend by tests/test_antientropy.py). Keys the pod
+        has no entry for are no-ops. Returns the number of pod entries
+        removed.
+        """
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support remove_entries"
+        )
+
     @abc.abstractmethod
     def remove_pod(self, pod_identifier: str) -> int:
         """Bulk-purge every entry `pod_identifier` holds, in one pass.

@@ -144,6 +144,16 @@ class InstrumentedIndex(Index):
             m.index_evictions.inc(removed)
         return removed
 
+    def remove_entries(
+        self, pod_identifier: str, request_keys, device_tiers=None
+    ) -> int:
+        removed = self.inner.remove_entries(
+            pod_identifier, request_keys, device_tiers
+        )
+        if m.index_evictions is not None and removed:
+            m.index_evictions.inc(removed)
+        return removed
+
     def export_view(self):
         return self.inner.export_view()
 

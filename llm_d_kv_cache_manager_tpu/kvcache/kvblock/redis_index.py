@@ -404,6 +404,68 @@ class RedisIndex(Index):
                     self._pipeline([("DEL", *stale)])
         return removed
 
+    def remove_entries(
+        self, pod_identifier: str, request_keys, device_tiers=None
+    ) -> int:
+        """Targeted purge (Index.remove_entries contract), fully
+        pipelined: ONE round trip reads the targeted hashes (HKEYS per
+        key), one issues the HDELs + HLENs, and one DELs the keys that
+        emptied — no SCAN of the keyspace (that is `remove_pod`'s job; a
+        feedback purge must stay O(targeted keys), not O(index)).
+        Engine:* mappings pointing at deleted keys are dropped in a final
+        targeted sweep over the emptied keys' engine aliases resolved the
+        same way `evict` resolves them — by skipping it: a dangling
+        engine:* row is self-healing here (get_request_key → evict finds
+        the hash gone and deletes the row), and hunting it down would
+        cost the SCAN this method exists to avoid. Connection errors
+        propagate like the write path's."""
+        target = {pod_identifier}
+        keys = list(request_keys)
+        if not keys:
+            return 0
+        replies = self._pipeline([("HKEYS", _key_str(k)) for k in keys])
+        commands = []
+        victims_per_key: List[tuple] = []
+        for key, reply in zip(keys, replies):
+            if isinstance(reply, RespError) or reply is None:
+                continue
+            victims = []
+            for field in reply:
+                field_str = (
+                    field.decode("utf-8") if isinstance(field, bytes) else field
+                )
+                entry = _parse_entry(field_str)
+                if (
+                    entry is not None
+                    and pod_matches(entry.pod_identifier, target)
+                    and (
+                        device_tiers is None
+                        or entry.device_tier in device_tiers
+                    )
+                ):
+                    victims.append(field_str)
+            if victims:
+                key_str = _key_str(key)
+                commands.append(("HDEL", key_str, *victims))
+                commands.append(("HLEN", key_str))
+                victims_per_key.append((key, len(victims)))
+        if not commands:
+            return 0
+        replies = self._pipeline(commands)
+        removed = 0
+        del_cmds = []
+        for i, (key, n_victims) in enumerate(victims_per_key):
+            removed += n_victims
+            if replies[2 * i + 1] == 0:  # the HLEN after the HDEL
+                del_cmds.append(("DEL", _key_str(key)))
+                # Engine aliases resolve through the same decimal-hash
+                # string on this backend's schema, so the 1:1 alias row
+                # can be dropped in the same sweep.
+                del_cmds.append(("DEL", _engine_key_str(key)))
+        if del_cmds:
+            self._pipeline(del_cmds)
+        return removed
+
     def export_view(self) -> IndexView:
         """SCAN-walk the keyspace into an IndexView (Index.export_view).
 

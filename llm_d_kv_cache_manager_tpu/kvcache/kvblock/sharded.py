@@ -424,6 +424,50 @@ class ShardedIndex(Index):
                         seg.engine_to_request.remove(engine_key)
         return removed
 
+    def remove_entries(
+        self, pod_identifier: str, request_keys, device_tiers=None
+    ) -> int:
+        """Targeted purge (Index.remove_entries contract). Each touched
+        key's read-view entry is REPUBLISHED under its pod cache's mutex
+        (same discipline as `add`/`evict`), so concurrent lock-free
+        lookups only ever see before/after states of a key — a purged
+        phantom stops scoring the moment this returns. Untouched keys are
+        accessed via `peek`, keeping their recency order."""
+        target = {pod_identifier}
+        removed = 0
+        emptied = set()
+        view = self._view
+        for request_key in request_keys:
+            seg = self._segments[self.shard_of(request_key)]
+            pod_cache = seg.data.peek(request_key)
+            if pod_cache is None:
+                continue
+            with pod_cache.mu:
+                victims = [
+                    e for e in pod_cache.cache.keys()
+                    if pod_matches(e.pod_identifier, target)
+                    and (device_tiers is None or e.device_tier in device_tiers)
+                ]
+                for entry in victims:
+                    pod_cache.cache.remove(entry)
+                removed += len(victims)
+                if not victims:
+                    continue
+                pod_cache.republish()
+                view[request_key] = pod_cache.entries
+                is_empty = len(pod_cache.cache) == 0
+            if is_empty:
+                # The segment LRU's on_evict hook prunes the view entry
+                # under the segment lock.
+                seg.data.remove(request_key)
+                emptied.add(request_key)
+        if emptied:
+            for seg in self._segments:
+                for engine_key, request_key in seg.engine_to_request.items():
+                    if request_key in emptied:
+                        seg.engine_to_request.remove(engine_key)
+        return removed
+
     def export_view(self) -> IndexView:
         """Snapshot segment by segment, each stripe oldest-first
         (Index.export_view contract). Keys re-stripe identically on

@@ -126,6 +126,7 @@ class EventPool:
         message_filter=None,
         popularity=None,
         load_tracker=None,
+        divergence=None,
     ):
         self.config = config or EventPoolConfig()
         self.index = index
@@ -161,6 +162,15 @@ class EventPool:
         # signal the load-blend routing policy reads — the wire-visible
         # trace of page-pool churn. Observation only; None costs one check.
         self.load_tracker = load_tracker
+        # Optional antientropy.AntiEntropyTracker (duck-typed): a
+        # BlockRemoved whose engine key resolves to NOTHING is an orphan —
+        # the index never saw the matching store (a dropped event), so the
+        # pod's real state diverged from the view in the direction the
+        # fetch-miss/audit loop can't see. Counted per pod instead of
+        # silently ignored. None (the default) keeps the removal path
+        # byte-identical — the extra get_request_key probe (a network RTT
+        # on the Redis backend) only runs when a tracker is attached.
+        self.divergence = divergence
         depth = max(0, self.config.max_queue_depth)
         self._queues: List["queue.Queue[Optional[Message]]"] = [
             queue.Queue(maxsize=depth) for _ in range(self.config.concurrency)
@@ -582,6 +592,17 @@ class EventPool:
             except (TypeError, ValueError) as e:
                 logger.debug("bad block hash in BlockRemoved: %s", e)
                 continue
+            if self.divergence is not None:
+                try:
+                    known = self.index.get_request_key(engine_key) is not None
+                except Exception as e:  # noqa: BLE001 - probe must not kill
+                    logger.debug("orphan probe failed: %s", e)  # the worker
+                    known = True  # can't tell: digest normally
+                if not known:
+                    # Orphan removal: the index never stored this block —
+                    # divergence evidence, and nothing to evict.
+                    self.divergence.observe_orphan_removal(pod_identifier)
+                    continue
             try:
                 self.index.evict(engine_key, entries)
             except ValueError as e:

@@ -146,6 +146,18 @@ prediction_blocks: Optional[Counter] = None
 prediction_mispredicted_blocks: Optional[Counter] = None
 prefetch_drops: Optional[Counter] = None
 
+# Index anti-entropy (antientropy/): divergence observations by fixed
+# source (tracker.DIVERGENCE_SOURCES: fetch_miss / orphan_removal /
+# audit_phantom), phantom entries purged and lost residents re-admitted
+# by the repair loop, audit rounds applied, and primaries skipped by the
+# peer resolver's negative-result cache. Pod identities stay data (the
+# /readyz index_health section), never labels.
+index_divergence_observations: Optional[Counter] = None
+index_divergence_purged: Optional[Counter] = None
+index_divergence_readmitted: Optional[Counter] = None
+index_divergence_audits: Optional[Counter] = None
+index_divergence_negative_skips: Optional[Counter] = None
+
 _APPLY_DELAY_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
     5.0, 10.0, 30.0, 60.0,
@@ -183,6 +195,9 @@ def register_metrics(registry=None) -> None:
     global prediction_sessions, prediction_jobs, prediction_blocks
     global prediction_mispredicted_blocks, prefetch_drops
     global trace_carrier_errors, slo_burn_rate
+    global index_divergence_observations, index_divergence_purged
+    global index_divergence_readmitted, index_divergence_audits
+    global index_divergence_negative_skips
 
     with _register_lock:
         if _registered:
@@ -517,6 +532,38 @@ def register_metrics(registry=None) -> None:
             labelnames=("source",),
             registry=reg,
         )
+        index_divergence_observations = Counter(
+            "kvcache_index_divergence_observations_total",
+            "Index-vs-reality divergence observations, labeled by the "
+            "fixed evidence source (antientropy.DIVERGENCE_SOURCES: "
+            "fetch_miss / orphan_removal / audit_phantom)",
+            labelnames=("source",),
+            registry=reg,
+        )
+        index_divergence_purged = Counter(
+            "kvcache_index_divergence_purged_entries_total",
+            "Phantom index entries purged by the anti-entropy repair "
+            "loop (fetch-miss feedback + residency audits)",
+            registry=reg,
+        )
+        index_divergence_readmitted = Counter(
+            "kvcache_index_divergence_readmitted_blocks_total",
+            "Resident-but-unadvertised blocks re-admitted into the index "
+            "by residency audits",
+            registry=reg,
+        )
+        index_divergence_audits = Counter(
+            "kvcache_index_divergence_audits_total",
+            "Per-pod residency audit verdicts applied by the anti-entropy "
+            "auditor",
+            registry=reg,
+        )
+        index_divergence_negative_skips = Counter(
+            "kvcache_index_divergence_negative_skips_total",
+            "Peer-resolver primary picks demoted by the negative-result "
+            "cache (the peer just disclaimed that block)",
+            registry=reg,
+        )
         _registered = True
 
 
@@ -739,6 +786,31 @@ def count_prediction_mispredicted(blocks: int) -> None:
 def count_prefetch_drop(source: str) -> None:
     if prefetch_drops is not None:
         prefetch_drops.labels(source=source).inc()
+
+
+def count_divergence(source: str, n: int = 1) -> None:
+    if index_divergence_observations is not None and n:
+        index_divergence_observations.labels(source=source).inc(n)
+
+
+def count_divergence_purged(n: int) -> None:
+    if index_divergence_purged is not None and n:
+        index_divergence_purged.inc(n)
+
+
+def count_divergence_readmitted(n: int) -> None:
+    if index_divergence_readmitted is not None and n:
+        index_divergence_readmitted.inc(n)
+
+
+def count_divergence_audit() -> None:
+    if index_divergence_audits is not None:
+        index_divergence_audits.inc()
+
+
+def count_negative_cache_skip() -> None:
+    if index_divergence_negative_skips is not None:
+        index_divergence_negative_skips.inc()
 
 
 def count_trace_carrier_error() -> None:

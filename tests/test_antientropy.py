@@ -1,0 +1,937 @@
+"""Index anti-entropy suite (antientropy/ + Index.remove_entries).
+
+Covers, per ISSUE 15:
+
+- `remove_entries` semantics pinned ≡ (export, filter, import) on all
+  four backends (in_memory, sharded, cost_aware, redis-on-fake_redis),
+  plus the backend-specific obligations: cost_aware re-credits its byte
+  budget, sharded republishes its lock-free read view immediately.
+- The trust tracker's accuracy EWMA / demotion factor / recovery, and
+  the acceptance pin: an attached-but-clean tracker is bit-identical to
+  the tracker-absent read path (same dict object out of adjust_scores).
+- Fetch-miss feedback: chain-suffix purges, host-tier scoping, and the
+  evidence discipline (no purge → no trust charge).
+- The resolver's negative-result cache (skip-as-primary TTL, counted).
+- Orphan BlockRemoved counting in the event pool.
+- The convergence property: after faults stop, K audit rounds drive the
+  index view back to ground truth on every backend.
+
+Policy tests run unmarked in tier-1; the `antientropy` marker covers the
+end-to-end legs that move real bytes through libkvtransfer.so (auto-
+skipped in conftest when the transfer lib isn't built).
+"""
+
+import pytest
+
+from tests.fake_redis import FakeRedisServer
+from llm_d_kv_cache_manager_tpu.antientropy import (
+    AntiEntropyConfig,
+    AntiEntropyTracker,
+    AuditorConfig,
+    DIVERGENCE_SOURCES,
+    FetchMissFeedback,
+    ResidencyAuditor,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.cost_aware import (
+    CostAwareIndexConfig,
+    CostAwareMemoryIndex,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+    InMemoryIndex,
+    InMemoryIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import IndexView
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.instrumented import (
+    InstrumentedIndex,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import (
+    RedisIndex,
+    RedisIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.sharded import (
+    ShardedIndex,
+    ShardedIndexConfig,
+)
+
+MODEL = "m"
+
+
+def _k(i: int) -> Key:
+    return Key(MODEL, i)
+
+
+_fake_redis = None
+
+
+def _redis_backend():
+    global _fake_redis
+    if _fake_redis is None:
+        _fake_redis = FakeRedisServer()
+    index = RedisIndex(RedisIndexConfig(url=_fake_redis.url))
+    index._pipeline([("FLUSHALL",)])
+    return index
+
+
+BACKENDS = {
+    "in_memory": lambda: InMemoryIndex(
+        InMemoryIndexConfig(size=1000, pod_cache_size=10)
+    ),
+    "sharded": lambda: ShardedIndex(
+        ShardedIndexConfig(size=1000, pod_cache_size=10)
+    ),
+    "cost_aware": lambda: CostAwareMemoryIndex(
+        CostAwareIndexConfig(max_size_bytes="1MiB", pod_cache_size=10)
+    ),
+    "redis": _redis_backend,
+    "instrumented": lambda: InstrumentedIndex(
+        InMemoryIndex(InMemoryIndexConfig(size=1000, pod_cache_size=10))
+    ),
+}
+
+
+@pytest.fixture(params=sorted(BACKENDS))
+def index(request):
+    yield BACKENDS[request.param]()
+
+
+def _seed(index, n_keys=6, pods=(("a", "hbm"), ("a", "host"), ("b", "hbm"))):
+    keys = [_k(i) for i in range(n_keys)]
+    index.add(keys, keys, [PodEntry(p, t) for p, t in pods])
+    return keys
+
+
+def _entries_as_set(view: IndexView):
+    return {
+        (model, h, frozenset(pods)) for model, h, pods in view.entries
+        if pods  # an empty-pod row carries no placements either way
+    }
+
+
+def _filtered_view(view: IndexView, pod, hashes, tiers=None):
+    """The (export, filter, import) reference semantics: drop `pod`'s
+    entries (tier-scoped) for exactly `hashes`; drop emptied keys and the
+    engine rows pointing at them."""
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import pod_matches
+
+    target = {pod}
+    hashes = set(hashes)
+    entries = []
+    dropped = set()
+    for model, h, pods in view.entries:
+        if h in hashes:
+            pods = tuple(
+                (p, t) for p, t in pods
+                if not (
+                    pod_matches(p, target) and (tiers is None or t in tiers)
+                )
+            )
+        if pods:
+            entries.append((model, h, pods))
+        else:
+            dropped.add((model, h))
+    engine_map = [
+        row for row in view.engine_map if (row[2], row[3]) not in dropped
+    ]
+    return IndexView(entries=entries, engine_map=engine_map)
+
+
+class TestRemoveEntries:
+    def test_targeted_purge_counts_and_scopes(self, index):
+        keys = _seed(index)
+        removed = index.remove_entries("a", keys[:3])
+        assert removed == 6  # two tiers x three keys
+        hits = index.lookup(keys, set())
+        for key in keys[:3]:
+            assert {e.pod_identifier for e in hits[key]} == {"b"}
+        for key in keys[3:]:
+            assert {e.pod_identifier for e in hits[key]} == {"a", "b"}
+
+    def test_tier_scoped_purge(self, index):
+        keys = _seed(index)
+        removed = index.remove_entries(
+            "a", keys[:2], device_tiers={"host"}
+        )
+        assert removed == 2
+        hits = index.lookup(keys[:2], set())
+        for key in keys[:2]:
+            tiers = {
+                e.device_tier for e in hits[key] if e.pod_identifier == "a"
+            }
+            assert tiers == {"hbm"}  # the device entry survived
+
+    def test_unknown_keys_and_pods_are_noops(self, index):
+        keys = _seed(index)
+        assert index.remove_entries("nobody", keys) == 0
+        assert index.remove_entries("a", [_k(999)]) == 0
+        assert len(index.lookup(keys, set())) == len(keys)
+
+    def test_emptied_keys_cut_the_chain(self, index):
+        keys = _seed(index, pods=(("a", "hbm"),))
+        removed = index.remove_entries("a", [keys[2]])
+        assert removed == 1
+        hits = index.lookup(keys, set())
+        # Chain cut exactly at the emptied key (seed lookup semantics).
+        assert set(hits) == set(keys[:2])
+
+    def test_matches_export_filter_import(self, index):
+        keys = _seed(index)
+        before = index.export_view()
+        expected = _filtered_view(
+            before, "a", [k.chunk_hash for k in keys[:4]]
+        )
+        index.remove_entries("a", keys[:4])
+        after = index.export_view()
+        assert _entries_as_set(after) == _entries_as_set(expected)
+
+    def test_matches_export_filter_import_tier_scoped(self, index):
+        keys = _seed(index)
+        before = index.export_view()
+        expected = _filtered_view(
+            before, "b", [k.chunk_hash for k in keys], tiers={"hbm"}
+        )
+        index.remove_entries("b", keys, device_tiers={"hbm"})
+        after = index.export_view()
+        assert _entries_as_set(after) == _entries_as_set(expected)
+
+    def test_engine_map_rows_follow_dropped_keys(self):
+        # In-memory backends drop engine rows pointing at emptied keys
+        # (redis leaves a dangling alias that the evict path self-heals —
+        # remove_entries there must stay O(targeted), never a SCAN).
+        for name in ("in_memory", "sharded", "cost_aware"):
+            index = BACKENDS[name]()
+            keys = _seed(index, pods=(("a", "hbm"),))
+            before = index.export_view()
+            expected = _filtered_view(
+                before, "a", [k.chunk_hash for k in keys]
+            )
+            index.remove_entries("a", keys)
+            after = index.export_view()
+            assert _entries_as_set(after) == _entries_as_set(expected)
+            assert sorted(after.engine_map) == sorted(expected.engine_map), (
+                name
+            )
+
+    def test_bare_pod_purges_dp_ranked_identities(self, index):
+        keys = [_k(i) for i in range(3)]
+        index.add(keys, keys, [
+            PodEntry("pod-1@dp0", "hbm"), PodEntry("pod-1@dp1", "hbm"),
+            PodEntry("pod-2", "hbm"),
+        ])
+        removed = index.remove_entries("pod-1", keys)
+        assert removed == 6  # both ranks, every key
+        hits = index.lookup(keys, set())
+        for key in keys:
+            assert {e.pod_identifier for e in hits[key]} == {"pod-2"}
+
+    def test_cost_aware_recredits_budget(self):
+        index = CostAwareMemoryIndex(
+            CostAwareIndexConfig(max_size_bytes="1MiB")
+        )
+        keys = _seed(index)
+        before = index.total_cost_bytes
+        removed = index.remove_entries("a", keys)
+        assert removed == 12
+        after = index.total_cost_bytes
+        assert after < before
+        # Purging the rest empties the index and zeroes the budget.
+        index.remove_entries("b", keys)
+        assert index.total_cost_bytes == 0
+
+    def test_sharded_read_view_republished_immediately(self):
+        index = ShardedIndex(ShardedIndexConfig(
+            size=1000, pod_cache_size=10,
+            # Never-touch reads: the lookup below hits ONLY the published
+            # lock-free view, so this asserts the republish, not a
+            # refresh side effect.
+            recency_refresh_interval=10**9,
+        ))
+        keys = _seed(index)
+        index.remove_entries("a", keys[:2])
+        hits = index.lookup(keys, set())
+        assert {e.pod_identifier for e in hits[keys[0]]} == {"b"}
+
+    def test_instrumented_counts_evictions(self):
+        from llm_d_kv_cache_manager_tpu.metrics import collector as metrics
+
+        metrics.register_metrics()
+        index = BACKENDS["instrumented"]()
+        keys = _seed(index)
+        before = metrics.counter_value(metrics.index_evictions)
+        removed = index.remove_entries("a", keys)
+        assert removed > 0
+        assert metrics.counter_value(metrics.index_evictions) == (
+            before + removed
+        )
+
+
+class TestTrustTracker:
+    def test_unseen_pods_are_fully_trusted(self):
+        t = AntiEntropyTracker()
+        assert t.accuracy("anyone") == 1.0
+        assert t.factor_for("anyone") == 1.0
+
+    def test_clean_tracker_returns_same_scores_object(self):
+        t = AntiEntropyTracker()
+        scores = {"a": 3.0, "b": 1.0}
+        assert t.adjust_scores(scores) is scores
+        # Clean audits keep it that way.
+        t.observe_audit("a", verified=10, phantom=0)
+        assert t.adjust_scores(scores) is scores
+
+    def test_fetch_misses_drop_accuracy_and_demote(self):
+        t = AntiEntropyTracker(AntiEntropyConfig(accuracy_alpha=0.5))
+        t.observe_fetch_miss("a", blocks=2, purged=2)
+        assert t.accuracy("a") == 0.5
+        out = t.adjust_scores({"a": 2.0, "b": 1.0})
+        assert out["a"] == pytest.approx(2.0 * (0.5 / 0.9))
+        assert out["b"] == 1.0
+
+    def test_min_factor_floor(self):
+        t = AntiEntropyTracker(AntiEntropyConfig(
+            accuracy_alpha=1.0, min_factor=0.25
+        ))
+        t.observe_fetch_miss("a")
+        assert t.accuracy("a") == 0.0
+        assert t.factor_for("a") == 0.25
+
+    def test_clean_audits_recover_trust(self):
+        t = AntiEntropyTracker(AntiEntropyConfig(accuracy_alpha=0.5))
+        t.observe_audit("a", verified=0, phantom=10)
+        assert t.factor_for("a") < 1.0
+        for _ in range(6):
+            t.observe_audit("a", verified=10, phantom=0)
+        assert t.factor_for("a") == 1.0
+
+    def test_empty_consistent_audit_counts_as_clean(self):
+        # A fully-purged pod whose (empty) advertised set matches its
+        # (empty) resident set must be able to earn trust back.
+        t = AntiEntropyTracker(AntiEntropyConfig(accuracy_alpha=1.0))
+        t.observe_fetch_miss("a")
+        assert t.factor_for("a") < 1.0
+        t.observe_audit("a", verified=0, phantom=0)
+        assert t.factor_for("a") == 1.0
+
+    def test_orphan_removals_counted_but_never_charged(self):
+        t = AntiEntropyTracker()
+        t.observe_orphan_removal("a", 5)
+        assert t.accuracy("a") == 1.0
+        assert t.status()["pods"]["a"]["orphan_removals"] == 5
+
+    def test_dp_ranked_scores_demoted_by_base_evidence(self):
+        t = AntiEntropyTracker(AntiEntropyConfig(accuracy_alpha=1.0))
+        t.observe_fetch_miss("pod-1")
+        out = t.adjust_scores({"pod-1@dp0": 4.0, "pod-2": 1.0})
+        assert out["pod-1@dp0"] < 4.0
+        assert out["pod-2"] == 1.0
+
+    def test_status_shape(self):
+        t = AntiEntropyTracker()
+        t.observe_fetch_miss("a", purged=3)
+        t.observe_audit("b", verified=4, phantom=1, purged=1, readmitted=2)
+        s = t.status()
+        assert s["distrusted_pods"] >= 1
+        assert s["totals"]["purged_entries"] == 4
+        assert s["totals"]["readmitted_blocks"] == 2
+        assert set(s["pods"]) == {"a", "b"}
+        assert "factor" in s["pods"]["a"]
+
+
+class TestIndexerBitIdentity:
+    def _indexer(self, tracker):
+        import os
+
+        from llm_d_kv_cache_manager_tpu.kvcache.indexer import (
+            Indexer,
+            IndexerConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+            TokenizationPool,
+            TokenizersPoolConfig,
+        )
+        from tests.conftest import TEST_MODEL_NAME, TEST_TOKENIZER_JSON
+
+        indexer = Indexer(
+            config=IndexerConfig(),
+            tokenization_pool=TokenizationPool(TokenizersPoolConfig(
+                workers=1,
+                local_tokenizer_files={TEST_MODEL_NAME: TEST_TOKENIZER_JSON},
+            )),
+            antientropy=tracker,
+        )
+        indexer.run()
+        return indexer
+
+    def test_attached_clean_tracker_is_bit_identical(self):
+        """Acceptance pin: Indexer scores with an attached-but-clean
+        anti-entropy tracker ≡ the tracker-absent path, bit for bit."""
+        from tests.conftest import TEST_MODEL_NAME
+
+        prompt = "the quick brown fox jumps over the lazy dog " * 8
+        tracker = AntiEntropyTracker()
+        with_tracker = self._indexer(tracker)
+        without = self._indexer(None)
+        try:
+            for indexer in (with_tracker, without):
+                enc = indexer.tokenizers_pool.tokenizer.encode(
+                    prompt, TEST_MODEL_NAME
+                )
+                keys = indexer.token_processor.tokens_to_kv_block_keys(
+                    None, enc.tokens, TEST_MODEL_NAME
+                )
+                indexer.kv_block_index.add(
+                    keys, keys,
+                    [PodEntry("pod-a", "hbm"), PodEntry("pod-b", "host")],
+                )
+            a = with_tracker.get_pod_scores_ex(prompt, TEST_MODEL_NAME, [])
+            b = without.get_pod_scores_ex(prompt, TEST_MODEL_NAME, [])
+            assert a.scores == b.scores
+            assert a.match_blocks == b.match_blocks
+            assert a.block_hashes == b.block_hashes
+            # Dirty the tracker: now (and only now) scores demote.
+            tracker.observe_fetch_miss("pod-a", blocks=4, purged=4)
+            c = with_tracker.get_pod_scores_ex(prompt, TEST_MODEL_NAME, [])
+            assert c.scores["pod-a"] < a.scores["pod-a"]
+            assert c.scores["pod-b"] == a.scores["pod-b"]
+        finally:
+            with_tracker.shutdown()
+            without.shutdown()
+
+
+class TestFetchMissFeedback:
+    def _setup(self, tracker=None):
+        index = InMemoryIndex(InMemoryIndexConfig(size=1000))
+        keys = [_k(i) for i in range(8)]
+        index.add(keys, keys, [
+            PodEntry("pod-a", "host"), PodEntry("pod-a", "hbm"),
+            PodEntry("pod-b", "host"),
+        ])
+        feedback = FetchMissFeedback(
+            index, MODEL,
+            pod_for_addr={("10.0.0.1", 7): "pod-a"}.get,
+            tracker=tracker,
+        )
+        return index, keys, feedback
+
+    def test_purges_missing_block_and_chain_suffix(self):
+        index, keys, feedback = self._setup()
+        hashes = [k.chunk_hash for k in keys]
+        purged = feedback.on_fetch_misses(
+            "10.0.0.1", 7, hashes[2:6], [hashes[3]]
+        )
+        # Suffix from the first miss: hashes 3,4,5 — host entries only.
+        assert purged == 3
+        hits = index.lookup(keys, set())
+        for i in (3, 4, 5):
+            entries = {
+                (e.pod_identifier, e.device_tier) for e in hits[keys[i]]
+            }
+            assert ("pod-a", "host") not in entries
+            assert ("pod-a", "hbm") in entries  # device evidence untouched
+            assert ("pod-b", "host") in entries
+        # Keys before the miss keep pod-a's host entry.
+        assert ("pod-a", "host") in {
+            (e.pod_identifier, e.device_tier) for e in hits[keys[2]]
+        }
+
+    def test_unadvertised_miss_is_not_divergence(self):
+        tracker = AntiEntropyTracker()
+        index, keys, feedback = self._setup(tracker)
+        # A block nobody indexed: the peer honestly doesn't have it.
+        purged = feedback.on_fetch_misses("10.0.0.1", 7, [999], [999])
+        assert purged == 0
+        assert tracker.accuracy("pod-a") == 1.0
+        # An advertised one IS divergence.
+        feedback.on_fetch_misses(
+            "10.0.0.1", 7, [keys[0].chunk_hash], [keys[0].chunk_hash]
+        )
+        assert tracker.accuracy("pod-a") < 1.0
+
+    def test_unknown_peer_is_ignored(self):
+        index, keys, feedback = self._setup()
+        assert feedback.on_fetch_misses(
+            "1.2.3.4", 5, [keys[0].chunk_hash], [keys[0].chunk_hash]
+        ) == 0
+
+
+class TestNegativeCache:
+    def _resolver(self, now, ttl=3.0):
+        from llm_d_kv_cache_manager_tpu.engine.tiering import (
+            IndexBackedPeerResolver,
+        )
+
+        index = InMemoryIndex(InMemoryIndexConfig(size=1000))
+        keys = [_k(i) for i in range(4)]
+        index.add(keys, keys, [
+            PodEntry("pod-a", "host"), PodEntry("pod-b", "host"),
+        ])
+        resolver = IndexBackedPeerResolver(
+            index, MODEL,
+            {"pod-a": ("10.0.0.1", 1), "pod-b": ("10.0.0.2", 2)},
+            "pod-self",
+            rendezvous_primary=True,
+            negative_ttl_s=ttl,
+            clock=lambda: now[0],
+        )
+        return resolver, keys
+
+    def test_negative_peer_demoted_from_primary_for_ttl(self):
+        now = [0.0]
+        resolver, keys = self._resolver(now)
+        h = keys[0].chunk_hash
+        primary = resolver.candidates(h)[0]
+        other = next(a for a in resolver.candidates(h) if a != primary)
+        resolver.note_miss(primary, [h])
+        ranked = resolver.candidates(h)
+        assert ranked[0] == other
+        assert primary in ranked  # demoted, never dropped
+        assert resolver.negative_skips == 1
+        # TTL lapse restores the original ranking.
+        now[0] = 10.0
+        assert resolver.candidates(h)[0] == primary
+
+    def test_only_holder_still_tried(self):
+        now = [0.0]
+        resolver, keys = self._resolver(now)
+        h = keys[0].chunk_hash
+        for addr in list(resolver.candidates(h)):
+            resolver.note_miss(addr, [h])
+        ranked = resolver.candidates(h)
+        assert len(ranked) == 2  # everyone negative: order unchanged, kept
+
+    def test_zero_ttl_disables(self):
+        now = [0.0]
+        resolver, keys = self._resolver(now, ttl=0.0)
+        h = keys[0].chunk_hash
+        before = resolver.candidates(h)
+        resolver.note_miss(before[0], [h])
+        assert resolver.candidates(h) == before
+        assert resolver.negative_skips == 0
+
+    def test_other_blocks_unaffected(self):
+        now = [0.0]
+        resolver, keys = self._resolver(now)
+        h0, h1 = keys[0].chunk_hash, keys[1].chunk_hash
+        resolver.note_miss(resolver.candidates(h0)[0], [h0])
+        # The negative entry is per-(peer, block): h1's ranking is its own.
+        ranked1 = resolver.candidates(h1)
+        assert resolver.negative_skips <= 1
+        assert len(ranked1) == 2
+
+
+class TestOrphanRemovals:
+    def _pool(self, tracker):
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+            ChunkedTokenDatabase,
+            TokenProcessorConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.kvevents.pool import (
+            EventPool,
+            EventPoolConfig,
+        )
+
+        index = InMemoryIndex(InMemoryIndexConfig(size=1000))
+        pool = EventPool(
+            EventPoolConfig(concurrency=1),
+            index,
+            ChunkedTokenDatabase(TokenProcessorConfig(block_size=4)),
+            divergence=tracker,
+        )
+        return pool, index
+
+    def test_orphan_removed_counted_per_pod(self):
+        from llm_d_kv_cache_manager_tpu.kvevents.events import (
+            BlockRemoved,
+            BlockStored,
+            EventBatch,
+        )
+
+        tracker = AntiEntropyTracker()
+        pool, index = self._pool(tracker)
+        # A store the index knows, then a removal for it: NOT an orphan.
+        pool._digest_events("pod-a", MODEL, EventBatch(ts=0.0, events=[
+            BlockStored(
+                block_hashes=[11], parent_block_hash=None,
+                token_ids=[1, 2, 3, 4], block_size=4, medium="hbm",
+            ),
+        ]))
+        pool._digest_events("pod-a", MODEL, EventBatch(ts=0.0, events=[
+            BlockRemoved(block_hashes=[11], medium="hbm"),
+        ]))
+        assert tracker.status()["totals"]["orphan_removals"] == 0
+        # A removal for a block never stored: orphan, counted per pod.
+        pool._digest_events("pod-a", MODEL, EventBatch(ts=0.0, events=[
+            BlockRemoved(block_hashes=[777, 778], medium="hbm"),
+        ]))
+        s = tracker.status()
+        assert s["totals"]["orphan_removals"] == 2
+        assert s["pods"]["pod-a"]["orphan_removals"] == 2
+        # Orphans are index evidence, not pod lies: no demotion.
+        assert tracker.factor_for("pod-a") == 1.0
+
+    def test_no_tracker_no_probe(self):
+        from llm_d_kv_cache_manager_tpu.kvevents.events import (
+            BlockRemoved,
+            EventBatch,
+        )
+
+        pool, index = self._pool(None)
+        calls = []
+        orig = index.get_request_key
+        index.get_request_key = lambda k: (calls.append(k), orig(k))[1]
+        pool._digest_events("pod-a", MODEL, EventBatch(ts=0.0, events=[
+            BlockRemoved(block_hashes=[777], medium="hbm"),
+        ]))
+        # The orphan probe must cost nothing when no tracker is attached
+        # (evict's own internal resolution doesn't go through this
+        # monkeypatched surface on the in-memory backend).
+        assert calls == []
+
+
+class _FakePodReality:
+    """Ground truth for auditor tests: per-pod resident sets by tier."""
+
+    def __init__(self):
+        self.device = {}
+        self.host = {}
+        self.unreachable = set()
+
+    def digest_fn(self, pod, device_hashes, host_hashes, max_extra):
+        if pod in self.unreachable:
+            return None
+        dev = self.device.get(pod, set())
+        host = self.host.get(pod, set())
+        return {
+            "device": {h for h in device_hashes if h in dev},
+            "host": {h for h in host_hashes if h in host},
+            "extra_device": sorted(dev)[:max_extra],
+            "extra_host": sorted(host)[:max_extra],
+        }
+
+
+class TestResidencyAuditor:
+    def _auditor(self, index, reality, tracker=None, **cfg):
+        clock = cfg.pop("clock", None) or (lambda: 0.0)
+        return ResidencyAuditor(
+            index, MODEL, reality.digest_fn, tracker=tracker,
+            config=AuditorConfig(**cfg), clock=clock,
+        )
+
+    def test_phantoms_purged_and_residents_readmitted(self):
+        index = InMemoryIndex(InMemoryIndexConfig(size=1000))
+        keys = [_k(i) for i in range(6)]
+        index.add(keys, keys, [PodEntry("pod-a", "hbm")])
+        reality = _FakePodReality()
+        # Reality: pod-a holds 0..3 plus 100..101 the index never saw.
+        reality.device["pod-a"] = {0, 1, 2, 3, 100, 101}
+        auditor = self._auditor(index, reality, sample_per_pod=100)
+        verdict = auditor.audit_once(0.0)["pod-a"]
+        assert verdict["phantom"] == 2       # hashes 4, 5
+        assert verdict["purged"] == 2
+        assert verdict["verified"] == 4
+        assert verdict["readmitted"] == 2    # hashes 100, 101
+        view = index.export_view()
+        advertised = {h for _m, h, pods in view.entries if pods}
+        assert advertised == {0, 1, 2, 3, 100, 101}
+
+    def test_tier_scoped_repair(self):
+        index = InMemoryIndex(InMemoryIndexConfig(size=1000))
+        keys = [_k(0)]
+        index.add(keys, keys, [
+            PodEntry("pod-a", "hbm"), PodEntry("pod-a", "host"),
+        ])
+        reality = _FakePodReality()
+        reality.device["pod-a"] = {0}   # device copy real
+        reality.host["pod-a"] = set()   # host copy phantom
+        auditor = self._auditor(index, reality, sample_per_pod=100)
+        verdict = auditor.audit_once(0.0)["pod-a"]
+        assert verdict["phantom"] == 1 and verdict["purged"] == 1
+        entries = index.lookup(keys, set())[keys[0]]
+        assert {(e.pod_identifier, e.device_tier) for e in entries} == {
+            ("pod-a", "hbm")
+        }
+
+    def test_unreachable_pod_skipped_not_punished(self):
+        tracker = AntiEntropyTracker()
+        index = InMemoryIndex(InMemoryIndexConfig(size=1000))
+        keys = [_k(0)]
+        index.add(keys, keys, [PodEntry("pod-a", "hbm")])
+        reality = _FakePodReality()
+        reality.unreachable.add("pod-a")
+        auditor = self._auditor(index, reality, tracker=tracker,
+                                sample_per_pod=100)
+        assert auditor.audit_once(0.0) == {}
+        assert auditor.stats["pods_unreachable"] == 1
+        assert tracker.accuracy("pod-a") == 1.0
+        assert len(index.lookup(keys, set())) == 1  # nothing purged
+
+    def test_tick_interval_gating(self):
+        index = InMemoryIndex(InMemoryIndexConfig(size=1000))
+        reality = _FakePodReality()
+        now = [0.0]
+        auditor = self._auditor(
+            index, reality, interval_s=5.0, clock=lambda: now[0]
+        )
+        assert auditor.tick() is True
+        assert auditor.tick() is False
+        now[0] = 5.1
+        assert auditor.tick() is True
+        assert auditor.stats["rounds"] == 2
+
+    def test_escalation_full_audit_after_distrust(self):
+        tracker = AntiEntropyTracker(AntiEntropyConfig(accuracy_alpha=1.0))
+        index = InMemoryIndex(InMemoryIndexConfig(size=1000))
+        keys = [_k(i) for i in range(64)]
+        index.add(keys, keys, [PodEntry("pod-a", "hbm")])
+        reality = _FakePodReality()
+        reality.device["pod-a"] = set()  # everything phantom
+        auditor = self._auditor(
+            index, reality, tracker=tracker, sample_per_pod=4,
+            readmit_sample=0,
+        )
+        auditor.audit_once(0.0)  # sampled round: catches the lie
+        assert tracker.factor_for("pod-a") < 1.0
+        auditor.audit_once(1.0)  # escalated round: full reconciliation
+        assert auditor.stats["escalated_audits"] >= 1
+        view = index.export_view()
+        assert not any(pods for _m, _h, pods in view.entries)
+
+    @pytest.mark.parametrize("backend", sorted(BACKENDS))
+    def test_convergence_after_faults_stop(self, backend):
+        """The convergence property: once faults stop, K audit rounds
+        drive index view ≡ ground truth on every backend — phantoms
+        purged (both tiers), lost residents re-admitted."""
+        index = BACKENDS[backend]()
+        reality = _FakePodReality()
+        # Ground truth: three pods with overlapping resident sets.
+        reality.device["pod-0"] = set(range(0, 20))
+        reality.device["pod-1"] = set(range(10, 30))
+        reality.host["pod-2"] = set(range(5, 25))
+        # Diverged index: pod-0 advertises 0..30 (10 phantoms), pod-1
+        # advertises only 10..15 (15 lost residents), pod-2 advertises
+        # 0..10 at host (5 phantoms, 15 lost).
+        k = lambda i: _k(i)  # noqa: E731
+        keys_a = [k(i) for i in range(0, 30)]
+        index.add(keys_a, keys_a, [PodEntry("pod-0", "hbm")])
+        keys_b = [k(i) for i in range(10, 16)]
+        index.add(keys_b, keys_b, [PodEntry("pod-1", "hbm")])
+        keys_c = [k(i) for i in range(0, 11)]
+        index.add(keys_c, keys_c, [PodEntry("pod-2", "host")])
+        tracker = AntiEntropyTracker()
+        auditor = ResidencyAuditor(
+            index, MODEL, reality.digest_fn, tracker=tracker,
+            config=AuditorConfig(
+                sample_per_pod=8, readmit_sample=64, seed=7
+            ),
+        )
+        for round_i in range(8):
+            auditor.audit_once(float(round_i))
+        view = index.export_view()
+        got = {"device": {}, "host": {}}
+        for _model, h, pods in view.entries:
+            for pod, tier in pods:
+                fam = "host" if tier in ("host", "cpu") else "device"
+                got[fam].setdefault(pod, set()).add(h)
+        assert got["device"].get("pod-0", set()) == reality.device["pod-0"]
+        assert got["device"].get("pod-1", set()) == reality.device["pod-1"]
+        assert got["host"].get("pod-2", set()) == reality.host["pod-2"]
+        # And the verdicts converged to clean: trust fully restored.
+        for pod in ("pod-0", "pod-1", "pod-2"):
+            assert tracker.factor_for(pod) == 1.0
+
+
+class TestEngineDigestSurface:
+    def test_block_manager_cached_hashes_bounded(self):
+        from llm_d_kv_cache_manager_tpu.engine.block_manager import (
+            BlockManager,
+            BlockManagerConfig,
+        )
+
+        bm = BlockManager(BlockManagerConfig(n_pages=32, page_size=4))
+        state = bm.allocate(list(range(16)))
+        bm.commit_prefill(state)
+        all_hashes = bm.cached_hashes()
+        assert len(all_hashes) == 4
+        assert bm.cached_hashes(limit=2) == all_hashes[:2]
+        for h in all_hashes:
+            assert bm.is_cached(h)
+
+    def test_tier_store_staged_subset_and_sample(self):
+        from llm_d_kv_cache_manager_tpu.engine.tiering import (
+            NullPageCodec,
+            TieredKVStore,
+        )
+
+        class _FakeConnector:
+            def __init__(self):
+                self.store = {}
+
+            def stage(self, h, payload, token_ids, block_size,
+                      parent_hash=None, lora_id=None):
+                self.store[h] = payload
+
+            def drop(self, h):
+                self.store.pop(h, None)
+
+        store = TieredKVStore(_FakeConnector(), NullPageCodec(),
+                              capacity_blocks=16)
+        store._stage_many([
+            (h, [1, 2], None, 0, None) for h in (10, 11, 12)
+        ])
+        assert store.staged_subset([10, 11, 99]) == {10, 11}
+        assert store.staged_sample(2) == [10, 11]
+        assert store.staged_sample(0) == []
+
+
+class TestReadyzIndexHealth:
+    def test_index_health_section(self):
+        """/readyz gains an `index_health` section when ANTIENTROPY is
+        on: per-pod divergence EWMA, last audit time, purge/readmit
+        counters."""
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from llm_d_kv_cache_manager_tpu.api.http_service import (
+            ScoringService,
+        )
+        from llm_d_kv_cache_manager_tpu.kvcache.indexer import (
+            Indexer,
+            IndexerConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+            TokenizationPool,
+            TokenizersPoolConfig,
+        )
+        from tests.conftest import TEST_MODEL_NAME, TEST_TOKENIZER_JSON
+
+        indexer = Indexer(
+            config=IndexerConfig(),
+            tokenization_pool=TokenizationPool(TokenizersPoolConfig(
+                workers=1,
+                local_tokenizer_files={TEST_MODEL_NAME: TEST_TOKENIZER_JSON},
+            )),
+        )
+        env = {
+            "zmq_endpoint": "tcp://*:0",
+            "zmq_topic": "kv@",
+            "pool_concurrency": 1,
+            "hash_seed": "",
+            "block_size": 16,
+            "http_port": 0,
+            "enable_metrics": False,
+            "antientropy": True,
+            "antientropy_distrust_threshold": 0.9,
+        }
+        service = ScoringService(env, indexer=indexer)
+        assert service.antientropy is not None
+        assert indexer.antientropy is service.antientropy
+        assert service.event_pool.divergence is service.antientropy
+        service.antientropy.observe_audit(
+            "pod-x", verified=3, phantom=1, purged=1, now=123.0
+        )
+
+        async def run():
+            async with TestClient(TestServer(service.make_app())) as client:
+                service.start(with_subscriber=False)
+                resp = await client.get("/readyz")
+                data = await resp.json()
+                section = data["index_health"]
+                pod = section["pods"]["pod-x"]
+                assert pod["accuracy_ewma"] < 1.0
+                assert pod["last_audit_t"] == 123.0
+                assert section["totals"]["purged_entries"] == 1
+                # Divergence never gates readiness.
+                assert resp.status == 200
+                resp = await client.get("/antientropy/status")
+                assert resp.status == 200
+                assert (await resp.json())["pods"]["pod-x"]
+
+        try:
+            asyncio.run(run())
+        finally:
+            service.stop()
+
+    def test_disabled_returns_400_and_null_section(self):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from llm_d_kv_cache_manager_tpu.api.http_service import (
+            ScoringService,
+        )
+        from llm_d_kv_cache_manager_tpu.kvcache.indexer import (
+            Indexer,
+            IndexerConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+            TokenizationPool,
+            TokenizersPoolConfig,
+        )
+        from tests.conftest import TEST_MODEL_NAME, TEST_TOKENIZER_JSON
+
+        indexer = Indexer(
+            config=IndexerConfig(),
+            tokenization_pool=TokenizationPool(TokenizersPoolConfig(
+                workers=1,
+                local_tokenizer_files={TEST_MODEL_NAME: TEST_TOKENIZER_JSON},
+            )),
+        )
+        env = {
+            "zmq_endpoint": "tcp://*:0", "zmq_topic": "kv@",
+            "pool_concurrency": 1, "hash_seed": "", "block_size": 16,
+            "http_port": 0, "enable_metrics": False,
+        }
+        service = ScoringService(env, indexer=indexer)
+
+        async def run():
+            async with TestClient(TestServer(service.make_app())) as client:
+                service.start(with_subscriber=False)
+                resp = await client.get("/readyz")
+                assert (await resp.json())["index_health"] is None
+                resp = await client.get("/antientropy/status")
+                assert resp.status == 400
+
+        try:
+            asyncio.run(run())
+        finally:
+            service.stop()
+
+
+@pytest.mark.antientropy
+class TestFetchMissE2E:
+    """End-to-end: a real transfer server answering per-block -2 drives
+    the feedback purge through a real TransferClient (libkvtransfer.so)."""
+
+    def test_explicit_miss_fires_feedback_and_purges(self):
+        from llm_d_kv_cache_manager_tpu.kv_connectors.connector import (
+            BlockTransferServer,
+            TransferClient,
+            TransferClientConfig,
+        )
+
+        index = InMemoryIndex(InMemoryIndexConfig(size=1000))
+        keys = [_k(i) for i in range(4)]
+        index.add(keys, keys, [PodEntry("pod-a", "host")])
+        server = BlockTransferServer()
+        try:
+            server.put(keys[0].chunk_hash, b"aa")  # only block 0 is real
+            feedback = FetchMissFeedback(
+                index, MODEL,
+                pod_for_addr={("127.0.0.1", server.port): "pod-a"}.get,
+            )
+            client = TransferClient(TransferClientConfig())
+            client.on_fetch_misses = feedback.on_fetch_misses
+            hashes = [k.chunk_hash for k in keys]
+            out = client.fetch_many("127.0.0.1", server.port, hashes, 64)
+            assert out[0] == b"aa"
+            assert out[1:] == [None, None, None]
+            assert client.stats["missing_blocks"] == 3
+            # The phantom suffix (blocks 1..3) was purged; block 0 kept.
+            view = index.export_view()
+            advertised = {h for _m, h, pods in view.entries if pods}
+            assert advertised == {keys[0].chunk_hash}
+            assert feedback.stats["purged_entries"] == 3
+            client.close()
+        finally:
+            server.close()

@@ -127,6 +127,15 @@ def test_collectors_exist():
     assert "transfer_block_errors" in collectors
     assert "transfer_hedges" in collectors
     assert "transfer_breaker_transitions" in collectors
+    # Index anti-entropy (antientropy/): divergence observations by
+    # bounded source, repair counters (purged/readmitted/audits), and the
+    # resolver negative-cache skips — pod identities stay data (the
+    # /readyz index_health section), never labels.
+    assert "index_divergence_observations" in collectors
+    assert "index_divergence_purged" in collectors
+    assert "index_divergence_readmitted" in collectors
+    assert "index_divergence_audits" in collectors
+    assert "index_divergence_negative_skips" in collectors
 
 
 def test_prefetch_drop_source_values_are_code_defined():
@@ -189,6 +198,27 @@ def test_transfer_breaker_state_label_values_are_code_defined():
             if state is not None:
                 assert state in BREAKER_STATES, (
                     f"unexpected breaker state {state!r}"
+                )
+
+
+def test_divergence_source_values_are_code_defined():
+    """The index_divergence_observations `source` label carries only the
+    fixed evidence vocabulary (antientropy.DIVERGENCE_SOURCES) — the
+    three ways divergence is detected, never traffic."""
+    from llm_d_kv_cache_manager_tpu.antientropy import DIVERGENCE_SOURCES
+
+    assert set(DIVERGENCE_SOURCES) == {
+        "fetch_miss", "orphan_removal", "audit_phantom",
+    }
+    metrics.register_metrics()
+    for metric in REGISTRY.collect():
+        if metric.name != "kvcache_index_divergence_observations":
+            continue
+        for sample in metric.samples:
+            source = sample.labels.get("source")
+            if source is not None:
+                assert source in DIVERGENCE_SOURCES, (
+                    f"unexpected divergence source {source!r}"
                 )
 
 
