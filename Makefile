@@ -5,8 +5,8 @@
 .PHONY: native native-asan kvtransfer test bench bench-micro bench-read \
 	bench-obs bench-batch bench-faults bench-chaos bench-divergence \
 	bench-replication bench-placement bench-anticipate bench-autoscale \
-	bench-geo bench-transfer clean proto lint precommit-install \
-	image-build image-push
+	bench-autopilot bench-geo bench-transfer clean proto lint \
+	precommit-install image-build image-push
 
 # Container image coordinates (override per environment/registry). The
 # release workflow (.github/workflows/ci-release.yaml) builds the same
@@ -162,6 +162,15 @@ bench-anticipate: kvtransfer
 # benchmarking/FLEET_BENCH_AUTOSCALE.json.
 bench-autoscale: kvtransfer
 	JAX_PLATFORMS=cpu python bench.py --autoscale
+
+# SLO-autopilot scenario (autopilot/): diurnal load over a fault mix (a
+# stalled transfer port covering the morning ramp, then silent-evictor
+# wipes through the peak) served by static-conservative, static-
+# aggressive, and closed-loop controller arms, plus the healthy-signals
+# bit-identity pair (autopilot attached vs absent). Headless; rewrites
+# benchmarking/FLEET_BENCH_AUTOPILOT.json.
+bench-autopilot: kvtransfer
+	JAX_PLATFORMS=cpu python bench.py --autopilot
 
 # Hierarchical-federation geo scenario (federation/): home-pinned sessions
 # with diurnal skew across regions, one region lost mid-replay; flat global

@@ -2944,6 +2944,635 @@ def main_divergence(args):
     }))
 
 
+# SLO autopilot scenario (--autopilot; autopilot/ subsystem): one
+# diurnal-load + fault-mix replay, served four ways. The fleet is the
+# winning-regime two-tier configuration (precise routing, placement
+# replication, residency audits, per-peer breakers + hedged fetches) and
+# the scenario stacks three stressors the static configs trade off
+# against each other:
+#   qps swing        low -> peak -> low (the diurnal shape; queueing at
+#                    the peak is where background replication charges
+#                    show up in p50 TTFT),
+#   stalling peer    AUTOPILOT_STALL_POD's transfer port hangs fetches
+#                    for a window inside the peak (breaker evidence),
+#   silent evictor   AUTOPILOT_WIPE_POD's cache is wiped on a cadence
+#                    inside the peak, stream seamless (hit-rate burn the
+#                    audit cadence exists to repair).
+# Arms:
+#   static_conservative  the baseline knob positions (K=1, small job
+#                        budget, slow audits): cheapest background work,
+#                        slowest divergence repair.
+#   static_aggressive    K=3, doubled job budget, 8x audit cadence:
+#                        fastest repair, but the replication charges ride
+#                        the read path at the peak — p50 pays all day for
+#                        resilience it needs for one window.
+#   autopilot            starts bit-identical to static_conservative and
+#                        lets the controller (autopilot/) move the SAME
+#                        knobs the aggressive arm pins, only while the
+#                        burn evidence says to, decaying back after.
+#   healthy pair         the same replay with NO faults, controller
+#                        attached vs absent — the bit-identity pin: on
+#                        healthy signals the autopilot arm's TTFT stream,
+#                        hit rate, and knob positions must be identical
+#                        to not having the subsystem at all.
+# SLO objectives are sim-backed (injected counts_fn closures over the
+# arm's own counters — the seam obs/slo.py exposes for exactly this):
+#   read_latency_p99  requests slower than AUTOPILOT_TTFT_SLO_S,
+#   hit_rate          requests whose cached-token fraction fell under
+#                     AUTOPILOT_HIT_FRAC_FLOOR.
+# Burn-minutes = sim-time spent with ANY objective breaching (both
+# windows over threshold), sampled on the same AUTOPILOT_EVAL_DT_S grid
+# in every arm. The headline verdict: the controller arm's burn-minutes
+# are <= every static arm's AND its p50 TTFT is within 1.05x the best
+# static arm's — adaptivity buys the aggressive arm's compliance at the
+# conservative arm's price.
+AUTOPILOT_QPS_LOW = 12.0
+AUTOPILOT_QPS_PEAK = 30.0
+AUTOPILOT_PEAK_FROM_S = 10.0
+AUTOPILOT_PEAK_UNTIL_S = 24.0
+AUTOPILOT_USERS_PER_GROUP = 6
+AUTOPILOT_TURNS_PER_USER = 8
+# The wipe window is LONG relative to the controller's reaction time
+# (~2-3s from first badness to knobs landed): a reactive repair covers
+# most of the window, a scheduled-audit repair covers none of it.
+AUTOPILOT_WIPE_PODS = ("pod-3", "pod-5")
+AUTOPILOT_WIPE_AT_S = 11.0
+AUTOPILOT_WIPE_EVERY_S = 1.0
+AUTOPILOT_WIPE_UNTIL_S = 22.0
+AUTOPILOT_STALL_POD = "pod-2"
+# The stall covers the morning ramp — it opens BEFORE the chains cross
+# the hotness threshold and closes before the wipes bite. An
+# always-aggressive replicator spends the whole ramp retrying
+# single-holder (unhedgeable) fetches against the hung port and carries
+# those timeout charges into the peak; a conservative replicator never
+# touches the stalled peer; the controller is still at its conservative
+# baseline (nothing is burning yet), so by the time burn evidence makes
+# it raise K the port is healthy again.
+AUTOPILOT_STALL_FROM_S = 2.0
+AUTOPILOT_STALL_UNTIL_S = 12.0
+# Sim-scaled SLO/controller clocks (the replay is ~33s of sim time; the
+# production defaults are 300s/3600s windows).
+AUTOPILOT_EVAL_DT_S = 0.25
+AUTOPILOT_SLO_FAST_S = 1.5
+AUTOPILOT_SLO_SLOW_S = 4.0
+AUTOPILOT_BURN_THRESHOLD = 2.0
+# TTFT SLO sits ABOVE the cost of the biggest honest recompute (~1.4s
+# for a late-turn wiped conversation) and BELOW one stalled-fetch
+# timeout ladder (3.0s): the read-latency objective counts requests the
+# transfer plane hung, not requests the hit-rate objective already
+# counts as recompute badness.
+AUTOPILOT_TTFT_SLO_S = 2.5
+AUTOPILOT_TTFT_BUDGET = 0.01
+# The healthy replay's worst per-request cached fraction is ~0.70 (a
+# turn-1 request re-reading a primed group prefix); a wiped conversation
+# that recovered only its group prefix re-serves at ~0.48. The floor
+# sits between them.
+AUTOPILOT_HIT_FRAC_FLOOR = 0.6
+AUTOPILOT_HIT_BUDGET = 0.06
+AUTOPILOT_CTRL_CFG = dict(
+    min_interval_s=0.2, warmup_s=6.0, cooldown_s=1.0, decay_after_s=3.0,
+)
+# Knob baselines (the conservative operator config) and the aggressive
+# arm's static pins. The autopilot arm's knob bounds derive from the
+# BASELINES via the owners' register_knobs(): K ceiling 3, jobs ceiling
+# 4, audit floor 1.0s — the aggressive positions are exactly reachable.
+AUTOPILOT_PLACEMENT_BASE = dict(
+    k_replicas=1, hotness_threshold=6.0, cooldown_s=2.0,
+    max_jobs_per_tick=2, max_prefix_blocks=64,
+)
+AUTOPILOT_PLACEMENT_AGGR = dict(
+    k_replicas=3, hotness_threshold=6.0, cooldown_s=2.0,
+    max_jobs_per_tick=4, max_prefix_blocks=64,
+)
+AUTOPILOT_AUDIT_BASE_S = 8.0
+AUTOPILOT_AUDIT_AGGR_S = 1.0
+AUTOPILOT_HEDGE_FLOOR_BASE_S = 0.2
+AUTOPILOT_HEDGE_FLOOR_AGGR_S = 0.05
+AUTOPILOT_AE_CFG = {
+    "audit_sample": 24,
+    "readmit_sample": 32,
+    "negative_ttl_s": 3.0,
+    "accuracy_alpha": 0.4,
+}
+AUTOPILOT_BREAKER_THRESHOLD = 3
+AUTOPILOT_BREAKER_COOLDOWN_S = 6.0
+AUTOPILOT_IO_TIMEOUT_MS = 3000
+AUTOPILOT_CONNECT_TIMEOUT_MS = 1500
+
+
+def build_autopilot_workload(seed: int = 42):
+    """(requests, conversations, rng): the synthetic chat shape with a
+    diurnal arrival rate — Poisson at AUTOPILOT_QPS_LOW outside the
+    [PEAK_FROM, PEAK_UNTIL) window, AUTOPILOT_QPS_PEAK inside it."""
+    rng = random.Random(seed)
+    conversations = shared_prefix_conversations(
+        rng, N_GROUPS, AUTOPILOT_USERS_PER_GROUP, SYSTEM_PROMPT_WORDS
+    )
+    turns = []
+    for conv_id in conversations:
+        for t in range(AUTOPILOT_TURNS_PER_USER):
+            turns.append((conv_id, t))
+    rng.shuffle(turns)
+    arrival = 0.0
+    requests = []
+    for conv_id, _t in turns:
+        qps = (
+            AUTOPILOT_QPS_PEAK
+            if AUTOPILOT_PEAK_FROM_S <= arrival < AUTOPILOT_PEAK_UNTIL_S
+            else AUTOPILOT_QPS_LOW
+        )
+        arrival += rng.expovariate(qps)
+        requests.append((arrival, conv_id))
+    return requests, conversations, rng
+
+
+def _autopilot_fault_plans(seed: int, healthy: bool):
+    """(wipe FaultPlan or None, transfer-stall peer dict): the fault mix,
+    or the empty pair for the healthy bit-identity arms."""
+    if healthy:
+        return None, {}
+    from llm_d_kv_cache_manager_tpu.fleethealth import FaultPlan, PodFaults
+    from llm_d_kv_cache_manager_tpu.kv_connectors import faults as tf
+
+    wipe_plan = FaultPlan(seed=seed, pods={
+        pod: PodFaults(
+            silent_wipe_at_s=AUTOPILOT_WIPE_AT_S,
+            silent_wipe_every_s=AUTOPILOT_WIPE_EVERY_S,
+            silent_wipe_until_s=AUTOPILOT_WIPE_UNTIL_S,
+        )
+        for pod in AUTOPILOT_WIPE_PODS
+    })
+    stall_faults = {
+        AUTOPILOT_STALL_POD: tf.PeerTransferFaults(
+            stall_from_s=AUTOPILOT_STALL_FROM_S,
+            stall_until_s=AUTOPILOT_STALL_UNTIL_S,
+        ),
+    }
+    return wipe_plan, stall_faults
+
+
+def run_autopilot_arm(mode: str, healthy: bool = False, seed: int = 42):
+    """One diurnal fault-mix replay. `mode`:
+      'off'        conservative baseline knobs, no controller,
+      'aggressive' the static aggressive knob pins, no controller,
+      'autopilot'  conservative baselines + the closed-loop controller.
+    Every arm runs the SAME subsystems (placement, anti-entropy,
+    breakers/hedges, SLO monitor on the same evaluation grid); only the
+    knob positions — static vs controlled — differ."""
+    from llm_d_kv_cache_manager_tpu.autopilot import (
+        AutopilotConfig,
+        AutopilotController,
+        KNOB_TRANSFER_HEDGE_FLOOR,
+        KnobRegistry,
+        KnobSpec,
+        SignalAssembler,
+    )
+    from llm_d_kv_cache_manager_tpu.obs.slo import (
+        OBJECTIVE_HIT_RATE,
+        OBJECTIVE_READ_LATENCY,
+        SLOConfig,
+        SLOMonitor,
+        SLOObjective,
+    )
+
+    aggressive = mode == "aggressive"
+    alpha_w, gamma_w, delta_w, _src = _winning_regime_constants()
+    requests, conversations, rng = build_autopilot_workload(seed)
+    wipe_plan, stall_faults = _autopilot_fault_plans(seed, healthy)
+    sim = FleetSim(
+        "precise",
+        # Oversized pods (2x the headline arm's 2048 pages, not the
+        # two-tier capacity squeeze): the healthy diurnal peak must be
+        # SLO-clean and free of device-eviction noise — burn in the
+        # fault arms has to come from the faults, and the aggressive
+        # arm's replication must not pay a hidden capacity tax.
+        pages_per_pod=2 * PAGES_PER_POD,
+        host_tier=True,
+        alpha=alpha_w, gamma=gamma_w, delta=delta_w,
+        fault_plan=wipe_plan,
+        placement=dict(
+            AUTOPILOT_PLACEMENT_AGGR if aggressive
+            else AUTOPILOT_PLACEMENT_BASE
+        ),
+        antientropy=dict(
+            AUTOPILOT_AE_CFG,
+            audit_interval_s=(
+                AUTOPILOT_AUDIT_AGGR_S if aggressive
+                else AUTOPILOT_AUDIT_BASE_S
+            ),
+            seed=seed,
+        ),
+        transfer_faults={
+            "pods": stall_faults,
+            "verify_integrity": True,
+            "breaker": {
+                "failure_threshold": AUTOPILOT_BREAKER_THRESHOLD,
+                "cooldown_s": AUTOPILOT_BREAKER_COOLDOWN_S,
+            },
+            "io_timeout_ms": AUTOPILOT_IO_TIMEOUT_MS,
+            "connect_timeout_ms": AUTOPILOT_CONNECT_TIMEOUT_MS,
+            "retries": 0,
+        },
+    )
+    # Deterministic peer choice (the chaos/divergence precedent) + the
+    # arm's hedge-floor position on every pod's client.
+    hedge_floor = (
+        AUTOPILOT_HEDGE_FLOOR_AGGR_S if aggressive
+        else AUTOPILOT_HEDGE_FLOOR_BASE_S
+    )
+    for pod in sim.pods:
+        pod.tier_store.peer_resolver.rendezvous_primary = True
+        pod.connector.client.config.hedge_delay_floor_s = hedge_floor
+
+    ttfts = []
+    records = []  # (arrival, ttft, hit_tokens, total_tokens)
+    slow_reqs = [0]
+    bad_hit_reqs = [0]
+    total_reqs = [0]
+    try:
+        # Sole-holder warm-up (identical in every arm; primer requests
+        # are not part of the measured population): group g's system
+        # prefix lands on pod (g mod N) and NOWHERE else — a wiped pod's
+        # groups have no free fallback. Second holders exist only where
+        # a replication policy (static pin or controller nudge) pays to
+        # create them.
+        groups = {}
+        for conv_id in conversations:
+            groups.setdefault(conv_id.split("-")[0], conversations[conv_id])
+        t = 0.0
+        for gi, group in enumerate(sorted(groups)):
+            sim.route_override = lambda p, pod=gi % sim.n_pods: pod
+            sim.serve(t, groups[group])
+            t += 0.02
+        sim.route_override = None
+
+        # Sim-backed SLO monitor (constructed after the warm-up so its
+        # baseline sample excludes priming spend); identical config and
+        # evaluation grid in every arm.
+        objectives = [
+            SLOObjective(
+                name=OBJECTIVE_READ_LATENCY,
+                description=(
+                    f"requests with TTFT > {AUTOPILOT_TTFT_SLO_S}s"
+                ),
+                budget=AUTOPILOT_TTFT_BUDGET,
+                counts_fn=lambda: (slow_reqs[0], total_reqs[0]),
+            ),
+            SLOObjective(
+                name=OBJECTIVE_HIT_RATE,
+                description=(
+                    "requests whose cached-token fraction fell under "
+                    f"{AUTOPILOT_HIT_FRAC_FLOOR}"
+                ),
+                budget=AUTOPILOT_HIT_BUDGET,
+                counts_fn=lambda: (bad_hit_reqs[0], total_reqs[0]),
+            ),
+        ]
+        monitor = SLOMonitor(
+            objectives,
+            SLOConfig(
+                fast_window_s=AUTOPILOT_SLO_FAST_S,
+                slow_window_s=AUTOPILOT_SLO_SLOW_S,
+                burn_threshold=AUTOPILOT_BURN_THRESHOLD,
+            ),
+            clock=lambda: sim.now,
+        )
+
+        controller = None
+        registry = None
+        if mode == "autopilot":
+            registry = KnobRegistry()
+            sim.replicator.register_knobs(registry)
+            sim.auditor.register_knobs(registry)
+            # Fleet-wide hedge-floor knob: the sim owns ALL pods' clients,
+            # so it publishes one knob whose setter fans out (the service
+            # wiring registers the single default client's instead).
+            cfg0 = sim.pods[0].connector.client.config
+
+            def _set_hedge_floor(v):
+                for p in sim.pods:
+                    p.connector.client.config.hedge_delay_floor_s = float(v)
+
+            registry.register(
+                KnobSpec(
+                    name=KNOB_TRANSFER_HEDGE_FLOOR,
+                    floor=min(0.001, cfg0.hedge_delay_floor_s),
+                    ceiling=cfg0.hedge_delay_cap_s,
+                    max_step=max(cfg0.hedge_delay_floor_s / 2.0, 0.001),
+                    description=(
+                        "minimum delay before a hedged fetch launches "
+                        "(fleet-wide)"
+                    ),
+                ),
+                get=lambda: cfg0.hedge_delay_floor_s,
+                set_=_set_hedge_floor,
+            )
+
+            def _agg_transfer_status():
+                peers: dict = {}
+                for p in sim.pods:
+                    for key, doc in (
+                    p.connector.client.status().get("peers", {}).items()
+                ):
+                        agg = peers.setdefault(
+                            key, {"state": "closed", "opens": 0}
+                        )
+                        if doc.get("state") == "open":
+                            agg["state"] = "open"
+                        agg["opens"] += int(doc.get("opens", 0))
+                return {"peers": peers}
+
+            class _FleetTransferStatus:
+                def status(self):
+                    return _agg_transfer_status()
+
+            assembler = SignalAssembler(
+                slo_monitor=monitor,
+                transfer_client=_FleetTransferStatus(),
+                antientropy=sim.antientropy,
+                prefetchers={"route": sim.route_prefetcher},
+                clock=lambda: sim.now,
+            )
+            controller = AutopilotController(
+                registry, assembler,
+                config=AutopilotConfig(**AUTOPILOT_CTRL_CFG),
+                clock=lambda: sim.now,
+            )
+
+        # The replay, shifted past the warm-up (fault windows are
+        # absolute sim time). One evaluation grid drives the monitor in
+        # every arm — and the controller in the autopilot arm.
+        shift = 1.0
+        burn_timeline = []  # (t, breaching objective names)
+        knob_timeline = []  # (t, {knob: position}) — autopilot arm only
+        next_eval = shift
+
+        def _evaluate(now):
+            if controller is not None:
+                controller.tick(now)
+                snap = controller.last_snapshot
+                breaching = list(snap.breaching) if snap else []
+            else:
+                breaching = list(monitor.evaluate(now)["breaching"])
+            burn_timeline.append((round(now, 3), breaching))
+            if registry is not None and not registry.at_baseline():
+                knob_timeline.append((
+                    round(now, 3),
+                    {
+                        name: doc["position"]
+                        for name, doc in registry.positions().items()
+                    },
+                ))
+
+        for arrival, conv_id in requests:
+            arrival += shift
+            while next_eval <= arrival:
+                _evaluate(next_eval)
+                next_eval += AUTOPILOT_EVAL_DT_S
+            question = _text(rng, QUESTION_WORDS)
+            prompt = conversations[conv_id] + " [user] " + question
+            h0, t0 = sim.hit_tokens, sim.total_tokens
+            ttft = sim.serve(arrival, prompt)
+            ttfts.append(ttft)
+            d_hit = sim.hit_tokens - h0
+            d_total = sim.total_tokens - t0
+            records.append((arrival, ttft, d_hit, d_total))
+            total_reqs[0] += 1
+            if ttft > AUTOPILOT_TTFT_SLO_S:
+                slow_reqs[0] += 1
+            if d_total > 0 and d_hit / d_total < AUTOPILOT_HIT_FRAC_FLOOR:
+                bad_hit_reqs[0] += 1
+            conversations[conv_id] = (
+                prompt + " [assistant] " + _text(rng, RESPONSE_WORDS)
+            )
+        # Cool-down tail: keep evaluating past the last arrival so the
+        # decay path (knobs walking home) is part of the record.
+        tail_until = requests[-1][0] + shift + 4.0
+        while next_eval <= tail_until:
+            _evaluate(next_eval)
+            next_eval += AUTOPILOT_EVAL_DT_S
+        sim.event_pool.drain()
+
+        breaker_opens = sum(
+            1 for _t, _obs, _peer, _old, new in sim.breaker_transitions
+            if new == "open"
+        )
+        return {
+            "ttfts": ttfts,
+            "records": records,
+            "hit_rate": sim.hit_tokens / max(sim.total_tokens, 1),
+            "burn_timeline": burn_timeline,
+            "knob_timeline": knob_timeline,
+            "slow_requests": slow_reqs[0],
+            "bad_hit_requests": bad_hit_reqs[0],
+            "silent_wipes": [(round(t, 3), i) for t, i in sim.silent_wipes],
+            "breaker_opens": breaker_opens,
+            "preemptions": sim.preemptions,
+            "replication": sim.placement_stats(),
+            "auditor": sim.auditor.status() if sim.auditor else None,
+            "knob_positions": (
+                {
+                    name: doc["position"]
+                    for name, doc in registry.positions().items()
+                }
+                if registry is not None else None
+            ),
+            "controller": (
+                controller.status() if controller is not None else None
+            ),
+        }
+    finally:
+        sim.shutdown()
+
+
+def _burn_minutes(timeline) -> float:
+    """Sim-minutes with ANY objective breaching, on the shared grid."""
+    return round(
+        sum(AUTOPILOT_EVAL_DT_S for _t, breaching in timeline if breaching)
+        / 60.0,
+        4,
+    )
+
+
+def main_autopilot(args):
+    """--autopilot: the closed-loop controller comparison. Writes
+    benchmarking/FLEET_BENCH_AUTOPILOT.json."""
+    from llm_d_kv_cache_manager_tpu.kv_connectors.connector import (
+        native_available,
+    )
+
+    if not native_available():
+        print(json.dumps({
+            "metric": "autopilot_burn_minutes",
+            "value": None,
+            "skipped": "libkvtransfer.so not built (make kvtransfer)",
+        }))
+        return
+
+    t_start = time.time()
+    arms_raw = {
+        "static_conservative": run_autopilot_arm("off", seed=args.seed),
+        "static_aggressive": run_autopilot_arm(
+            "aggressive", seed=args.seed
+        ),
+        "autopilot": run_autopilot_arm("autopilot", seed=args.seed),
+        "healthy_off": run_autopilot_arm("off", healthy=True, seed=args.seed),
+        "healthy_autopilot": run_autopilot_arm(
+            "autopilot", healthy=True, seed=args.seed
+        ),
+    }
+
+    def arm_stats(arm, with_knobs=False):
+        out = {
+            "ttft_p50_s": round(p50(arm["ttfts"]), 4),
+            "ttft_p90_s": round(p90(arm["ttfts"]), 4),
+            "prefix_hit_rate": round(arm["hit_rate"], 4),
+            "burn_minutes": _burn_minutes(arm["burn_timeline"]),
+            "slow_requests": arm["slow_requests"],
+            "bad_hit_requests": arm["bad_hit_requests"],
+            "breaker_opens": arm["breaker_opens"],
+            "preemptions": arm["preemptions"],
+            "replicated_blocks": arm["replication"].get(
+                "replicated_blocks", 0
+            ),
+            "replication_charged_s": arm["replication"].get(
+                "replication_charged_s", 0.0
+            ),
+            "audit_rounds": (
+                arm["auditor"]["rounds"] if arm["auditor"] else 0
+            ),
+        }
+        if arm["silent_wipes"]:
+            out["silent_wipes"] = arm["silent_wipes"]
+        if with_knobs and arm["controller"] is not None:
+            ctrl = arm["controller"]
+            out["actuations"] = ctrl["stats"]["actuations"]
+            out["reverts"] = ctrl["stats"]["reverts"]
+            out["rules_fired"] = {
+                name: doc["fired"]
+                for name, doc in ctrl["rules"].items() if doc["fired"]
+            }
+            out["final_at_baseline"] = ctrl["at_baseline"]
+            out["recent_actuations"] = ctrl["recent_actuations"]
+            out["knob_timeline"] = arm["knob_timeline"]
+        return out
+
+    arms = {
+        "static_conservative": arm_stats(arms_raw["static_conservative"]),
+        "static_aggressive": arm_stats(arms_raw["static_aggressive"]),
+        "autopilot": arm_stats(arms_raw["autopilot"], with_knobs=True),
+        "healthy_off": arm_stats(arms_raw["healthy_off"]),
+        "healthy_autopilot": arm_stats(
+            arms_raw["healthy_autopilot"], with_knobs=True
+        ),
+    }
+
+    ap_burn = arms["autopilot"]["burn_minutes"]
+    static_burns = {
+        name: arms[name]["burn_minutes"]
+        for name in ("static_conservative", "static_aggressive")
+    }
+    best_static_p50 = min(
+        arms[name]["ttft_p50_s"]
+        for name in ("static_conservative", "static_aggressive")
+    )
+    p50_ratio = round(
+        arms["autopilot"]["ttft_p50_s"] / max(best_static_p50, 1e-9), 4
+    )
+
+    h_off = arms_raw["healthy_off"]
+    h_on = arms_raw["healthy_autopilot"]
+    healthy_bit_identity = {
+        "ttft_stream_identical": h_on["ttfts"] == h_off["ttfts"],
+        "hit_identical": h_on["hit_rate"] == h_off["hit_rate"],
+        "knobs_at_baseline": bool(
+            h_on["controller"] and h_on["controller"]["at_baseline"]
+        ),
+        "actuations": (
+            h_on["controller"]["stats"]["actuations"]
+            if h_on["controller"] else None
+        ),
+        "burn_timeline_identical": (
+            h_on["burn_timeline"] == h_off["burn_timeline"]
+        ),
+    }
+
+    stats = {
+        "config": {
+            "workload": (
+                "synthetic chat with a diurnal arrival rate "
+                f"({AUTOPILOT_QPS_LOW} qps -> {AUTOPILOT_QPS_PEAK} qps in "
+                f"[{AUTOPILOT_PEAK_FROM_S}, {AUTOPILOT_PEAK_UNTIL_S})s -> "
+                f"{AUTOPILOT_QPS_LOW} qps), sole-holder warm-up, precise "
+                "routing, two-tier winning-regime data plane"
+            ),
+            "requests": len(arms_raw["autopilot"]["ttfts"]),
+            "n_pods": N_PODS,
+            "seed": args.seed,
+            "faults": {
+                "wipe_pods": list(AUTOPILOT_WIPE_PODS),
+                "wipe_window_s": [
+                    AUTOPILOT_WIPE_AT_S, AUTOPILOT_WIPE_UNTIL_S,
+                ],
+                "wipe_every_s": AUTOPILOT_WIPE_EVERY_S,
+                "stall_pod": AUTOPILOT_STALL_POD,
+                "stall_window_s": [
+                    AUTOPILOT_STALL_FROM_S, AUTOPILOT_STALL_UNTIL_S,
+                ],
+            },
+            "slo": {
+                "eval_dt_s": AUTOPILOT_EVAL_DT_S,
+                "fast_window_s": AUTOPILOT_SLO_FAST_S,
+                "slow_window_s": AUTOPILOT_SLO_SLOW_S,
+                "burn_threshold": AUTOPILOT_BURN_THRESHOLD,
+                "ttft_slo_s": AUTOPILOT_TTFT_SLO_S,
+                "ttft_budget": AUTOPILOT_TTFT_BUDGET,
+                "hit_frac_floor": AUTOPILOT_HIT_FRAC_FLOOR,
+                "hit_budget": AUTOPILOT_HIT_BUDGET,
+            },
+            "controller": dict(AUTOPILOT_CTRL_CFG),
+            "knobs": {
+                "placement_base": dict(AUTOPILOT_PLACEMENT_BASE),
+                "placement_aggressive": dict(AUTOPILOT_PLACEMENT_AGGR),
+                "audit_interval_base_s": AUTOPILOT_AUDIT_BASE_S,
+                "audit_interval_aggressive_s": AUTOPILOT_AUDIT_AGGR_S,
+                "hedge_floor_base_s": AUTOPILOT_HEDGE_FLOOR_BASE_S,
+                "hedge_floor_aggressive_s": AUTOPILOT_HEDGE_FLOOR_AGGR_S,
+            },
+        },
+        "arms": arms,
+        # Headline verdicts.
+        "autopilot_burn_minutes": ap_burn,
+        "static_burn_minutes": static_burns,
+        "autopilot_beats_every_static_on_burn": all(
+            ap_burn <= b for b in static_burns.values()
+        ),
+        "autopilot_p50_vs_best_static": p50_ratio,
+        "autopilot_p50_within_1p05x": p50_ratio <= 1.05,
+        "healthy_bit_identity": healthy_bit_identity,
+        "wall_s": round(time.time() - t_start, 1),
+    }
+    print(json.dumps(stats), file=sys.stderr)
+    artifact = {k: v for k, v in stats.items() if k != "wall_s"}
+    out = os.path.join(REPO, "benchmarking", "FLEET_BENCH_AUTOPILOT.json")
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({
+        "metric": "autopilot_burn_minutes",
+        "value": ap_burn,
+        "unit": "sim-minutes breaching",
+        "static_burn_minutes": static_burns,
+        "beats_every_static": stats["autopilot_beats_every_static_on_burn"],
+        "p50_vs_best_static": p50_ratio,
+        "healthy_bit_identical": (
+            healthy_bit_identity["ttft_stream_identical"]
+            and healthy_bit_identity["knobs_at_baseline"]
+        ),
+        "source": "benchmarking/FLEET_BENCH_AUTOPILOT.json",
+    }))
+
+
 # Indexer kill-and-restart scenario (--replication; cluster/ subsystem):
 # replay the ShareGPT trace while the INDEX SERVICE itself crashes mid-run,
 # and compare what the restarted instance starts from:
@@ -5249,6 +5878,15 @@ def parse_args(argv=None):
              "benchmarking/FLEET_BENCH_DIVERGENCE.json",
     )
     ap.add_argument(
+        "--autopilot", action="store_true",
+        help="run the SLO-autopilot scenario (autopilot/ subsystem): one "
+             "diurnal-load + fault-mix replay (qps swing, stalling "
+             "transfer peer, silent evictor) served by static "
+             "conservative/aggressive knob configs vs the closed-loop "
+             "controller, plus a healthy bit-identity pair, writing "
+             "benchmarking/FLEET_BENCH_AUTOPILOT.json",
+    )
+    ap.add_argument(
         "--replication", action="store_true",
         help="run the indexer kill-and-restart scenario (FaultPlan "
              "indexer_crash) over the ShareGPT replay: cold restart vs "
@@ -5272,6 +5910,8 @@ if __name__ == "__main__":
         main_batch_window(_args)
     elif _args.cluster_replicas > 1:
         main_cluster_check(_args)
+    elif _args.autopilot:
+        main_autopilot(_args)
     elif _args.replication:
         main_replication(_args)
     elif _args.divergence:

@@ -286,6 +286,33 @@ class ResidencyAuditor:
             return 0
         return len(unknown)
 
+    def register_knobs(self, registry) -> None:
+        """Publish the audit cadence to the autopilot
+        (autopilot/knobs.py). tick() compares against the config on
+        every call, so tightening the interval takes effect on the next
+        tick. Bounds are relative to the configured baseline: the
+        controller can audit up to 8x faster under a hit-rate burn
+        (divergence repair is the lever) and never slower than 4x the
+        operator's cadence."""
+        from llm_d_kv_cache_manager_tpu.autopilot.knobs import (
+            KNOB_AUDIT_INTERVAL,
+            KnobSpec,
+        )
+
+        cfg = self.config
+        base = cfg.interval_s
+        registry.register(
+            KnobSpec(
+                name=KNOB_AUDIT_INTERVAL,
+                floor=base / 8.0,
+                ceiling=base * 4.0,
+                max_step=base / 2.0,
+                description="residency-audit cadence in seconds",
+            ),
+            get=lambda: cfg.interval_s,
+            set_=lambda v: setattr(cfg, "interval_s", float(v)),
+        )
+
     # -- introspection -----------------------------------------------------
 
     def status(self) -> dict:

@@ -11,7 +11,11 @@ overload an *explicit, bounded, observable* outcome instead:
 - at most ``max_queue_depth`` more wait, for at most ``max_wait_s``;
 - everything past those bounds is SHED — HTTP 429 with a ``Retry-After``
   hint, gRPC ``RESOURCE_EXHAUSTED`` with a ``retry-after-ms`` trailer —
-  never an unbounded queue, never a silent stall.
+  never an unbounded queue, never a silent stall. The hint is honest
+  backpressure: the baseline ``retry_after_s`` scaled by the shed
+  pressure of the last few seconds and clamped at ``retry_after_max_s``,
+  so an isolated shed invites a quick retry while a sustained burn
+  pushes clients progressively further away.
 
 Deadline propagation rides the same gate: a caller-supplied remaining
 budget (the gRPC context deadline, or the HTTP ``X-Request-Deadline-Ms``
@@ -38,6 +42,7 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterator, Optional
 
@@ -70,10 +75,19 @@ class AdmissionConfig:
     max_queue_depth: int = 64
     # Hard cap on time spent in the waiting line (sheds as "timeout").
     max_wait_s: float = 1.0
-    # Retry-After hint attached to every shed response. Deliberately a
-    # fixed config value, not a queue-derived estimate: under overload an
-    # estimate computed from the thing that is overloaded is noise.
+    # BASELINE Retry-After hint. The hint a shed response actually
+    # carries scales this by live shed pressure (sheds observed in the
+    # last `shed_pressure_window_s`, per concurrency slot) and clamps at
+    # `retry_after_max_s`: an isolated shed says "retry in a beat", a
+    # sustained burn says "back off, honestly". The scale input is the
+    # controller's own shed COUNT — not a queue-wait estimate computed
+    # from the thing that is overloaded, which is noise.
     retry_after_s: float = 1.0
+    # Ceiling on the scaled hint (and the value a client sees when the
+    # surface is being hammered).
+    retry_after_max_s: float = 8.0
+    # Window over which recent sheds count as live pressure.
+    shed_pressure_window_s: float = 5.0
 
     def __post_init__(self):
         if self.max_concurrency <= 0:
@@ -84,6 +98,10 @@ class AdmissionConfig:
             raise ValueError("max_wait_s must be positive")
         if self.retry_after_s < 0:
             raise ValueError("retry_after_s must be >= 0")
+        if self.retry_after_max_s < self.retry_after_s:
+            raise ValueError("retry_after_max_s must be >= retry_after_s")
+        if self.shed_pressure_window_s <= 0:
+            raise ValueError("shed_pressure_window_s must be positive")
 
 
 class AdmissionRejected(Exception):
@@ -111,6 +129,10 @@ class AdmissionController:
         self._cond = threading.Condition()
         self._active = 0
         self._waiting = 0
+        # Shed timestamps inside (roughly) the pressure window — the
+        # Retry-After scale input. Bounded: under a flood the window is
+        # saturated long before the ring is.
+        self._shed_times: deque = deque(maxlen=512)
         self.stats: Dict[str, int] = {
             "admitted": 0,
             "queued": 0,
@@ -121,10 +143,30 @@ class AdmissionController:
 
     # -- gate --------------------------------------------------------------
 
+    def retry_after_hint(self, now: Optional[float] = None) -> float:
+        """The live Retry-After hint: baseline scaled by recent shed
+        pressure (sheds in the last `shed_pressure_window_s`, per
+        concurrency slot), clamped to `retry_after_max_s`. With no
+        recent sheds this is exactly `retry_after_s` — the first shed
+        of a burst carries the baseline hint, each subsequent one backs
+        clients off harder."""
+        cfg = self.config
+        if now is None:
+            now = self.clock()
+        horizon = now - cfg.shed_pressure_window_s
+        recent = sum(1 for t in self._shed_times if t > horizon)
+        scale = 1.0 + recent / cfg.max_concurrency
+        return min(cfg.retry_after_max_s, cfg.retry_after_s * scale)
+
     def _shed(self, kind: str) -> AdmissionRejected:
+        # Hint BEFORE recording this shed: pressure is what the caller
+        # arrived into, not what it contributed.
+        now = self.clock()
+        hint = self.retry_after_hint(now)
+        self._shed_times.append(now)
         self.stats[f"shed_{kind}"] += 1
         metrics.count_admission_shed(kind)
-        return AdmissionRejected(kind, self.config.retry_after_s)
+        return AdmissionRejected(kind, hint)
 
     def try_acquire(self, budget_s: Optional[float] = None) -> None:
         """Take a slot or raise `AdmissionRejected`. `budget_s` is the
@@ -180,6 +222,33 @@ class AdmissionController:
         finally:
             self.release()
 
+    def register_knobs(self, registry) -> None:
+        """Publish the waiting-line depth to the autopilot
+        (autopilot/knobs.py). The gate reads the config under its lock
+        on every acquire, so a nudge widens the line for the very next
+        arrival. Floor = the operator's configured depth (the autopilot
+        widens under a shed burn and reverts; it never narrows below
+        the baseline), ceiling = 4x it."""
+        from llm_d_kv_cache_manager_tpu.autopilot.knobs import (
+            KNOB_ADMISSION_QUEUE,
+            KnobSpec,
+        )
+
+        cfg = self.config
+        base = cfg.max_queue_depth
+        registry.register(
+            KnobSpec(
+                name=KNOB_ADMISSION_QUEUE,
+                floor=float(base),
+                ceiling=float(max(base * 4, base + 8)),
+                max_step=float(max(base // 2, 8)),
+                integer=True,
+                description="bounded admission waiting-line depth",
+            ),
+            get=lambda: cfg.max_queue_depth,
+            set_=lambda v: setattr(cfg, "max_queue_depth", int(v)),
+        )
+
     # -- introspection -----------------------------------------------------
 
     def depth(self) -> Dict[str, int]:
@@ -203,6 +272,8 @@ class AdmissionController:
             "max_queue_depth": cfg.max_queue_depth,
             "max_wait_s": cfg.max_wait_s,
             "retry_after_s": cfg.retry_after_s,
+            "retry_after_max_s": cfg.retry_after_max_s,
+            "retry_after_hint_s": round(self.retry_after_hint(), 3),
             "depth": depth,
             "stats": stats,
         }

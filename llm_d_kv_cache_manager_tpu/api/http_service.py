@@ -67,6 +67,12 @@ the ZMQ KVEvents write plane, and Prometheus metrics behind HTTP:
                                 per-objective fast/slow-window burn
                                 rates off the live registry, breach
                                 status (also a /readyz `slo` section)
+  GET  /autopilot/status        SLO-autopilot introspection (one
+                                rate-limited controller tick, then the
+                                document): knob positions vs baseline,
+                                per-rule firing/breach evidence, the
+                                recent actuation journal tail (also a
+                                /readyz `autopilot` section)
   GET  /debug/traces            flight recorder dump: recent complete
                                 traces + the slow-outlier reservoir.
                                 Filters: ?limit= (alias n=), ?plane=,
@@ -110,7 +116,12 @@ ANTIENTROPY_ACCURACY_ALPHA / ANTIENTROPY_DISTRUST_THRESHOLD /
 ANTIENTROPY_MIN_FACTOR / ANTIENTROPY_AUDIT_INTERVAL_S /
 ANTIENTROPY_AUDIT_SAMPLE (ANTIENTROPY=0 default; on, scores stay
 bit-identical while the fleet stays truthful — the tracker only demotes
-on verified divergence).
+on verified divergence), and the SLO autopilot AUTOPILOT /
+AUTOPILOT_MIN_INTERVAL_S / AUTOPILOT_WARMUP_S / AUTOPILOT_COOLDOWN_S /
+AUTOPILOT_DECAY_AFTER_S (AUTOPILOT=0 default; on with healthy signals,
+every knob stays bit-identical to the operator's configuration — the
+controller only actuates while an SLO burns, and walks every knob back
+to baseline once it stops).
 
 Run: python -m llm_d_kv_cache_manager_tpu.api.http_service
 """
@@ -337,7 +348,49 @@ def config_from_env() -> dict:
         "antientropy_audit_sample": int(
             os.environ.get("ANTIENTROPY_AUDIT_SAMPLE", "16")
         ),
+        # SLO autopilot (autopilot/): AUTOPILOT=1 attaches the closed-loop
+        # controller over whatever knobs this process's subsystems
+        # publish (the admission gate at minimum; embedder-wired
+        # subsystems register theirs against `service.autopilot_registry`).
+        # Ticks ride the /autopilot/status and /readyz poll cadence — no
+        # background thread. AUTOPILOT=0 (default) leaves the plane None;
+        # on with healthy signals, every knob stays bit-identical to the
+        # operator's configuration.
+        "autopilot": os.environ.get("AUTOPILOT", "0") == "1",
+        "autopilot_min_interval_s": float(
+            os.environ.get("AUTOPILOT_MIN_INTERVAL_S", "1")
+        ),
+        "autopilot_warmup_s": float(
+            os.environ.get("AUTOPILOT_WARMUP_S", "10")
+        ),
+        "autopilot_cooldown_s": float(
+            os.environ.get("AUTOPILOT_COOLDOWN_S", "5")
+        ),
+        "autopilot_decay_after_s": float(
+            os.environ.get("AUTOPILOT_DECAY_AFTER_S", "15")
+        ),
     }
+
+
+class _LazyStatusSource:
+    """SignalAssembler source resolving its target per snapshot — the
+    autopilot can see subsystems an embedder wires AFTER the service is
+    constructed (route prefetcher, transfer client) without re-wiring."""
+
+    def __init__(self, resolve):
+        self._resolve = resolve
+
+    def status(self) -> dict:
+        target = self._resolve()
+        return target.status() if target is not None else {}
+
+
+def _peek_transfer_client():
+    from llm_d_kv_cache_manager_tpu.kv_connectors import (
+        connector as conn_mod,
+    )
+
+    return conn_mod.peek_default_client()
 
 
 class ScoringService:
@@ -704,6 +757,57 @@ class ScoringService:
                 fed_config,
                 regions,
                 derive_fn=derive_fn_from_indexer(self.indexer),
+            )
+
+        # SLO autopilot (autopilot/): AUTOPILOT=1 wires the closed-loop
+        # controller LAST, over whatever this construction attached. The
+        # admission gate publishes its knob here; embedder-wired
+        # subsystems (replicator, prefetch scheduler, auditor, transfer
+        # client) publish theirs by calling
+        # `x.register_knobs(service.autopilot_registry)` after assigning
+        # them — knobs registered later are immediately reachable by the
+        # rules. Signal sources that arrive late (route prefetcher,
+        # transfer client) are resolved lazily per snapshot.
+        self.autopilot = None
+        self.autopilot_registry = None
+        if env.get("autopilot"):
+            from llm_d_kv_cache_manager_tpu.autopilot import (
+                AutopilotConfig,
+                AutopilotController,
+                KnobRegistry,
+                SignalAssembler,
+            )
+
+            self.autopilot_registry = KnobRegistry()
+            if self.admission is not None:
+                self.admission.register_knobs(self.autopilot_registry)
+            assembler = SignalAssembler(
+                slo_monitor=self.slo,
+                load_tracker=self.load_tracker,
+                transfer_client=_LazyStatusSource(
+                    lambda: self.transfer_client
+                    or _peek_transfer_client()
+                ),
+                antientropy=_LazyStatusSource(lambda: self.antientropy),
+                prefetchers={
+                    "route": _LazyStatusSource(
+                        lambda: self.route_prefetcher
+                    ),
+                },
+            )
+            self.autopilot = AutopilotController(
+                self.autopilot_registry,
+                assembler,
+                config=AutopilotConfig(
+                    min_interval_s=float(
+                        env.get("autopilot_min_interval_s", 1.0)
+                    ),
+                    warmup_s=float(env.get("autopilot_warmup_s", 10.0)),
+                    cooldown_s=float(env.get("autopilot_cooldown_s", 5.0)),
+                    decay_after_s=float(
+                        env.get("autopilot_decay_after_s", 15.0)
+                    ),
+                ),
             )
 
     def start(self, with_subscriber: bool = True) -> None:
@@ -1091,7 +1195,19 @@ class ScoringService:
             # readmit counters. Never gates readiness — a divergent POD
             # is being demoted and repaired; this process is fine.
             "index_health": self._index_health_section(),
+            # SLO autopilot: knob positions vs baseline, rule states, and
+            # the recent actuation tail. The /readyz poll is also one of
+            # the controller's tick cadences (rate-limited internally).
+            # NEVER gates readiness — an actuating autopilot is relieving
+            # a burn, not failing.
+            "autopilot": self._autopilot_section(),
         }
+
+    def _autopilot_section(self) -> Optional[dict]:
+        if self.autopilot is None:
+            return None
+        self.autopilot.tick()
+        return self.autopilot.status()
 
     def _index_health_section(self) -> Optional[dict]:
         if self.antientropy is None:
@@ -1185,6 +1301,22 @@ class ScoringService:
             )
         return web.json_response(
             await asyncio.to_thread(self._index_health_section)
+        )
+
+    async def handle_autopilot_status(
+        self, request: web.Request
+    ) -> web.Response:
+        """Autopilot introspection: one controller tick (rate-limited
+        internally — fast polls are pure reads), then the status
+        document the /readyz `autopilot` section embeds (knob positions
+        vs baseline, rule firing evidence, recent actuation tail)."""
+        if self.autopilot is None:
+            return web.json_response(
+                {"error": "autopilot disabled (set AUTOPILOT=1)"},
+                status=400,
+            )
+        return web.json_response(
+            await asyncio.to_thread(self._autopilot_section)
         )
 
     async def handle_placement_status(self, request: web.Request) -> web.Response:
@@ -1440,6 +1572,7 @@ class ScoringService:
         )
         app.router.add_post("/cluster/snapshot", self.handle_cluster_snapshot)
         app.router.add_get("/slo/status", self.handle_slo_status)
+        app.router.add_get("/autopilot/status", self.handle_autopilot_status)
         app.router.add_get(
             "/debug/critical_path", self.handle_debug_critical_path
         )

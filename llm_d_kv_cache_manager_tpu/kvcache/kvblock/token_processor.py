@@ -95,7 +95,10 @@ class ChunkedTokenDatabase:
         "extra keys" semantics), so the same tokens served through different
         LoRA adapters occupy distinct index entries. The reference parses the
         event's LoraID but drops it (pool.go BlockStored handling; its LoRA
-        parity test is a skipped TODO) — here it is first-class.
+        parity test is a skipped TODO) — here it is first-class, and the
+        chunk-boundary × LoRA semantics are pinned against the vendored
+        vLLM oracle (tests/test_hash_parity.py
+        ::TestChunkBoundaryOracleParity).
 
         `prefix_state` is the tokenization pool's prefix-store boundary
         fingerprint chain for THIS token list (pool.tokenize_ex). With the

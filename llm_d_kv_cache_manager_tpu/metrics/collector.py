@@ -158,6 +158,14 @@ index_divergence_readmitted: Optional[Counter] = None
 index_divergence_audits: Optional[Counter] = None
 index_divergence_negative_skips: Optional[Counter] = None
 
+# SLO autopilot (autopilot/): bounded knob nudges applied by the
+# controller and the live position of every registered knob. All three
+# labels take values from FIXED code vocabularies (AUTOPILOT_RULES /
+# AUTOPILOT_DIRECTIONS in autopilot/controller.py, AUTOPILOT_KNOBS in
+# autopilot/knobs.py) — rule/actuator topology, never traffic.
+autopilot_actuations: Optional[Counter] = None
+autopilot_knob_position: Optional[Gauge] = None
+
 _APPLY_DELAY_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
     5.0, 10.0, 30.0, 60.0,
@@ -198,6 +206,7 @@ def register_metrics(registry=None) -> None:
     global index_divergence_observations, index_divergence_purged
     global index_divergence_readmitted, index_divergence_audits
     global index_divergence_negative_skips
+    global autopilot_actuations, autopilot_knob_position
 
     with _register_lock:
         if _registered:
@@ -564,6 +573,20 @@ def register_metrics(registry=None) -> None:
             "cache (the peer just disclaimed that block)",
             registry=reg,
         )
+        autopilot_actuations = Counter(
+            "kvcache_autopilot_actuations_total",
+            "Bounded knob nudges applied by the SLO autopilot, by rule "
+            "and direction",
+            labelnames=("rule", "direction"),
+            registry=reg,
+        )
+        autopilot_knob_position = Gauge(
+            "kvcache_autopilot_knob_position",
+            "Live position of each autopilot-registered policy knob "
+            "(equals its baseline whenever signals are healthy)",
+            labelnames=("knob",),
+            registry=reg,
+        )
         _registered = True
 
 
@@ -821,6 +844,16 @@ def count_trace_carrier_error() -> None:
 def set_slo_burn_rate(objective: str, window: str, burn: float) -> None:
     if slo_burn_rate is not None:
         slo_burn_rate.labels(objective=objective, window=window).set(burn)
+
+
+def count_autopilot_actuation(rule: str, direction: str) -> None:
+    if autopilot_actuations is not None:
+        autopilot_actuations.labels(rule=rule, direction=direction).inc()
+
+
+def set_autopilot_knob_position(knob: str, value: float) -> None:
+    if autopilot_knob_position is not None:
+        autopilot_knob_position.labels(knob=knob).set(value)
 
 
 def counter_value(c: Optional[Counter]) -> float:

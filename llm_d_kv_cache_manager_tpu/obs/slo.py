@@ -363,3 +363,38 @@ class SLOMonitor:
             "samples_retained": len(samples),
             "evaluations": self.evaluations,
         }
+
+    def burn_history(
+        self, objective: str, window: str
+    ) -> List[Tuple[float, float]]:
+        """Windowed burn-rate series over the retained sample ring,
+        through a public seam: [(sample_time, burn_rate)], oldest first,
+        one point per retained sample, each computed over the named
+        window ending AT that sample (clipped to the monitor's lifetime
+        exactly as evaluate() clips). Bounded by the ring
+        (``max_samples``); callers — the autopilot's journal, tests —
+        never touch the private ring or re-derive the burn math."""
+        if window not in SLO_WINDOWS:
+            raise ValueError(f"unknown window {window!r} (not in SLO_WINDOWS)")
+        obj = next((o for o in self.objectives if o.name == objective), None)
+        if obj is None:
+            raise ValueError(
+                f"unknown objective {objective!r} (have "
+                f"{[o.name for o in self.objectives]})"
+            )
+        wlen = (
+            self.config.fast_window_s if window == WINDOW_FAST
+            else self.config.slow_window_s
+        )
+        with self._mu:
+            samples = list(self._samples)
+        out: List[Tuple[float, float]] = []
+        for i, (t, counts) in enumerate(samples):
+            base_t, base = self._baseline(samples[: i + 1], t - wlen)
+            bad_now, total_now = counts.get(obj.name, (0.0, 0.0))
+            bad_0, total_0 = base.get(obj.name, (0.0, 0.0))
+            d_bad = max(0.0, bad_now - bad_0)
+            d_total = max(0.0, total_now - total_0)
+            bad_frac = min(1.0, d_bad / d_total) if d_total > 0 else 0.0
+            out.append((t, bad_frac / obj.budget))
+        return out

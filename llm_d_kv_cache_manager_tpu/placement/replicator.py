@@ -217,6 +217,44 @@ class HotPrefixReplicator:
         ranked.sort()
         return [pod for _w, pod in ranked[:want]]
 
+    def register_knobs(self, registry) -> None:
+        """Publish this replicator's adaptive surfaces to the autopilot
+        (autopilot/knobs.py). tick() re-reads the config each pass, so a
+        nudge takes effect on the next tick. Bounds are relative to the
+        configured baseline: the controller can roughly double the
+        replica spread or halve the per-tick budget, never more."""
+        from llm_d_kv_cache_manager_tpu.autopilot.knobs import (
+            KNOB_PLACEMENT_JOBS,
+            KNOB_PLACEMENT_K,
+            KnobSpec,
+        )
+
+        cfg = self.config
+        registry.register(
+            KnobSpec(
+                name=KNOB_PLACEMENT_K,
+                floor=1.0,
+                ceiling=float(max(cfg.k_replicas * 2, cfg.k_replicas + 2)),
+                max_step=1.0,
+                integer=True,
+                description="target replica count per hot chain",
+            ),
+            get=lambda: cfg.k_replicas,
+            set_=lambda v: setattr(cfg, "k_replicas", int(v)),
+        )
+        registry.register(
+            KnobSpec(
+                name=KNOB_PLACEMENT_JOBS,
+                floor=1.0,
+                ceiling=float(max(cfg.max_jobs_per_tick * 2, 2)),
+                max_step=1.0,
+                integer=True,
+                description="replication jobs submitted per tick",
+            ),
+            get=lambda: cfg.max_jobs_per_tick,
+            set_=lambda v: setattr(cfg, "max_jobs_per_tick", int(v)),
+        )
+
     def status(self) -> dict:
         return {
             "config": {

@@ -174,6 +174,30 @@ class PrefetchScheduler:
         self.stats["drops"] += 1
         return False
 
+    def register_knobs(self, registry) -> None:
+        """Publish the per-tick prefetch budget to the autopilot
+        (autopilot/knobs.py). tick() re-reads the config each pass. The
+        floor is 1, not 0: `due_sessions(limit=0)` means UNLIMITED, so a
+        zeroed knob would widen the budget it exists to shrink."""
+        from llm_d_kv_cache_manager_tpu.autopilot.knobs import (
+            KNOB_PREDICTION_JOBS,
+            KnobSpec,
+        )
+
+        cfg = self.config
+        registry.register(
+            KnobSpec(
+                name=KNOB_PREDICTION_JOBS,
+                floor=1.0,
+                ceiling=float(max(cfg.max_jobs_per_tick * 2, 2)),
+                max_step=1.0,
+                integer=True,
+                description="anticipatory prefetch jobs submitted per tick",
+            ),
+            get=lambda: cfg.max_jobs_per_tick,
+            set_=lambda v: setattr(cfg, "max_jobs_per_tick", int(v)),
+        )
+
     def status(self) -> dict:
         return {
             "config": {

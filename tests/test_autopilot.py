@@ -1,0 +1,808 @@
+"""SLO autopilot: knobs, rules, hysteresis, and the healthy no-op pin.
+
+Everything runs under injected hand clocks and injected SLO objectives —
+no sleeps, no wall time. The keystone property (mirrored end-to-end by
+the committed FLEET_BENCH_AUTOPILOT.json healthy arm) is the last class:
+an attached autopilot whose signals stay healthy mutates NOTHING — every
+owning config dataclass, every knob position, bit-identical to an
+autopilot-free process.
+"""
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.api.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    AdmissionRejected,
+)
+from llm_d_kv_cache_manager_tpu.autopilot import (
+    AUTOPILOT_KNOBS,
+    AutopilotConfig,
+    AutopilotController,
+    KNOB_ADMISSION_QUEUE,
+    KNOB_AUDIT_INTERVAL,
+    KNOB_PLACEMENT_K,
+    KnobRegistry,
+    KnobSpec,
+    Rule,
+    RULE_DECAY,
+    RULE_HIT_RATE,
+    SignalAssembler,
+    SignalSnapshot,
+    default_rules,
+)
+from llm_d_kv_cache_manager_tpu.obs.slo import (
+    OBJECTIVE_HIT_RATE,
+    OBJECTIVE_READ_LATENCY,
+    SLOConfig,
+    SLOMonitor,
+    SLOObjective,
+    WINDOW_FAST,
+    WINDOW_SLOW,
+)
+
+pytestmark = pytest.mark.autopilot
+
+
+class HandClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+class Box:
+    """Minimal knob owner: one mutable attribute."""
+
+    def __init__(self, value):
+        self.value = value
+
+
+def make_knob(registry, name=KNOB_PLACEMENT_K, value=3.0, floor=1.0,
+              ceiling=6.0, max_step=1.0, integer=False):
+    box = Box(value)
+    knob = registry.register(
+        KnobSpec(name=name, floor=floor, ceiling=ceiling,
+                 max_step=max_step, integer=integer),
+        get=lambda: box.value,
+        set_=lambda v: setattr(box, "value", v),
+    )
+    return box, knob
+
+
+# -- knobs --------------------------------------------------------------------
+
+
+class TestKnobs:
+    def test_spec_rejects_unknown_names_and_bad_bounds(self):
+        with pytest.raises(ValueError, match="AUTOPILOT_KNOBS"):
+            KnobSpec(name="router.secret", floor=0, ceiling=1, max_step=1)
+        with pytest.raises(ValueError, match="floor"):
+            KnobSpec(name=KNOB_PLACEMENT_K, floor=5, ceiling=1, max_step=1)
+        with pytest.raises(ValueError, match="max_step"):
+            KnobSpec(name=KNOB_PLACEMENT_K, floor=1, ceiling=5, max_step=0)
+
+    def test_baseline_outside_bounds_is_rejected(self):
+        registry = KnobRegistry()
+        with pytest.raises(ValueError, match="outside"):
+            make_knob(registry, value=99.0, ceiling=6.0)
+
+    def test_nudge_clips_to_max_step_then_clamps_to_bounds(self):
+        registry = KnobRegistry()
+        box, knob = make_knob(registry, value=3.0, max_step=1.0)
+        # A huge requested delta applies at most one max_step.
+        assert knob.nudge(100.0) == 1.0
+        assert box.value == 4.0
+        # Landing clamps to the ceiling; a knob pinned there is a no-op.
+        knob.nudge(1.0)
+        knob.nudge(1.0)
+        assert box.value == 6.0
+        assert knob.nudge(1.0) == 0.0
+        assert box.value == 6.0
+
+    def test_integer_knob_writes_ints(self):
+        registry = KnobRegistry()
+        box, knob = make_knob(registry, value=3, integer=True)
+        knob.nudge(1.0)
+        assert box.value == 4 and isinstance(box.value, int)
+
+    def test_revert_step_lands_exactly_on_baseline(self):
+        registry = KnobRegistry()
+        box, knob = make_knob(registry, value=3.0, max_step=0.75)
+        knob.nudge(0.75)
+        knob.nudge(0.75)
+        assert box.value == 4.5
+        assert knob.revert_step() == -0.75
+        # Within one max_step of baseline: lands bit-identically on it,
+        # not epsilon-close.
+        assert knob.revert_step() == -0.75
+        assert box.value == 3.0
+        assert knob.at_baseline()
+        assert knob.revert_step() == 0.0
+
+    def test_registry_rejects_duplicates_and_reports_positions(self):
+        registry = KnobRegistry()
+        make_knob(registry, name=KNOB_PLACEMENT_K, value=3.0)
+        with pytest.raises(ValueError, match="already registered"):
+            make_knob(registry, name=KNOB_PLACEMENT_K, value=3.0)
+        make_knob(registry, name=KNOB_ADMISSION_QUEUE, value=4.0,
+                  floor=1.0, ceiling=16.0)
+        assert registry.names() == sorted(
+            [KNOB_PLACEMENT_K, KNOB_ADMISSION_QUEUE]
+        )
+        assert registry.at_baseline()
+        registry.get(KNOB_PLACEMENT_K).nudge(1.0)
+        assert not registry.at_baseline()
+        doc = registry.positions()[KNOB_PLACEMENT_K]
+        assert doc["position"] == 4.0 and doc["baseline"] == 3.0
+
+
+# -- signals ------------------------------------------------------------------
+
+
+class FakeTransferClient:
+    def __init__(self, peers=None):
+        self.peers = peers or {}
+
+    def status(self):
+        return {"peers": self.peers}
+
+
+class FakeAntiEntropy:
+    def __init__(self, pods=None):
+        self.pods = pods or {}
+
+    def status(self):
+        return {"pods": self.pods}
+
+
+class FakePrefetcher:
+    def __init__(self, by_source):
+        self.by_source = by_source
+
+    def status(self):
+        return {"by_source": self.by_source}
+
+
+class TestSignalAssembler:
+    def test_empty_assembler_reads_healthy(self):
+        snap = SignalAssembler(clock=HandClock(5.0)).snapshot()
+        assert snap.t == 5.0
+        assert snap.breaching == () and snap.open_peers == ()
+        assert snap.breaker_opens == 0 and snap.prefetch_drops == {}
+        assert snap.objective_status(OBJECTIVE_HIT_RATE) == "no_data"
+
+    def test_projects_breakers_trust_and_drops(self):
+        client = FakeTransferClient({
+            "pod-b:9": {"state": "open", "opens": 3},
+            "pod-a:9": {"state": "closed", "opens": 1},
+        })
+        assembler = SignalAssembler(
+            transfer_client=client,
+            antientropy=FakeAntiEntropy({
+                "pod-a": {"factor": 1.0, "accuracy": 0.9},
+                "pod-b": {"factor": 0.25, "accuracy": 0.4},
+            }),
+            prefetchers={
+                "route": FakePrefetcher({"route": {"dropped": 2}}),
+                "prediction": FakePrefetcher(
+                    {"prediction": {"dropped": 5}, "route": {"dropped": 1}}
+                ),
+            },
+            clock=HandClock(),
+        )
+        snap = assembler.snapshot(1.0)
+        assert snap.open_peers == ("pod-b:9",)
+        # Historical trips baseline on the first snapshot: attaching to
+        # a fleet with old opens must not read as a live incident.
+        assert snap.breaker_opens == 0
+        assert snap.distrusted_pods == ("pod-b",)
+        assert snap.min_accuracy == 0.4
+        assert snap.prefetch_drops == {"route": 3, "prediction": 5}
+
+    def test_breaker_opens_is_a_delta_between_snapshots(self):
+        client = FakeTransferClient({
+            "pod-b:9": {"state": "open", "opens": 3},
+        })
+        assembler = SignalAssembler(
+            transfer_client=client, clock=HandClock()
+        )
+        assert assembler.snapshot(1.0).breaker_opens == 0
+        client.peers["pod-b:9"]["opens"] = 5
+        client.peers["pod-a:9"] = {"state": "closed", "opens": 2}
+        assert assembler.snapshot(2.0).breaker_opens == 4
+        # Quiet interval reads 0 again — the condition un-latches, so
+        # hysteresis can walk the hedge knob home after the incident.
+        assert assembler.snapshot(3.0).breaker_opens == 0
+        # A peer table that shrank (e.g. a pod replaced) clamps at 0.
+        del client.peers["pod-b:9"]
+        assert assembler.snapshot(4.0).breaker_opens == 0
+
+    def test_a_raising_source_reads_as_healthy(self):
+        class Broken:
+            def status(self):
+                raise RuntimeError("down")
+
+        snap = SignalAssembler(
+            transfer_client=Broken(), antientropy=Broken(),
+            prefetchers={"x": Broken()}, clock=HandClock(),
+        ).snapshot(1.0)
+        assert snap.open_peers == () and snap.distrusted_pods == ()
+        assert snap.prefetch_drops == {}
+
+
+# -- SLOMonitor.burn_history (satellite surface) ------------------------------
+
+
+def make_monitor(clock, bad_total):
+    """Monitor over one injected cumulative counter pair."""
+    cfg = SLOConfig(fast_window_s=10.0, slow_window_s=60.0)
+    obj = SLOObjective(
+        name=OBJECTIVE_READ_LATENCY, description="t", budget=0.1,
+        counts_fn=lambda: tuple(bad_total),
+    )
+    return SLOMonitor([obj], cfg, clock=clock)
+
+
+class TestBurnHistory:
+    def test_series_tracks_the_ring(self):
+        clock = HandClock()
+        bad_total = [0.0, 0.0]
+        mon = make_monitor(clock, bad_total)
+        for _ in range(5):
+            clock.advance(1.0)
+            bad_total[1] += 10.0
+            bad_total[0] += 5.0  # 50% bad, budget 0.1 → burn 5.0
+            mon.evaluate(clock.t)
+        hist = dict(mon.burn_history(OBJECTIVE_READ_LATENCY, WINDOW_FAST))
+        assert hist[0.0] == 0.0  # the construction-time baseline sample
+        assert hist[5.0] == pytest.approx(5.0)
+        # Times ascend, one point per retained sample.
+        times = [t for t, _ in
+                 mon.burn_history(OBJECTIVE_READ_LATENCY, WINDOW_SLOW)]
+        assert times == sorted(times) and len(times) == 6
+
+    def test_each_point_uses_its_own_window_edge(self):
+        clock = HandClock()
+        bad_total = [0.0, 0.0]
+        mon = make_monitor(clock, bad_total)  # fast window = 10s
+        # 5 clean seconds, then 10 burning ones.
+        for _ in range(5):
+            clock.advance(1.0)
+            bad_total[1] += 10.0
+            mon.evaluate(clock.t)
+        for _ in range(10):
+            clock.advance(1.0)
+            bad_total[1] += 10.0
+            bad_total[0] += 10.0  # 100% bad → burn 10.0
+            mon.evaluate(clock.t)
+        hist = dict(mon.burn_history(OBJECTIVE_READ_LATENCY, WINDOW_FAST))
+        assert hist[5.0] == 0.0
+        # At t=15 the fast window [5, 15] is entirely bad traffic.
+        assert hist[15.0] == pytest.approx(10.0)
+        # Mid-ramp the window still holds some clean baseline.
+        assert 0.0 < hist[10.0] < 10.0
+
+    def test_unknown_objective_and_window_raise(self):
+        mon = make_monitor(HandClock(), [0.0, 0.0])
+        with pytest.raises(ValueError, match="SLO_WINDOWS"):
+            mon.burn_history(OBJECTIVE_READ_LATENCY, "weird")
+        with pytest.raises(ValueError, match="unknown objective"):
+            mon.burn_history("nope", WINDOW_FAST)
+
+
+# -- controller ---------------------------------------------------------------
+
+
+def make_controller(clock, breaching=False, **cfg_kw):
+    """Controller over one hand-made rule conditioned on a mutable flag."""
+    flag = {"hot": breaching}
+    registry = KnobRegistry()
+    box, _ = make_knob(registry, name=KNOB_PLACEMENT_K, value=3.0,
+                       ceiling=6.0, max_step=1.0, integer=True)
+    rule = Rule(
+        name=RULE_HIT_RATE,
+        description="test rule",
+        condition=lambda snap: flag["hot"],
+        nudges=((KNOB_PLACEMENT_K, 1.0),),
+    )
+    cfg = AutopilotConfig(
+        min_interval_s=1.0, warmup_s=5.0, cooldown_s=3.0,
+        decay_after_s=6.0, **cfg_kw,
+    )
+    ctrl = AutopilotController(
+        registry, SignalAssembler(clock=clock), config=cfg, rules=[rule],
+        clock=clock,
+    )
+    return ctrl, box, flag
+
+
+class TestController:
+    def test_rule_vocabulary_is_enforced(self):
+        with pytest.raises(ValueError, match="AUTOPILOT_RULES"):
+            Rule(name="my_rule", description="", condition=lambda s: True,
+                 nudges=())
+
+    def test_default_rules_cover_every_burn_signal(self):
+        rules = default_rules()
+        names = {r.name for r in rules}
+        assert names == {
+            "read_latency_breach", "hit_rate_burn", "breaker_trips",
+            "shed_rate_burn",
+        }
+        # Every nudged knob is in the fixed vocabulary.
+        for rule in rules:
+            for knob_name, frac in rule.nudges:
+                assert knob_name in AUTOPILOT_KNOBS
+                assert frac != 0.0
+
+    def test_warmup_holds_fire(self):
+        clock = HandClock()
+        ctrl, box, _ = make_controller(clock, breaching=True)
+        assert ctrl.tick(0.0) == []  # breaching, but cold
+        assert ctrl.tick(clock.advance(2.0)) == []
+        assert box.value == 3
+        applied = ctrl.tick(clock.advance(4.0))  # t=6 > warmup 5
+        assert len(applied) == 1 and box.value == 4
+
+    def test_cooldown_rate_limits_each_rule(self):
+        clock = HandClock(10.0)
+        ctrl, box, _ = make_controller(clock, breaching=True)
+        ctrl.tick(10.0)  # warm-up starts at first tick
+        clock.advance(6.0)
+        assert len(ctrl.tick(clock.t)) == 1 and box.value == 4
+        # Still breaching, but inside the 3s cooldown: no second nudge.
+        assert ctrl.tick(clock.advance(1.0)) == []
+        assert box.value == 4
+        assert len(ctrl.tick(clock.advance(3.0))) == 1
+        assert box.value == 5
+
+    def test_min_interval_skips_fast_polls(self):
+        clock = HandClock()
+        ctrl, _, _ = make_controller(clock)
+        ctrl.tick(0.0)
+        ctrl.tick(0.5)  # under min_interval_s=1.0
+        assert ctrl.stats["ticks"] == 2
+        assert ctrl.stats["evaluations"] == 1
+
+    def test_decay_walks_back_to_baseline_and_journal_attributes_it(self):
+        clock = HandClock()
+        ctrl, box, flag = make_controller(clock, breaching=True)
+        ctrl.tick(0.0)
+        for _ in range(4):  # fire up to the ceiling region
+            ctrl.tick(clock.advance(3.0))
+        assert box.value > 3
+        peak = box.value
+        flag["hot"] = False  # condition clears
+        # Inside decay_after_s: knob holds.
+        ctrl.tick(clock.advance(3.0))
+        assert box.value == peak
+        # Once quiet long enough, one bounded revert step per cooldown
+        # cadence, attributed to the decay pseudo-rule.
+        steps = 0
+        while box.value != 3 and steps < 10:
+            applied = ctrl.tick(clock.advance(3.0))
+            for entry in applied:
+                assert entry[1] == RULE_DECAY and entry[3] == "revert"
+                assert abs(entry[4]) <= 1.0
+            steps += 1
+        assert box.value == 3  # bit-identical to the operator's config
+        assert ctrl.registry.at_baseline()
+        assert ctrl.stats["reverts"] > 0
+        # Fully reverted: later quiet ticks journal nothing.
+        assert ctrl.tick(clock.advance(3.0)) == []
+
+    def test_breach_during_decay_rearms_the_hold(self):
+        clock = HandClock()
+        ctrl, box, flag = make_controller(clock, breaching=True)
+        ctrl.tick(0.0)
+        ctrl.tick(clock.advance(6.0))
+        assert box.value == 4
+        flag["hot"] = False
+        ctrl.tick(clock.advance(3.0))
+        flag["hot"] = True  # breaches again before decay_after_s elapses
+        ctrl.tick(clock.advance(3.0))
+        flag["hot"] = False
+        # The quiet timer restarted: 3s later the knob must still hold.
+        applied = ctrl.tick(clock.advance(3.0))
+        assert all(e[1] != RULE_DECAY for e in applied)
+
+    def test_status_document_shape(self):
+        clock = HandClock()
+        ctrl, _, _ = make_controller(clock, breaching=True)
+        ctrl.tick(0.0)
+        ctrl.tick(clock.advance(6.0))
+        doc = ctrl.status()
+        assert doc["config"]["warmup_s"] == 5.0
+        assert KNOB_PLACEMENT_K in doc["knobs"]
+        assert not doc["at_baseline"]
+        assert doc["rules"][RULE_HIT_RATE]["fired"] == 1
+        assert doc["rules"][RULE_HIT_RATE]["touched_knobs"] == [
+            KNOB_PLACEMENT_K
+        ]
+        assert doc["recent_actuations"]
+        assert doc["stats"]["actuations"] == 1
+
+    def test_journal_is_bounded(self):
+        clock = HandClock()
+        ctrl, box, flag = make_controller(clock, breaching=True,
+                                          journal_len=4)
+        ctrl.tick(0.0)
+        for _ in range(8):  # alternate breach/decay to keep actuating
+            ctrl.tick(clock.advance(3.0))
+            flag["hot"] = not flag["hot"]
+            clock.advance(6.0)
+        assert len(ctrl.journal) <= 4
+
+    def test_a_raising_rule_condition_reads_as_quiet(self):
+        registry = KnobRegistry()
+        make_knob(registry, name=KNOB_PLACEMENT_K, value=3.0)
+        rule = Rule(
+            name=RULE_HIT_RATE, description="",
+            condition=lambda snap: 1 / 0,
+            nudges=((KNOB_PLACEMENT_K, 1.0),),
+        )
+        clock = HandClock()
+        ctrl = AutopilotController(
+            registry, SignalAssembler(clock=clock),
+            config=AutopilotConfig(warmup_s=0.0), rules=[rule], clock=clock,
+        )
+        assert ctrl.tick(0.0) == []
+        assert registry.at_baseline()
+
+
+# -- subsystem knob registration ----------------------------------------------
+
+
+class TestRegisteredKnobs:
+    def test_admission_knob_widens_the_live_waiting_line(self):
+        clock = HandClock()
+        gate = AdmissionController(
+            AdmissionConfig(max_concurrency=1, max_queue_depth=0),
+            clock=clock,
+        )
+        registry = KnobRegistry()
+        gate.register_knobs(registry)
+        knob = registry.get(KNOB_ADMISSION_QUEUE)
+        assert knob is not None and knob.position() == 0.0
+        assert knob.spec.floor == 0.0  # never narrows below the baseline
+        gate.try_acquire()
+        # Baseline: no waiting line at all → immediate queue_full shed.
+        with pytest.raises(AdmissionRejected):
+            gate.try_acquire(budget_s=0.01)
+        knob.nudge(knob.spec.max_step)
+        assert gate.config.max_queue_depth > 0  # the very next arrival queues
+
+    def test_auditor_knob_tightens_the_live_cadence(self):
+        from llm_d_kv_cache_manager_tpu.antientropy.auditor import (
+            AuditorConfig,
+            ResidencyAuditor,
+        )
+
+        auditor = ResidencyAuditor(
+            index=None, model_name="m", digest_fn=lambda *a: None,
+            config=AuditorConfig(interval_s=8.0), clock=HandClock(),
+        )
+        registry = KnobRegistry()
+        auditor.register_knobs(registry)
+        knob = registry.get(KNOB_AUDIT_INTERVAL)
+        knob.nudge(-knob.spec.max_step)
+        assert auditor.config.interval_s == 4.0
+        # Bounds honor the operator's baseline: floor base/8, ceil base*4.
+        assert knob.spec.floor == 1.0 and knob.spec.ceiling == 32.0
+
+    def test_prediction_jobs_floor_is_one_not_zero(self):
+        """due_sessions(limit=0) means UNLIMITED — a zeroed knob would
+        WIDEN the budget it exists to shrink."""
+        from llm_d_kv_cache_manager_tpu.prediction.scheduler import (
+            PrefetchScheduler,
+            SchedulerConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.prediction.sessions import (
+            SessionTable,
+        )
+
+        sched = PrefetchScheduler(
+            SessionTable(clock=HandClock()),
+            score_fn=lambda *a: None, submit_fn=lambda *a: False,
+            config=SchedulerConfig(max_jobs_per_tick=2), clock=HandClock(),
+        )
+        registry = KnobRegistry()
+        sched.register_knobs(registry)
+        knob = registry.get("prediction.max_jobs_per_tick")
+        assert knob.spec.floor == 1.0
+        knob.nudge(-10.0)
+        knob.nudge(-10.0)
+        assert sched.config.max_jobs_per_tick == 1
+
+    def test_replicator_registers_both_placement_knobs(self):
+        from llm_d_kv_cache_manager_tpu.placement.replicator import (
+            HotPrefixReplicator,
+            ReplicationConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.placement.popularity import (
+            ChainPopularityTracker,
+        )
+
+        rep = HotPrefixReplicator(
+            ChainPopularityTracker(clock=HandClock()),
+            submit_fn=lambda *a: False, pods_fn=lambda: [],
+            config=ReplicationConfig(k_replicas=3, max_jobs_per_tick=4),
+            clock=HandClock(),
+        )
+        registry = KnobRegistry()
+        rep.register_knobs(registry)
+        assert registry.names() == [
+            "placement.k_replicas", "placement.max_jobs_per_tick",
+        ]
+        registry.get(KNOB_PLACEMENT_K).nudge(1.0)
+        assert rep.config.k_replicas == 4
+
+
+# -- dynamic Retry-After (satellite surface) ----------------------------------
+
+
+class TestRetryAfterPressure:
+    def make_gate(self, clock):
+        return AdmissionController(
+            AdmissionConfig(
+                max_concurrency=2, max_queue_depth=0, retry_after_s=1.0,
+                retry_after_max_s=8.0, shed_pressure_window_s=5.0,
+            ),
+            clock=clock,
+        )
+
+    def shed_once(self, gate):
+        with pytest.raises(AdmissionRejected) as exc:
+            gate.try_acquire()
+        return exc.value.retry_after_s
+
+    def test_hint_scales_with_live_shed_pressure(self):
+        clock = HandClock(100.0)
+        gate = self.make_gate(clock)
+        gate.try_acquire()
+        gate.try_acquire()  # both slots busy; queue depth 0
+        # First shed of a burst carries exactly the baseline hint.
+        assert self.shed_once(gate) == 1.0
+        # Each subsequent shed inside the window backs off harder:
+        # scale = 1 + recent/max_concurrency.
+        assert self.shed_once(gate) == 1.5
+        assert self.shed_once(gate) == 2.0
+        # ... clamped at the ceiling under a flood.
+        for _ in range(40):
+            self.shed_once(gate)
+        assert self.shed_once(gate) == 8.0
+
+    def test_pressure_decays_once_the_window_passes(self):
+        clock = HandClock(100.0)
+        gate = self.make_gate(clock)
+        gate.try_acquire()
+        gate.try_acquire()
+        for _ in range(6):
+            self.shed_once(gate)
+        assert gate.retry_after_hint() > 1.0
+        clock.advance(6.0)  # past shed_pressure_window_s
+        assert gate.retry_after_hint() == 1.0
+        assert self.shed_once(gate) == 1.0
+
+    def test_status_reports_the_live_hint(self):
+        clock = HandClock(100.0)
+        gate = self.make_gate(clock)
+        doc = gate.status()
+        assert doc["retry_after_max_s"] == 8.0
+        assert doc["retry_after_hint_s"] == 1.0
+
+
+# -- the healthy no-op pin ----------------------------------------------------
+
+
+class TestHealthyBitIdentity:
+    def test_attached_autopilot_on_healthy_signals_mutates_nothing(self):
+        """The tentpole guarantee, unit-scale: warm controller, live
+        monitor, real registered subsystems, healthy signals — many ticks
+        later every owning config is bit-identical and the journal is
+        empty. (FLEET_BENCH_AUTOPILOT.json pins the same property through
+        the full sim.)"""
+        from llm_d_kv_cache_manager_tpu.antientropy.auditor import (
+            AuditorConfig,
+            ResidencyAuditor,
+        )
+
+        clock = HandClock()
+        bad_total = [0.0, 0.0]
+        mon = make_monitor(clock, bad_total)
+        gate = AdmissionController(AdmissionConfig(), clock=clock)
+        auditor = ResidencyAuditor(
+            index=None, model_name="m", digest_fn=lambda *a: None,
+            config=AuditorConfig(), clock=clock,
+        )
+        registry = KnobRegistry()
+        gate.register_knobs(registry)
+        auditor.register_knobs(registry)
+        assembler = SignalAssembler(
+            slo_monitor=mon,
+            transfer_client=FakeTransferClient(
+                {"pod-a:9": {"state": "closed", "opens": 0}}
+            ),
+            antientropy=FakeAntiEntropy(
+                {"pod-a": {"factor": 1.0, "accuracy": 1.0}}
+            ),
+            clock=clock,
+        )
+        ctrl = AutopilotController(
+            registry, assembler,
+            config=AutopilotConfig(warmup_s=0.0), clock=clock,
+        )
+        before = (repr(gate.config), repr(auditor.config))
+        positions_before = {
+            name: doc["position"]
+            for name, doc in registry.positions().items()
+        }
+        for _ in range(30):
+            clock.advance(2.0)
+            bad_total[1] += 100.0  # healthy traffic: zero bad events
+            assert ctrl.tick(clock.t) == []
+        assert (repr(gate.config), repr(auditor.config)) == before
+        assert {
+            name: doc["position"]
+            for name, doc in registry.positions().items()
+        } == positions_before
+        assert registry.at_baseline()
+        assert len(ctrl.journal) == 0
+        assert ctrl.stats["actuations"] == 0
+        assert ctrl.stats["evaluations"] == 30
+        assert ctrl.last_snapshot is not None
+        assert ctrl.last_snapshot.breaching == ()
+
+    def test_snapshot_assembly_is_read_only_over_the_monitor(self):
+        """Assembly evaluates the monitor exactly as a /slo/status poll
+        would — same sample ring growth, no other state."""
+        clock = HandClock()
+        bad_total = [0.0, 0.0]
+        mon = make_monitor(clock, bad_total)
+        assembler = SignalAssembler(slo_monitor=mon, clock=clock)
+        evals_before = mon.evaluations
+        snap = assembler.snapshot(clock.advance(1.0))
+        assert mon.evaluations == evals_before + 1
+        assert isinstance(snap, SignalSnapshot)
+
+
+# -- service wiring -----------------------------------------------------------
+
+
+def make_service(extra_env=None):
+    from llm_d_kv_cache_manager_tpu.api.http_service import ScoringService
+    from llm_d_kv_cache_manager_tpu.kvcache.indexer import (
+        Indexer,
+        IndexerConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+        TokenizationPool,
+        TokenizersPoolConfig,
+    )
+    from tests.conftest import TEST_MODEL_NAME, TEST_TOKENIZER_JSON
+
+    indexer = Indexer(
+        config=IndexerConfig(),
+        tokenization_pool=TokenizationPool(TokenizersPoolConfig(
+            workers=1,
+            local_tokenizer_files={TEST_MODEL_NAME: TEST_TOKENIZER_JSON},
+        )),
+    )
+    env = {
+        "zmq_endpoint": "tcp://*:0", "zmq_topic": "kv@",
+        "pool_concurrency": 1, "hash_seed": "", "block_size": 16,
+        "http_port": 0, "enable_metrics": False,
+    }
+    env.update(extra_env or {})
+    return ScoringService(env, indexer=indexer)
+
+
+class TestServiceWiring:
+    def test_config_from_env_parses_autopilot_block(self, monkeypatch):
+        from llm_d_kv_cache_manager_tpu.api.http_service import (
+            config_from_env,
+        )
+
+        monkeypatch.setenv("AUTOPILOT", "1")
+        monkeypatch.setenv("AUTOPILOT_MIN_INTERVAL_S", "2.5")
+        monkeypatch.setenv("AUTOPILOT_WARMUP_S", "30")
+        monkeypatch.setenv("AUTOPILOT_COOLDOWN_S", "7")
+        monkeypatch.setenv("AUTOPILOT_DECAY_AFTER_S", "45")
+        env = config_from_env()
+        assert env["autopilot"] is True
+        assert env["autopilot_min_interval_s"] == 2.5
+        assert env["autopilot_warmup_s"] == 30.0
+        assert env["autopilot_cooldown_s"] == 7.0
+        assert env["autopilot_decay_after_s"] == 45.0
+        monkeypatch.delenv("AUTOPILOT")
+        assert config_from_env()["autopilot"] is False  # off by default
+
+    def test_disabled_returns_400_and_null_readyz_section(self):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        service = make_service()
+        assert service.autopilot is None
+
+        async def run():
+            async with TestClient(TestServer(service.make_app())) as client:
+                service.start(with_subscriber=False)
+                resp = await client.get("/readyz")
+                assert (await resp.json())["autopilot"] is None
+                resp = await client.get("/autopilot/status")
+                assert resp.status == 400
+                assert "AUTOPILOT=1" in (await resp.json())["error"]
+
+        try:
+            asyncio.run(run())
+        finally:
+            service.stop()
+
+    def test_enabled_service_exposes_status_and_admission_knob(self):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        service = make_service({
+            "autopilot": True,
+            "autopilot_warmup_s": 30.0,
+        })
+        assert service.autopilot is not None
+        assert service.autopilot_registry is not None
+        # The admission gate published its knob at construction.
+        assert service.autopilot_registry.names() == [KNOB_ADMISSION_QUEUE]
+        assert service.autopilot.config.warmup_s == 30.0
+
+        async def run():
+            async with TestClient(TestServer(service.make_app())) as client:
+                service.start(with_subscriber=False)
+                resp = await client.get("/autopilot/status")
+                assert resp.status == 200
+                doc = await resp.json()
+                assert doc["at_baseline"] is True
+                assert KNOB_ADMISSION_QUEUE in doc["knobs"]
+                assert doc["recent_actuations"] == []
+                assert set(doc["rules"]) == {
+                    "read_latency_breach", "hit_rate_burn",
+                    "breaker_trips", "shed_rate_burn",
+                }
+                # /readyz embeds the same section and stays ready.
+                resp = await client.get("/readyz")
+                assert resp.status == 200
+                section = (await resp.json())["autopilot"]
+                assert section["at_baseline"] is True
+                assert section["stats"]["ticks"] >= 1
+
+        try:
+            asyncio.run(run())
+        finally:
+            service.stop()
+
+    def test_late_registered_knob_is_reachable(self):
+        """Embedder wiring order: subsystems assigned after construction
+        register against service.autopilot_registry and are immediately
+        visible to the controller."""
+        from llm_d_kv_cache_manager_tpu.antientropy.auditor import (
+            AuditorConfig,
+            ResidencyAuditor,
+        )
+
+        service = make_service({"autopilot": True})
+        auditor = ResidencyAuditor(
+            index=None, model_name="m", digest_fn=lambda *a: None,
+            config=AuditorConfig(),
+        )
+        service.auditor = auditor
+        auditor.register_knobs(service.autopilot_registry)
+        assert KNOB_AUDIT_INTERVAL in service.autopilot_registry.names()
+        assert (
+            service.autopilot.status()["knobs"][KNOB_AUDIT_INTERVAL]
+            ["at_baseline"]
+        )

@@ -264,6 +264,98 @@ class TestVendoredOracleFuzz:
             )
 
 
+class TestChunkBoundaryOracleParity:
+    """Chunk-boundary parity vs the vendored vLLM oracle — the test the
+    reference flags as a skipped TODO in its BlockStored handling
+    (pool.go; token_processor.tokens_to_kv_block_keys docstring), landed.
+    Three boundary behaviours must agree with an oracle replay, each with
+    and without a LoRA adapter mixed into the extra keys: a partial tail
+    block is DROPPED (never hashed, never perturbs the chain), an
+    exact-multiple token count chains cleanly, and a parent-Key
+    continuation across a chunk boundary re-joins the oracle's chain
+    bit-identically."""
+
+    BLOCK = 16
+
+    def _oracle(self, monkeypatch):
+        import sys as _sys
+
+        _sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+        from third_party import vllm_kv_cache_utils as oracle
+
+        monkeypatch.setenv("PYTHONHASHSEED", "0")
+        oracle.init_none_hash(oracle.sha256_cbor_64bit)
+        return oracle
+
+    def _db(self):
+        return ChunkedTokenDatabase(
+            TokenProcessorConfig(
+                block_size=self.BLOCK,
+                hash_seed="0",
+                hash_algo="sha256_cbor_64bit",
+            )
+        )
+
+    def _replay(self, oracle, tokens, lora_id, parent=None):
+        """Oracle-side chain over the FULL blocks only — the oracle has
+        no partial-tail notion, so the replay dropping the tail is itself
+        part of the property under test."""
+        extra = (int(lora_id),) if lora_id is not None else None
+        out = []
+        for i in range(len(tokens) // self.BLOCK):
+            bh = oracle.hash_block_tokens(
+                oracle.sha256_cbor_64bit,
+                parent,
+                tokens[i * self.BLOCK:(i + 1) * self.BLOCK],
+                extra,
+            )
+            out.append(bh.hash_value)
+            parent = bh.hash_value
+        return out
+
+    @pytest.mark.parametrize("lora_id", [None, 7])
+    def test_partial_tail_is_dropped_not_hashed(self, monkeypatch, lora_id):
+        oracle = self._oracle(monkeypatch)
+        rng = random.Random(0xB0B)
+        block = self.BLOCK
+        tokens = [rng.randrange(2**32) for _ in range(block * 3)]
+        full_chain = self._replay(oracle, tokens, lora_id)
+        for tail in (0, 1, block // 2, block - 1):
+            got = [
+                k.chunk_hash
+                for k in self._db().tokens_to_kv_block_keys(
+                    None, tokens + tokens[:tail], "m", lora_id=lora_id
+                )
+            ]
+            assert got == full_chain, (
+                f"a {tail}-token partial tail perturbed the chain"
+            )
+        # Fewer than one full block yields no keys at all.
+        assert self._db().tokens_to_kv_block_keys(
+            None, tokens[: block - 1], "m", lora_id=lora_id
+        ) == []
+
+    @pytest.mark.parametrize("lora_id", [None, 7])
+    def test_parent_key_continuation_across_boundary(
+        self, monkeypatch, lora_id
+    ):
+        oracle = self._oracle(monkeypatch)
+        rng = random.Random(0xB0C)
+        block = self.BLOCK
+        tokens = [rng.randrange(2**32) for _ in range(block * 4)]
+        expected = self._replay(oracle, tokens, lora_id)
+        db = self._db()
+        head = db.tokens_to_kv_block_keys(
+            None, tokens[: block * 2], "m", lora_id=lora_id
+        )
+        # Continue from the head's last Key across the chunk boundary —
+        # with a partial tail on the continuation, which must still drop.
+        cont = db.tokens_to_kv_block_keys(
+            head[-1], tokens[block * 2:] + tokens[:3], "m", lora_id=lora_id
+        )
+        assert [k.chunk_hash for k in head + cont] == expected
+
+
 class TestVllmAlgoEventPath:
     """End-to-end property of sha256_cbor_64bit mode: when the engine's
     own block hashes (computed here by the vendored vLLM oracle) flow

@@ -41,9 +41,15 @@ from llm_d_kv_cache_manager_tpu.obs import spans as obs_spans
 #               PREFETCH_SOURCES tuple: route/replication/prediction)
 #   objective — SLO objective names (obs/slo.py SLO_OBJECTIVES tuple)
 #   window    — SLO evaluation windows (obs/slo.py SLO_WINDOWS: fast/slow)
+#   rule      — autopilot rule names (autopilot/controller.py
+#               AUTOPILOT_RULES tuple)
+#   direction — autopilot actuation directions (autopilot/controller.py
+#               AUTOPILOT_DIRECTIONS: up/down/revert)
+#   knob      — autopilot knob names (autopilot/knobs.py AUTOPILOT_KNOBS
+#               tuple — policy surfaces, never traffic)
 ALLOWED_LABELS = {
     "state", "kind", "backend", "op", "plane", "stage", "phase", "region",
-    "source", "objective", "window",
+    "source", "objective", "window", "rule", "direction", "knob",
 }
 # The plane vocabulary is committed in code (obs/spans.py) — the walk and
 # the span-inventory scan both pin against the same tuple, so a new plane
@@ -136,6 +142,12 @@ def test_collectors_exist():
     assert "index_divergence_readmitted" in collectors
     assert "index_divergence_audits" in collectors
     assert "index_divergence_negative_skips" in collectors
+    # SLO autopilot (autopilot/): bounded actuations by (rule, direction)
+    # and the live knob-position gauge by knob name — every label from a
+    # fixed code-defined vocabulary, inside the walk so the bounds stay
+    # enforced.
+    assert "autopilot_actuations" in collectors
+    assert "autopilot_knob_position" in collectors
 
 
 def test_prefetch_drop_source_values_are_code_defined():
@@ -267,6 +279,49 @@ def test_admission_shed_kind_values_are_code_defined():
             kind = sample.labels.get("kind")
             if kind is not None:
                 assert kind in SHED_KINDS, f"unexpected shed kind {kind!r}"
+
+
+def test_autopilot_label_values_are_code_defined():
+    """The autopilot actuation counter's (rule, direction) labels and the
+    knob-position gauge's knob label carry only the fixed vocabularies
+    committed in autopilot/ — controller policy identity, never traffic."""
+    from llm_d_kv_cache_manager_tpu.autopilot import (
+        AUTOPILOT_DIRECTIONS,
+        AUTOPILOT_KNOBS,
+        AUTOPILOT_RULES,
+    )
+
+    assert set(AUTOPILOT_RULES) == {
+        "read_latency_breach", "hit_rate_burn", "breaker_trips",
+        "shed_rate_burn", "decay_to_baseline",
+    }
+    assert set(AUTOPILOT_DIRECTIONS) == {"up", "down", "revert"}
+    assert set(AUTOPILOT_KNOBS) == {
+        "placement.k_replicas", "placement.max_jobs_per_tick",
+        "prediction.max_jobs_per_tick", "transfer.hedge_delay_floor_s",
+        "admission.max_queue_depth", "antientropy.interval_s",
+    }
+    metrics.register_metrics()
+    for metric in REGISTRY.collect():
+        if metric.name == "kvcache_autopilot_actuations":
+            for sample in metric.samples:
+                rule = sample.labels.get("rule")
+                direction = sample.labels.get("direction")
+                if rule is not None:
+                    assert rule in AUTOPILOT_RULES, (
+                        f"unexpected autopilot rule {rule!r}"
+                    )
+                if direction is not None:
+                    assert direction in AUTOPILOT_DIRECTIONS, (
+                        f"unexpected autopilot direction {direction!r}"
+                    )
+        elif metric.name == "kvcache_autopilot_knob_position":
+            for sample in metric.samples:
+                knob = sample.labels.get("knob")
+                if knob is not None:
+                    assert knob in AUTOPILOT_KNOBS, (
+                        f"unexpected autopilot knob {knob!r}"
+                    )
 
 
 def test_all_metrics_in_kvcache_namespace():
