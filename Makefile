@@ -2,11 +2,11 @@
 # build/test/bench/lint/image-build/image-push + pre-commit install —
 # /root/reference/Makefile, /root/reference/hooks/pre-commit.sh).
 
-.PHONY: native native-asan kvtransfer test bench bench-micro bench-read \
-	bench-obs bench-batch bench-faults bench-chaos bench-divergence \
-	bench-replication bench-placement bench-anticipate bench-autoscale \
-	bench-autopilot bench-geo bench-transfer clean proto lint \
-	precommit-install image-build image-push
+.PHONY: native native-asan native-tsan kvtransfer test bench bench-micro \
+	bench-read bench-obs bench-batch bench-native bench-faults bench-chaos \
+	bench-divergence bench-replication bench-placement bench-anticipate \
+	bench-autoscale bench-autopilot bench-geo bench-transfer clean proto \
+	lint precommit-install image-build image-push
 
 # Container image coordinates (override per environment/registry). The
 # release workflow (.github/workflows/ci-release.yaml) builds the same
@@ -39,15 +39,16 @@ kvtransfer:
 	cd kv_connectors/cpp && $(MAKE)
 
 # Sanitizer pass over the native code that touches raw buffers: builds the
-# C hash core and the transfer engine with -fsanitize=address,undefined
-# and runs the native/transfer test subset (wire fuzz included) under
-# them. The ASan runtime must be preloaded into the Python process for a
-# sanitized .so to load; leak detection is off (CPython itself "leaks" at
-# interpreter exit by design). The subset is the socket/hashing tests —
-# JAX device compute is pathologically slow under ASan and adds no
-# coverage of the raw-buffer code under test. The clean (unsanitized)
-# hash core is rebuilt afterwards whatever the test outcome, so this
-# target never leaves a sanitized .so in the package dir.
+# C hash core + scoring arena (native/setup.py builds both extensions) and
+# the transfer engine with -fsanitize=address,undefined and runs the
+# native/transfer test subset (wire fuzz included) under them. The ASan
+# runtime must be preloaded into the Python process for a sanitized .so to
+# load; leak detection is off (CPython itself "leaks" at interpreter exit
+# by design). The subset is the socket/hashing/arena tests — JAX device
+# compute is pathologically slow under ASan and adds no coverage of the
+# raw-buffer code under test. The clean (unsanitized) modules are rebuilt
+# afterwards whatever the test outcome, so this target never leaves a
+# sanitized .so in the package dir.
 native-asan:
 	cd kv_connectors/cpp && $(MAKE) asan
 	cd native && CFLAGS="-fsanitize=address,undefined -g" \
@@ -57,8 +58,26 @@ native-asan:
 	LD_PRELOAD=$$($(CXX) -print-file-name=libasan.so) \
 	JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
 		tests/test_transfer_wire_fuzz.py tests/test_transfer_chaos.py \
-		tests/test_hash_differential.py \
+		tests/test_hash_differential.py tests/test_native_core.py \
 		"tests/test_kv_connectors.py::TestTransferEngine" \
+		|| status=$$?; \
+	cd native && python setup.py build_ext >/dev/null 2>&1; \
+	exit $$status
+
+# ThreadSanitizer pass over the scoring arena's lock-free read path: the
+# seqlock'd per-key entry arrays and epoch-published structural changes are
+# exactly the code a data-race detector exercises, so the digest-while-
+# scoring stress tests run under TSan with both native extensions rebuilt
+# -fsanitize=thread. The suppression file mutes CPython's own internals
+# (the interpreter is not TSan-instrumented — every GIL handoff would
+# otherwise report). Same rebuild-clean-afterwards contract as native-asan.
+native-tsan:
+	cd native && CFLAGS="-fsanitize=thread -g" python setup.py build_ext
+	status=0; TSAN_OPTIONS="suppressions=$(PWD)/native/tsan.supp \
+	report_bugs=1 halt_on_error=0 exitcode=66" \
+	LD_PRELOAD=$$($(CC) -print-file-name=libtsan.so) \
+	JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+		tests/test_native_core.py tests/test_hash_differential.py \
 		|| status=$$?; \
 	cd native && python setup.py build_ext >/dev/null 2>&1; \
 	exit $$status
@@ -111,6 +130,16 @@ bench-obs:
 #   python benchmarking/micro_bench.py
 bench-batch: native
 	JAX_PLATFORMS=cpu python benchmarking/micro_bench.py --quick --legs batch
+
+# Native-scoring-core legs (kvcache/kvblock/native_index.py): the fused
+# lookup+score+adjust C crossing vs the pure-Python pipeline at router
+# batch 32 (plain and fully-adjusted) plus arena event digestion vs the
+# Python digest loop. Acceptance: native ≤ 10µs/request at batch 32,
+# arena digestion > 1M blocks/s. Full mode: refreshes the native legs IN
+# PLACE in the committed MICRO_BENCH.json (classic legs keep their
+# numbers). Smoke: add --quick (prints only, writes nothing).
+bench-native: native
+	JAX_PLATFORMS=cpu python benchmarking/micro_bench.py --legs native
 
 # Fault-injection fleet scenario (fleethealth/): pod crash/restart, event
 # stall, lossy/reordering streams over the synthetic chat workload.

@@ -1417,6 +1417,70 @@ def batch_section() -> str:
     return "\n".join(out)
 
 
+def native_section() -> str:
+    """Native scoring core legs from MICRO_BENCH.json — fused C crossing
+    vs the pure-Python read path on identical state (ISSUE 17
+    acceptance: warm score ≤10µs/request at batch 32, arena digestion
+    >1M blocks/s)."""
+    path = os.path.join(HERE, "MICRO_BENCH.json")
+    if not os.path.exists(path):
+        return (
+            "_Not yet recorded — run `python benchmarking/micro_bench.py`._"
+        )
+    d = _load(path).get("native_core")
+    if not d:
+        return (
+            "_native_core legs not in the committed MICRO_BENCH.json — "
+            "rerun `python benchmarking/micro_bench.py`._"
+        )
+    if not d.get("available"):
+        return (
+            "_Native module not built when the bench ran — `make native` "
+            "then `make bench-native`._"
+        )
+    out = [
+        f"Per-request cost of the batched read path at batch {d['batch']} "
+        f"({d['pods']} pods, {d['chain_blocks']}-block chains; `plain` = "
+        "lookup + longest-prefix score only, `adjusted` = plus "
+        "fleet-health demotion, anti-entropy accuracy factors, and "
+        "load-blend divisors — the full production scoring stack):",
+        "",
+        "| Leg | native (µs/req) | python (µs/req) | speedup |",
+        "|---|---:|---:|---:|",
+    ]
+    for leg in ("score_plain", "score_adjusted"):
+        m = d[leg]
+        out.append(
+            f"| {leg.removeprefix('score_')} "
+            f"| {m['native']['per_request_us']} "
+            f"| {m['python']['per_request_us']} "
+            f"| **{m['speedup_x']}×** |"
+        )
+    ed = d["event_digest"]
+    out += [
+        "",
+        f"Event digestion (steady-state arena, {ed['batches']} batches × "
+        f"{ed['blocks_per_batch']} blocks, BlockStored with periodic "
+        "BlockRemoved): native "
+        f"**{ed['native']['blocks_per_s']:,} blocks/s** vs python "
+        f"{ed['python']['blocks_per_s']:,} blocks/s "
+        f"(**{ed['speedup_x']}×**).",
+        "",
+        f"Acceptance (ROADMAP): warm adjusted score ≤ 10µs/request at "
+        f"batch 32 — **{d['native_32_per_request_us']} µs** "
+        f"({'met' if d['meets_10us_target'] else 'NOT met'}); arena "
+        f"digestion > 1M blocks/s — "
+        f"{'met' if ed['meets_1m_blocks_target'] else 'NOT met'}. "
+        "Bit-identity native vs Python is pinned per-trial in "
+        "`tests/test_native_core.py` (randomized tracker combos, fork "
+        "specs, adversarial digests) and `tests/test_score_many.py`; "
+        "`make native-asan` / `make native-tsan` run the suites under "
+        "AddressSanitizer and ThreadSanitizer. `make bench-native` "
+        "reruns these legs. Source: `MICRO_BENCH.json` (`native_core`).",
+    ]
+    return "\n".join(out)
+
+
 def obs_section() -> str:
     """Tracing-spine legs from MICRO_BENCH.json: per-stage attribution of
     the three planes + the enabled-tracing overhead on the warm read
@@ -1545,6 +1609,7 @@ def regenerate(text: str) -> str:
         ("device", device_section()),
         ("micro", micro_section()),
         ("batch", batch_section()),
+        ("native", native_section()),
         ("obs", obs_section()),
     ):
         pattern = re.compile(

@@ -36,6 +36,12 @@ Legs (all through public APIs):
   from-scratch derivation), whole-batch p50 and per-request amortized µs,
   plus the same 32 requests through sequential single calls for the
   batch-vs-loop speedup (acceptance: warm per-request < 50µs at 32)
+- native_core: the native scoring core's fused C crossing (lookup +
+  longest-prefix score + fleet-health/anti-entropy/routing adjustments in
+  one GIL-released call) vs the equivalent pure-Python pipeline at router
+  batch 32, plain and fully-adjusted, plus arena event digestion vs the
+  Python digest loop in blocks/s (acceptance: ≤10µs/request at 32,
+  >1M blocks/s)
 - obs_overhead: the tracing spine's tax on the warm read path — A/B/A
   (disabled/enabled/disabled) p50 over several trials, median overhead
   pct (acceptance: <5%), plus disabled-mode agreement with the untraced
@@ -51,7 +57,8 @@ The classic legs run with tracing DISABLED (obs/ ships enabled by
 default) so their numbers stay comparable with pre-obs rounds; the obs
 legs measure the enabled/disabled delta explicitly.
 
-Run: python benchmarking/micro_bench.py [--quick] [--legs all|read|obs|batch]
+Run: python benchmarking/micro_bench.py [--quick]
+     [--legs all|read|obs|batch|native]
 Writes MICRO_BENCH.json (full mode, all legs) and prints it.
 """
 
@@ -518,6 +525,258 @@ def score_many_legs(quick: bool) -> dict:
     return report
 
 
+def native_core_legs(quick: bool) -> dict:
+    """Native scoring core (kvcache/kvblock/native_index.py): the fused
+    lookup + longest-prefix score + per-pod adjustment crossing vs the
+    equivalent pure-Python pipeline (ShardedIndex.lookup ->
+    LongestPrefixScorer.score_plan -> fleet-health filter -> anti-entropy
+    factors -> routing divisors), on identically-populated indexes.
+
+    Two score legs at router batch 32 — `plain` (no trackers wired, the
+    lookup+score floor) and `adjusted` (fleet health + anti-entropy +
+    LOAD_BLEND routing all active, the full production read path) — plus
+    `event_digest`: BlockStored/BlockRemoved batches applied through the
+    arena's lock-free apply_batch vs the Python digest loop, in blocks/s.
+    Both backends score bit-identically (pinned by the differential-fuzz
+    suites); this leg records what the single crossing buys. Acceptance
+    (ISSUE 17): native ≤ 10µs/request at batch 32, arena digestion
+    > 1M blocks/s."""
+    from llm_d_kv_cache_manager_tpu.antientropy.tracker import (
+        AntiEntropyConfig,
+        AntiEntropyTracker,
+    )
+    from llm_d_kv_cache_manager_tpu.fleethealth.tracker import (
+        FleetHealthConfig,
+        FleetHealthTracker,
+    )
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.native_index import (
+        NativeIndexConfig,
+        NativeScoringIndex,
+        have_native_index,
+    )
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.sharded import (
+        ShardedIndex,
+        ShardedIndexConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+        ChunkedTokenDatabase,
+        TokenProcessorConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.kvcache.routing import (
+        LOAD_BLEND,
+        RoutingPolicy,
+        RoutingPolicyConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.kvcache.scorer import LongestPrefixScorer
+    from llm_d_kv_cache_manager_tpu.kvevents.events import (
+        BlockRemoved,
+        BlockStored,
+        EventBatch,
+    )
+    from llm_d_kv_cache_manager_tpu.kvevents.pool import (
+        EventPool,
+        EventPoolConfig,
+    )
+
+    if not have_native_index():
+        return {"available": False, "note": "run `make native` first"}
+
+    rng = random.Random(23)
+    weights = {"hbm": 1.0, "host": 0.8}
+    pods = [f"pod-{i}" for i in range(8)]
+    scorer = LongestPrefixScorer(weights)
+
+    # Identical content on both backends: 256 chains of 32 blocks, each
+    # block resident on 1-4 pods across two tiers.
+    nat = NativeScoringIndex(NativeIndexConfig(size=200_000))
+    sha = ShardedIndex(ShardedIndexConfig(size=200_000))
+    chains = []
+    for _ in range(256):
+        chain = [rng.getrandbits(64) for _ in range(32)]
+        chains.append(chain)
+        for h in chain:
+            req = [Key(MODEL, h)]
+            ents = [
+                PodEntry(p, rng.choice(("hbm", "host")))
+                for p in rng.sample(pods, rng.randint(1, 4))
+            ]
+            nat.add(req, req, ents)
+            sha.add(req, req, ents)
+
+    batch = 32
+    specs = []
+    for i in range(batch):
+        chain = rng.choice(chains)
+        keys = [Key(MODEL, h) for h in chain]
+        specs.append({"item": i, "keys": keys, "ref": None, "pods": ()})
+
+    def python_pipeline(index, fh=None, ae=None, rp=None):
+        plan = []
+        for spec in specs:
+            hits = index.lookup(spec["keys"], set(spec["pods"]))
+            plan.append(("solo", spec["keys"], hits, False))
+        out = []
+        for scores, match in scorer.score_plan(plan):
+            if fh is not None:
+                scores = fh.filter_scores(scores)
+            if ae is not None:
+                scores = ae.adjust_scores(scores)
+            if rp is not None:
+                scores = rp.adjust(scores)
+            out.append((scores, match))
+        return out
+
+    iters = 30 if quick else 300
+    report: dict = {
+        "available": True,
+        "batch": batch,
+        "chain_blocks": 32,
+        "pods": len(pods),
+    }
+
+    # Plain leg: lookup + longest-prefix score, no trackers.
+    leg: dict = {}
+    t = _timeit(lambda: nat.score_plan(specs, weights), iters)
+    t["per_request_us"] = round(t["p50_us"] / batch, 2)
+    leg["native"] = t
+    t = _timeit(lambda: python_pipeline(sha), iters)
+    t["per_request_us"] = round(t["p50_us"] / batch, 2)
+    leg["python"] = t
+    leg["speedup_x"] = round(
+        leg["python"]["p50_us"] / max(leg["native"]["p50_us"], 0.1), 2
+    )
+    report["score_plain"] = leg
+
+    # Adjusted leg: fleet health (one suspect pod demoted), anti-entropy
+    # (one inaccurate pod), LOAD_BLEND routing — the full production
+    # adjustment stack fused into the same crossing.
+    class _Clock:
+        def __init__(self):
+            self.t = 1000.0
+
+        def __call__(self):
+            return self.t
+
+    class _Load:
+        def load_of(self, pod, now=None):
+            class L:
+                queue_depth = 3
+                busy_s = 0.4
+                preemption_rate = 1.0
+
+            return L()
+
+    def mk_trackers():
+        clock = _Clock()
+        fh = FleetHealthTracker(
+            FleetHealthConfig(
+                suspect_after_s=10, stale_after_s=10**6,
+                suspect_demotion_factor=0.5, auto_quarantine=False,
+            ),
+            clock=clock,
+        )
+        for p in pods:
+            fh.observe_batch(p, "t", None, clock.t)
+        clock.t += 15  # everyone suspect…
+        for p in pods[1:]:
+            fh.observe_batch(p, "t", None, clock.t)  # …except pod-0
+        ae = AntiEntropyTracker(AntiEntropyConfig(), clock=clock)
+        ae.observe_audit("pod-1", verified=2, phantom=8)
+        rp = RoutingPolicy(
+            RoutingPolicyConfig(policy=LOAD_BLEND, load_weight=0.7),
+            load_tracker=_Load(),
+        )
+        return fh, ae, rp
+
+    leg = {}
+    fh, ae, rp = mk_trackers()
+    t = _timeit(
+        lambda: nat.score_plan(
+            specs, weights, fleet_health=fh, antientropy=ae,
+            routing_policy=rp,
+        ),
+        iters,
+    )
+    t["per_request_us"] = round(t["p50_us"] / batch, 2)
+    leg["native"] = t
+    fh, ae, rp = mk_trackers()
+    t = _timeit(lambda: python_pipeline(sha, fh, ae, rp), iters)
+    t["per_request_us"] = round(t["p50_us"] / batch, 2)
+    leg["python"] = t
+    leg["speedup_x"] = round(
+        leg["python"]["p50_us"] / max(leg["native"]["p50_us"], 0.1), 2
+    )
+    report["score_adjusted"] = leg
+
+    report["native_32_per_request_us"] = max(
+        report["score_plain"]["native"]["per_request_us"],
+        report["score_adjusted"]["native"]["per_request_us"],
+    )
+    report["meets_10us_target"] = report["native_32_per_request_us"] <= 10.0
+
+    # Event digestion: identical BlockStored/BlockRemoved batches through
+    # the pool's digest seam — the arena's single apply_batch crossing vs
+    # the per-event Python loop. chain_memo off on both (the native digest
+    # never warms the memo; see native_index.py's parity notes).
+    tp = ChunkedTokenDatabase(
+        TokenProcessorConfig(block_size=16, chain_memo=False)
+    )
+    n_batches = 50 if quick else 500
+    blocks_per_batch = 32
+    toks = [rng.randint(0, 50000) for _ in range(16 * blocks_per_batch)]
+    digest_leg: dict = {
+        "batches": n_batches,
+        "blocks_per_batch": blocks_per_batch,
+    }
+    # Half-run warmup: a production arena is long-lived, so the timed
+    # region measures the steady state with the bucket array + slab pages
+    # resident, not the one-time first-touch faults over the 16MB tables.
+    warmup = max(10, n_batches // 2)
+    for name, index in (
+        ("native", NativeScoringIndex(NativeIndexConfig(size=10**8))),
+        ("python", ShardedIndex(ShardedIndexConfig(size=10**8))),
+    ):
+        pool = EventPool(EventPoolConfig(), index, tp)
+        batches = []
+        for i in range(warmup + n_batches):
+            hashes = [
+                (i * blocks_per_batch + j + 1) for j in range(blocks_per_batch)
+            ]
+            events = [BlockStored(
+                block_hashes=hashes, parent_block_hash=None,
+                token_ids=toks, block_size=16,
+                medium="hbm" if i % 2 else None,
+            )]
+            if i % 8 == 7:  # removal churn rides along like production
+                events.append(BlockRemoved(block_hashes=hashes[:4]))
+            batches.append(EventBatch(ts=float(i), events=events))
+        # Warmup tranche pays the first-touch page faults on the bucket
+        # array + slabs (both backends) outside the timed region, same
+        # hygiene as _timeit's warmup.
+        for i, b in enumerate(batches[:warmup]):
+            pool._digest_events(f"pod-{i % 8}", MODEL, b)  # noqa: SLF001
+        gc.collect()
+        t0 = time.perf_counter()
+        for i, b in enumerate(batches[warmup:]):
+            pool._digest_events(f"pod-{i % 8}", MODEL, b)  # noqa: SLF001
+        dt = time.perf_counter() - t0
+        digest_leg[name] = {
+            "blocks_per_s": round(n_batches * blocks_per_batch / dt),
+            "wall_s": round(dt, 4),
+        }
+    digest_leg["speedup_x"] = round(
+        digest_leg["native"]["blocks_per_s"]
+        / max(1, digest_leg["python"]["blocks_per_s"]),
+        2,
+    )
+    digest_leg["meets_1m_blocks_target"] = (
+        digest_leg["native"]["blocks_per_s"] > 1_000_000
+    )
+    report["event_digest"] = digest_leg
+    return report
+
+
 def obs_legs(quick: bool) -> dict:
     """obs_overhead + stage_attribution (see module docstring).
 
@@ -912,11 +1171,13 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="CI smoke sizes")
     ap.add_argument(
-        "--legs", choices=["all", "read", "obs", "batch"], default="all",
+        "--legs", choices=["all", "read", "obs", "batch", "native"],
+        default="all",
         help="'read' runs only the read_path_replay legs (make bench-read); "
         "'obs' runs only the tracing overhead + stage-attribution legs "
         "(make bench-obs); 'batch' runs only the score_many legs "
-        "(make bench-batch)",
+        "(make bench-batch); 'native' runs only the native-scoring-core "
+        "legs (make bench-native)",
     )
     args = ap.parse_args()
     iters = 30 if args.quick else 300
@@ -952,6 +1213,22 @@ def main():
 
     if args.legs == "batch":
         report = {"score_many": score_many_legs(args.quick)}
+        print(json.dumps(report, indent=2))
+        return
+
+    if args.legs == "native":
+        report = {"native_core": native_core_legs(args.quick)}
+        # Full mode refreshes the native legs IN PLACE in the committed
+        # MICRO_BENCH.json (make bench-native), same contract as bench-obs.
+        out = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "MICRO_BENCH.json"
+        )
+        if not args.quick and os.path.exists(out):
+            with open(out) as f:
+                committed = json.load(f)
+            committed.update(report)
+            with open(out, "w") as f:
+                json.dump(committed, f, indent=2)
         print(json.dumps(report, indent=2))
         return
 
@@ -1130,6 +1407,9 @@ def main():
 
     # Batched read path (score_many) at router batch sizes.
     report["score_many"] = score_many_legs(args.quick)
+
+    # Native scoring core: fused C crossing vs the pure-Python pipeline.
+    report["native_core"] = native_core_legs(args.quick)
 
     # Tracing-spine legs: enabled-mode overhead + three-plane attribution.
     report.update(obs_legs(args.quick))

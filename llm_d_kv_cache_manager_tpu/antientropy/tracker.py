@@ -240,6 +240,32 @@ class AntiEntropyTracker:
             demoted[pod] = demoted[pod] * factor
         return scores if demoted is None else demoted
 
+    def score_factors(self, pod_identifiers):
+        """Per-pod demotion multipliers for the native scoring core.
+
+        Aligned with `pod_identifiers`; None when the tracker has no
+        divergence evidence at all (the zero-allocation unchanged-scores
+        path `adjust_scores` takes). ``None`` input entries (the
+        interner's id-0 sentinel) get the neutral 1.0. Same arithmetic as
+        `factor_for`, folded into one lock acquisition for the batch.
+        """
+        with self._mu:
+            if not self._pods:
+                return None
+            threshold = self.config.distrust_threshold
+            min_factor = self.config.min_factor
+            out = [1.0] * len(pod_identifiers)
+            for i, pod in enumerate(pod_identifiers):
+                if pod is None:
+                    continue
+                rec = self._pods.get(base_pod_identifier(pod))
+                if rec is None:
+                    continue
+                acc = rec.accuracy
+                if acc < threshold:
+                    out[i] = max(min_factor, acc / max(threshold, 1e-9))
+        return out
+
     # -- introspection -----------------------------------------------------
 
     def status(self) -> dict:

@@ -191,6 +191,15 @@ def config_from_env() -> dict:
         # Shared index backend (redis:// or valkey:// URL) for multi-replica
         # managers; empty -> in-memory index.
         "index_url": os.environ.get("INDEX_URL", ""),
+        # Native scoring core (kvcache/kvblock/native_index.py):
+        # NATIVE_SCORING=1 backs the in-memory index with the C arena —
+        # the whole read path (lookup + longest-prefix score + per-pod
+        # adjustments) and event digestion each run in one GIL-released
+        # crossing. Scores are bit-identical to the Python path (pinned
+        # by the differential-fuzz suites); requires `make native`, and
+        # silently degrades to the Python backend when the module isn't
+        # built. Ignored when INDEX_URL selects a shared backend.
+        "native_scoring": os.environ.get("NATIVE_SCORING", "0") == "1",
         # UDS tokenizer sidecar socket; empty -> local tokenization only.
         "uds_socket": os.environ.get("UDS_SOCKET", ""),
         # Fleet-health windows (fleethealth/tracker.py): event silence
@@ -500,6 +509,10 @@ class ScoringService:
             self.indexer = indexer
         else:
             index_config = IndexConfig.default()
+            # Native scoring core only applies to the in-memory backend;
+            # a shared-backend INDEX_URL wins (redis_config takes priority
+            # in the backend-selection order below).
+            index_config.native = bool(env.get("native_scoring", False))
             if env.get("index_url"):
                 from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import (
                     RedisIndexConfig,
@@ -1091,6 +1104,9 @@ class ScoringService:
             )
         except Exception as e:  # noqa: BLE001
             return web.json_response({"error": str(e)}, status=500)
+        # Which engine produced these scores (C arena vs pure Python) and
+        # the running fallback count — evidence for "why was this slow".
+        explain["native_core"] = self._native_core_section()
         return web.json_response(explain)
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
@@ -1201,7 +1217,33 @@ class ScoringService:
             # NEVER gates readiness — an actuating autopilot is relieving
             # a burn, not failing.
             "autopilot": self._autopilot_section(),
+            # Native scoring core: whether the C arena backs the read
+            # path, its occupancy (keys/bytes/epoch + digest counters),
+            # and how many batches fell back to the pure-Python path.
+            # Never gates readiness — the fallback path is bit-identical,
+            # just slower.
+            "native_core": self._native_core_section(),
         }
+
+    def _native_core_section(self) -> dict:
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.native_index import (
+            NativeScoringIndex,
+            fallback_total,
+            have_native_index,
+        )
+
+        inner = getattr(
+            self.indexer.kv_block_index, "inner", self.indexer.kv_block_index
+        )
+        if isinstance(inner, NativeScoringIndex):
+            section = inner.native_status()
+        else:
+            section = {
+                "enabled": False,
+                "module_available": have_native_index(),
+            }
+        section["fallbacks"] = fallback_total()
+        return section
 
     def _autopilot_section(self) -> Optional[dict]:
         if self.autopilot is None:

@@ -395,6 +395,42 @@ class FleetHealthTracker:
                     demoted[pod] = demoted[pod] * factor
         return scores if demoted is None else demoted
 
+    def score_factors(self, pod_identifiers) -> tuple:
+        """Per-pod demotion modes for the native scoring core.
+
+        Returns ``(modes, suspect_demotion_factor)`` where ``modes`` is a
+        bytes object aligned with `pod_identifiers` (0 healthy/unknown,
+        1 suspect, 2 stale), or ``(None, factor)`` when the tracker has
+        seen no pods (the all-healthy zero-overhead path). ``None``
+        entries in the input are skipped (the interner's id-0 sentinel).
+
+        Modes come from `_expected_state` WITHOUT advancing the state
+        machine: `refresh()` transitions land exactly on the expected
+        state, so the demote/drop decisions are identical to
+        `filter_scores` — but auto-quarantine purges must not run before
+        the caller's fused lookup+score crossing (the Python batch path
+        does every lookup before its first `filter_scores`). The caller
+        runs the real `refresh()` after the crossing.
+        """
+        factor = self.config.suspect_demotion_factor
+        now = self.clock()
+        with self._mu:
+            if not self._pods:
+                return None, factor
+            modes = bytearray(len(pod_identifiers))
+            for i, pod in enumerate(pod_identifiers):
+                if pod is None:
+                    continue
+                rec = self._pods.get(pod)
+                if rec is None:
+                    continue
+                expected = self._expected_state(rec, now)
+                if expected == STALE:
+                    modes[i] = 2
+                elif expected == SUSPECT:
+                    modes[i] = 1
+        return bytes(modes), factor
+
     # -- introspection -----------------------------------------------------
 
     def summary(self, now: Optional[float] = None) -> dict:

@@ -465,7 +465,7 @@ class Indexer:
                 lookup_reqs.append((block_keys, pod_set))
                 plan_specs.append({
                     "item": i, "keys": block_keys, "lookup": lookup_idx,
-                    "ref": None,
+                    "ref": None, "pods": pods,
                 })
             else:
                 ref_keys = plan_specs[ref_pos]["keys"]
@@ -482,10 +482,20 @@ class Indexer:
                 plan_specs.append({
                     "item": i, "keys": block_keys, "lookup": lookup_idx,
                     "ref": ref_pos, "shared": shared_blocks, "tail": tail,
+                    "pods": pods,
                 })
                 plan_specs[ref_pos]["forked"] = True
 
         if plan_specs:
+            native_out = self._native_score_plan(plan_specs)
+            if native_out is not None:
+                for spec, (scores, match_blocks) in zip(plan_specs, native_out):
+                    results[spec["item"]] = PodScores(
+                        scores=scores,
+                        match_blocks=match_blocks,
+                        block_hashes=[k.chunk_hash for k in spec["keys"]],
+                    )
+                return results
             with obs.stage("read.batch.lookup"):
                 lookup_many = getattr(self.kv_block_index, "lookup_many", None)
                 if lookup_many is not None:
@@ -526,6 +536,47 @@ class Indexer:
                         block_hashes=[k.chunk_hash for k in spec["keys"]],
                     )
         return results
+
+    def _native_score_plan(self, plan_specs):
+        """Fused read path: when the index is the native arena, run the
+        whole batch plan — lookup + longest-prefix score + fleet-health /
+        anti-entropy / routing adjustments — in one GIL-released crossing.
+
+        Returns the per-spec `(scores, match_blocks)` list, or None when
+        the backend isn't native (the ordinary Python path, not a
+        fallback) or the crossing failed (counted in
+        `kvcache_native_fallbacks_total`; the Python path recomputes the
+        batch from the same state, so degradation is invisible in the
+        scores)."""
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.native_index import (
+            NativeScoringIndex,
+            count_fallback,
+        )
+
+        inner = getattr(self.kv_block_index, "inner", self.kv_block_index)
+        if not isinstance(inner, NativeScoringIndex):
+            return None
+        medium_weights = getattr(self.scorer, "medium_weights", None)
+        if medium_weights is None:
+            count_fallback()  # custom scorer: parity not provable in C
+            return None
+        try:
+            with obs.stage("read.batch.native"):
+                return inner.score_plan(
+                    plan_specs,
+                    medium_weights,
+                    fleet_health=self.fleet_health,
+                    antientropy=self.antientropy,
+                    routing_policy=self.routing_policy,
+                )
+        except Exception as e:  # noqa: BLE001 - any native failure must
+            # degrade to the Python path, never the read path itself.
+            count_fallback()
+            logger.warning(
+                "native scoring crossing failed; batch fell back to the "
+                "Python path: %s", e,
+            )
+            return None
 
     def score_hashes(
         self,

@@ -178,6 +178,14 @@ class IndexConfig:
     sharded: bool = True
     num_shards: int = 16  # DEFAULT_NUM_SHARDS (sharded.py)
     recency_refresh_interval: int = 64  # DEFAULT_RECENCY_REFRESH (sharded.py)
+    # Native scoring core (kvblock/native_index.py): when the in-memory
+    # backend is selected, back the index with the C arena so the whole
+    # read path (lookup + score + per-pod adjustments) and event digestion
+    # run in single GIL-released crossings. Requires `make native`
+    # (_kvtpu_kvscore); silently degrades to the Python backend when the
+    # module isn't built. Scores are bit-identical either way (pinned by
+    # the differential-fuzz suites).
+    native: bool = False
 
     @classmethod
     def default(cls) -> "IndexConfig":
@@ -234,6 +242,21 @@ def _new_memory_index(config: IndexConfig, in_memory_config) -> Index:
         InMemoryIndex,
         InMemoryIndexConfig,
     )
+
+    if config.native:
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.native_index import (
+            NativeIndexConfig,
+            NativeScoringIndex,
+            have_native_index,
+        )
+
+        if have_native_index():
+            imc = in_memory_config or InMemoryIndexConfig()
+            return NativeScoringIndex(NativeIndexConfig(
+                size=imc.size, pod_cache_size=imc.pod_cache_size,
+            ))
+        # Not built (no `make native`): degrade to the Python backend —
+        # same scores, just without the fused crossings.
 
     if not config.sharded:
         return InMemoryIndex(in_memory_config)

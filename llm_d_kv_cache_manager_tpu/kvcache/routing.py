@@ -182,6 +182,46 @@ class RoutingPolicy:
             }
         return adjusted
 
+    def score_divisors(self, pod_identifiers):
+        """Per-pod score divisors for the native scoring core.
+
+        `adjust` computes ``score / (1 + load_weight * load_index)``; this
+        returns the denominators aligned with `pod_identifiers`, or None
+        when the blend is inert (prefix_only / no tracker / zero weight) —
+        the unchanged-scores identity path. One clock read for the whole
+        batch, like `adjust`'s one read per request. ``None`` input
+        entries (the interner's id-0 sentinel) get the neutral 1.0.
+        """
+        if (
+            self.is_noop
+            or self.load_tracker is None
+            or self.config.load_weight == 0.0
+        ):
+            return None
+        weight = self.config.load_weight
+        now = None
+        clock = getattr(self.load_tracker, "clock", None)
+        if clock is not None:
+            now = clock()
+        return [
+            1.0 if pod is None
+            else 1.0 + weight * self.load_index(pod, now=now)
+            for pod in pod_identifiers
+        ]
+
+    def note_adjusted(self, adjusted: int, overrides: int) -> None:
+        """Fold a native batch's blend accounting into `stats` — the same
+        counters `adjust` keeps per request, minus the per-override trace
+        log (the native path only knows the override happened, not the
+        pod names)."""
+        if adjusted <= 0:
+            return
+        with self._mu:
+            self.stats["adjusted_requests"] += adjusted
+            self.stats["overrides"] += overrides
+        for _ in range(overrides):
+            metrics.count_routing_override()
+
     def select(
         self,
         scores: Dict[str, float],

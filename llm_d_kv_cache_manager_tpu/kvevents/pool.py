@@ -525,6 +525,8 @@ class EventPool:
     def _digest_events(
         self, pod_identifier: str, model_name: str, batch: EventBatch
     ) -> None:
+        if self._native_digest(pod_identifier, model_name, batch):
+            return
         for event in batch.events:
             if isinstance(event, BlockStored):
                 self._digest_block_stored(pod_identifier, model_name, event)
@@ -536,6 +538,92 @@ class EventPool:
                 self._digest_block_removed(pod_identifier, model_name, event)
             elif isinstance(event, AllBlocksCleared):
                 continue  # engines emit per-block removals as well
+
+    def _native_digest(
+        self, pod_identifier: str, model_name: str, batch: EventBatch
+    ) -> bool:
+        """Apply the whole decoded batch against the native arena in one
+        GIL-released crossing (kvscore.c `apply_batch`), chain-deriving
+        request keys in C. Returns False when the batch must take the
+        pure-Python digest instead: non-native index backend, a subsystem
+        the arena doesn't model (popularity store-observes, divergence
+        orphan probes, a non-fnv64 hash chain), or a conversion error —
+        the latter counted in `kvcache_native_fallbacks_total`. The arena
+        is untouched on failure, so the Python path replays the batch to
+        the exact same final state.
+
+        Two Python-path behaviors intentionally don't ride along: the
+        chain memo isn't warmed by native digestion (a read-path perf
+        cache, not state), and per-event add/evict instrumentation on a
+        metrics-wrapped index is bypassed like the fused read path does.
+        """
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.native_index import (
+            NativeScoringIndex,
+            count_fallback,
+        )
+
+        inner = getattr(self.index, "inner", self.index)
+        if not isinstance(inner, NativeScoringIndex):
+            return False
+        if self.popularity is not None or self.divergence is not None:
+            return False
+        tp = self.token_processor
+        if tp.config.hash_algo != "fnv64_cbor":
+            return False
+
+        default_tier = self.config.default_device_tier
+        shaped: List[tuple] = []
+        removed_counts: List[int] = []
+        for event in batch.events:
+            if isinstance(event, BlockStored):
+                tier = (event.medium or default_tier).lower()
+                packed = inner.intern_entry(pod_identifier, tier)
+                lora_id = event.lora_id
+                if (
+                    not isinstance(lora_id, int)
+                    or isinstance(lora_id, bool)
+                    or lora_id < 0
+                ):
+                    if lora_id is not None:
+                        logger.debug(
+                            "ignoring invalid lora_id %r in BlockStored",
+                            lora_id,
+                        )
+                    lora_id = None
+                extra = (lora_id,) if lora_id is not None else None
+                shaped.append((
+                    1, event.block_hashes, event.parent_block_hash,
+                    event.token_ids, extra, packed,
+                ))
+            elif isinstance(event, BlockRemoved):
+                tier = (event.medium or default_tier).lower()
+                packed = inner.intern_entry(pod_identifier, tier)
+                shaped.append((0, event.block_hashes, packed))
+                removed_counts.append(
+                    len(event.block_hashes) if event.block_hashes else 0
+                )
+            elif isinstance(event, AllBlocksCleared):
+                continue  # engines emit per-block removals as well
+        try:
+            inner.apply_batch(
+                model_name, tp.init_hash, tp.block_size, shaped
+            )
+        except Exception as e:  # noqa: BLE001 - arena untouched: replay
+            # the batch through the Python digest for an identical result.
+            count_fallback()
+            logger.debug(
+                "native digest fell back to the Python path: %s", e
+            )
+            return False
+        # Load-tracker pre-pass AFTER the apply succeeded — running it
+        # during shaping would double-count if we then fell back.
+        if self.load_tracker is not None:
+            for n in removed_counts:
+                if n:
+                    self.load_tracker.observe_removed_blocks(
+                        pod_identifier, n
+                    )
+        return True
 
     def _digest_block_stored(
         self, pod_identifier: str, model_name: str, ev: BlockStored

@@ -112,6 +112,11 @@ admission_queued: Optional[Counter] = None
 routing_policy_overrides: Optional[Counter] = None
 membership_transitions: Optional[Counter] = None
 
+# Native scoring core (kvcache/kvblock/native_index.py): batches that fell
+# back from the fused C crossing to the pure-Python path (conversion error,
+# tracker without factor hooks, digest feature the arena doesn't model).
+native_fallbacks: Optional[Counter] = None
+
 # Hierarchical federation (federation/): requests routed per region and
 # the global tier's degradation/replication economics. The `region` label
 # takes values from the FIXED configured region set (FederationConfig /
@@ -197,6 +202,7 @@ def register_metrics(registry=None) -> None:
     global placement_skipped_unhealthy
     global admission_shed, admission_queued
     global routing_policy_overrides, membership_transitions
+    global native_fallbacks
     global federation_routes, federation_mispicks, federation_failovers
     global federation_transitions, federation_digest_bytes
     global federation_warmed_blocks, federation_digest_age
@@ -436,6 +442,12 @@ def register_metrics(registry=None) -> None:
             "kvcache_routing_policy_overrides_total",
             "Scoring calls where the load-blend routing policy changed "
             "the deterministic prefix argmax (kvcache/routing.py)",
+            registry=reg,
+        )
+        native_fallbacks = Counter(
+            "kvcache_native_fallbacks_total",
+            "Batches the native scoring core handed back to the "
+            "pure-Python path (kvcache/kvblock/native_index.py)",
             registry=reg,
         )
         membership_transitions = Counter(
@@ -747,6 +759,11 @@ def count_admission_queued() -> None:
 def count_routing_override() -> None:
     if routing_policy_overrides is not None:
         routing_policy_overrides.inc()
+
+
+def count_native_fallback() -> None:
+    if native_fallbacks is not None:
+        native_fallbacks.inc()
 
 
 def count_membership_transition(phase: str) -> None:

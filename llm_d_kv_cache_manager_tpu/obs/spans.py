@@ -69,7 +69,7 @@ SPAN_INVENTORY = frozenset({
     "read.prefix_store", "read.encode", "read.derive",
     "read.lookup", "read.score",
     "read.batch.tokenize", "read.batch.derive", "read.batch.lookup",
-    "read.batch.score",
+    "read.batch.score", "read.batch.native",
     # write plane (kvevents/pool.py)
     "write.digest", "write.queue_wait", "write.decode", "write.index_apply",
     # transfer plane (engine/tiering.py, kv_connectors/)

@@ -1,0 +1,139 @@
+/* Shared chained-CBOR+FNV-64a hashing helpers for the native modules.
+ *
+ * Extracted from fnvcbor.c so the scoring/index arena (kvscore.c) can derive
+ * request keys with the exact same byte layout and folding as the hash core.
+ * Everything here is static inline: each including translation unit gets its
+ * own copy, no cross-.so symbol coupling.
+ *
+ * The canonical form hashed per block is the CBOR array
+ *   [parent_u64, [token_u32...], extra|null]
+ * folded with FNV-64a from the standard offset basis — bit-identical to the
+ * pure-Python implementation in kvcache/kvblock/hashing.py (the test oracle).
+ */
+
+#ifndef KVTPU_KVHASH_H
+#define KVTPU_KVHASH_H
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <stddef.h>
+
+#define FNV64_OFFSET 0xcbf29ce484222325ULL
+#define FNV64_PRIME 0x100000001b3ULL
+
+static inline uint64_t kv_fnv1a64(const uint8_t *data, size_t n, uint64_t h) {
+    for (size_t i = 0; i < n; i++) {
+        h ^= (uint64_t)data[i];
+        h *= FNV64_PRIME;
+    }
+    return h;
+}
+
+/* Shortest-form CBOR head (RFC 8949 canonical). Returns bytes written. */
+static inline size_t kv_cbor_head(uint8_t *out, uint8_t major, uint64_t value) {
+    uint8_t mt = (uint8_t)(major << 5);
+    if (value < 24) {
+        out[0] = mt | (uint8_t)value;
+        return 1;
+    } else if (value <= 0xff) {
+        out[0] = mt | 24;
+        out[1] = (uint8_t)value;
+        return 2;
+    } else if (value <= 0xffff) {
+        out[0] = mt | 25;
+        out[1] = (uint8_t)(value >> 8);
+        out[2] = (uint8_t)value;
+        return 3;
+    } else if (value <= 0xffffffffULL) {
+        out[0] = mt | 26;
+        out[1] = (uint8_t)(value >> 24);
+        out[2] = (uint8_t)(value >> 16);
+        out[3] = (uint8_t)(value >> 8);
+        out[4] = (uint8_t)value;
+        return 5;
+    }
+    out[0] = mt | 27;
+    for (int i = 0; i < 8; i++) out[1 + i] = (uint8_t)(value >> (56 - 8 * i));
+    return 9;
+}
+
+/* One chain link over a pre-converted block: FNV-64a of the canonical CBOR
+ * [parent, [tokens...], extra|null]. `buf` must hold the worst case:
+ * 20 + 9*n_toks + 9*(n_extra+1) bytes. */
+static inline uint64_t kv_hash_block(uint8_t *buf, uint64_t parent,
+                                     const uint64_t *toks, Py_ssize_t n_toks,
+                                     const uint64_t *extra, Py_ssize_t n_extra) {
+    size_t pos = 0;
+    buf[pos++] = 0x83; /* array(3) */
+    pos += kv_cbor_head(buf + pos, 0, parent);
+    pos += kv_cbor_head(buf + pos, 4, (uint64_t)n_toks);
+    for (Py_ssize_t i = 0; i < n_toks; i++)
+        pos += kv_cbor_head(buf + pos, 0, toks[i]);
+    if (extra == NULL) {
+        buf[pos++] = 0xf6; /* null */
+    } else {
+        pos += kv_cbor_head(buf + pos, 4, (uint64_t)n_extra);
+        for (Py_ssize_t i = 0; i < n_extra; i++)
+            pos += kv_cbor_head(buf + pos, 0, extra[i]);
+    }
+    return kv_fnv1a64(buf, pos, FNV64_OFFSET);
+}
+
+/* Token -> uint64, accepting anything with __index__ (plain ints, numpy and
+ * jax integer scalars) so callers never pay a Python-side [int(t) ...] copy.
+ * Returns -1 with an exception set on failure. */
+static inline int kv_as_u64(PyObject *o, uint64_t *out) {
+    unsigned long long v = PyLong_AsUnsignedLongLong(o);
+    if (v == (unsigned long long)-1 && PyErr_Occurred()) {
+        if (!PyErr_ExceptionMatches(PyExc_TypeError)) return -1;
+        PyErr_Clear();
+        PyObject *ix = PyNumber_Index(o);
+        if (!ix) return -1;
+        v = PyLong_AsUnsignedLongLong(ix);
+        Py_DECREF(ix);
+        if (v == (unsigned long long)-1 && PyErr_Occurred()) return -1;
+    }
+    *out = v;
+    return 0;
+}
+
+/* Convert a Python sequence of token-likes into a fresh uint64_t array.
+ * On success *out_n holds the element count; caller PyMem_Free()s. */
+static inline uint64_t *kv_tokens_to_array(PyObject *tokens_obj,
+                                           Py_ssize_t *out_n) {
+    PyObject *seq = PySequence_Fast(tokens_obj, "tokens must be a sequence");
+    if (!seq) return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    uint64_t *arr = (uint64_t *)PyMem_Malloc(n ? n * sizeof(uint64_t) : 1);
+    if (!arr) {
+        Py_DECREF(seq);
+        PyErr_NoMemory();
+        return NULL;
+    }
+    PyObject **items = PySequence_Fast_ITEMS(seq);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        if (kv_as_u64(items[i], &arr[i]) < 0) {
+            PyMem_Free(arr);
+            Py_DECREF(seq);
+            return NULL;
+        }
+    }
+    Py_DECREF(seq);
+    *out_n = n;
+    return arr;
+}
+
+/* Optional extra-key tuple (e.g. [lora_id]): NULL-able uint64 array. */
+static inline int kv_extra_to_array(PyObject *extra_obj, uint64_t **out,
+                                    Py_ssize_t *out_n) {
+    if (extra_obj == NULL || extra_obj == Py_None) {
+        *out = NULL;
+        *out_n = 0;
+        return 0;
+    }
+    *out = kv_tokens_to_array(extra_obj, out_n);
+    return *out ? 0 : -1;
+}
+
+#endif /* KVTPU_KVHASH_H */

@@ -1,0 +1,1841 @@
+/* kvscore.c — native GIL-free index arena + fused batch scorer.
+ *
+ * Second native module beside fnvcbor.c: a C arena holding the sharded
+ * index's published read view (per-key pod-entry slots keyed by
+ * (model_id, chunk_hash)), with the whole router read path — lookup +
+ * longest-prefix score + per-pod scalar adjustments (fleet-health
+ * demotion, anti-entropy accuracy factors, routing load demotion) —
+ * fused into ONE GIL-released crossing (`score_batch`), and event
+ * digestion (`apply_batch`) applying decoded BlockStored/BlockRemoved
+ * batches against the same arena while readers stay lock-free.
+ *
+ * Concurrency design (mirrors sharded.py's GIL-atomic published-view
+ * trick, in C):
+ *
+ * - One writer mutex serializes all mutation (add/evict/remove/apply).
+ *   Writers NEVER touch the Python C-API while holding it, and release
+ *   the GIL before taking it, so a digest thread can apply events while
+ *   router threads score.
+ * - Readers never lock. Each key node carries a seqlock (Boehm pattern:
+ *   odd version = write in progress); a reader copies the entry slots,
+ *   then revalidates the version. Structural changes (node unlink /
+ *   free / reuse) bump a global epoch BEFORE the structure changes, so
+ *   a chain walk that ends in a miss is only trusted if the epoch is
+ *   unchanged across the walk. Torn reads retry a bounded number of
+ *   times, then fall back to taking the writer mutex (counted in
+ *   stats() as `locked_lookups`).
+ * - Nodes live in type-stable slabs that are never freed while the
+ *   arena lives: a stale reader can always dereference a node pointer;
+ *   the seqlock + epoch protocol rejects whatever it reads there.
+ *
+ * The Python-facing surface speaks ONLY integer ids: the wrapper
+ * (kvcache/kvblock/native_index.py) interns pod/tier/model strings to
+ * small ints and owns every string comparison (pod_matches, filters),
+ * pushing them down as bitmaps and factor tables. Entry slots pack
+ * (pod_id << 16) | tier_id into one atomic uint64 (0 = empty slot);
+ * slot order is the per-key LRU's oldest-first published order,
+ * exactly what `LRUCache.keys()` yields in the Python backends.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <pthread.h>
+#include <stdatomic.h>
+#include <stdint.h>
+#include <string.h>
+
+#include "kvhash.h"
+
+#define KVS_SLAB_NODES 1024
+#define KVS_MAX_WALK 65536      /* chain-walk bound before declaring torn */
+#define KVS_FIND_RETRIES 64     /* lock-free retries before mutex fallback */
+
+/* ---------------------------------------------------------------------- */
+/* Node types                                                             */
+/* ---------------------------------------------------------------------- */
+
+/* Request-key node: seqlock-protected so readers can snapshot the entry
+ * slots without the writer mutex. `entries[i]` packs (pod_id<<16)|tier_id,
+ * 0 = empty; live slots are entries[0..n_entries) in oldest-first order. */
+typedef struct KeyNode {
+    _Atomic uint64_t version;        /* seqlock; odd = write in progress */
+    _Atomic uint64_t hash;
+    _Atomic uint32_t model_id;
+    _Atomic uint32_t n_entries;
+    _Atomic(struct KeyNode *) next;  /* bucket chain (readers walk this) */
+    /* Writer-only fields (mutex-protected): */
+    struct KeyNode *free_next;
+    struct KeyNode *lru_prev, *lru_next; /* recency list, head = oldest */
+    size_t bucket;
+    _Atomic uint64_t entries[];      /* cap slots */
+} KeyNode;
+
+/* Engine-key → request-key mapping. Only ever touched under the writer
+ * mutex (even "reads" move recency, mirroring LRUCache.get), so no
+ * atomics needed. */
+typedef struct EngNode {
+    uint64_t hash;
+    uint32_t model_id;
+    uint32_t req_model;
+    uint64_t req_hash;
+    struct EngNode *next;            /* bucket chain */
+    struct EngNode *free_next;
+    struct EngNode *lru_prev, *lru_next;
+    size_t bucket;
+} EngNode;
+
+typedef struct {
+    PyObject_HEAD
+    pthread_mutex_t mu;
+
+    uint32_t cap;                    /* pods_per_key: entry slots per node */
+    Py_ssize_t max_keys;             /* capacity of key map AND engine map */
+    size_t key_stride;               /* slab stride for KeyNode + slots */
+
+    /* Request-key map */
+    size_t n_buckets, mask;
+    _Atomic(KeyNode *) *buckets;
+    KeyNode *key_lru_head, *key_lru_tail;
+    Py_ssize_t n_keys;
+    KeyNode *key_free;
+
+    /* Engine map */
+    EngNode **e_buckets;             /* same n_buckets/mask */
+    EngNode *eng_lru_head, *eng_lru_tail;
+    Py_ssize_t n_eng;
+    EngNode *eng_free;
+
+    /* Type-stable slabs (never freed while the arena lives) */
+    void **slabs;
+    size_t n_slabs, slabs_cap;
+    size_t bytes_allocated;
+
+    _Atomic uint64_t epoch;          /* bumped BEFORE structural changes */
+    uint64_t locked_lookups;         /* bounded-retry mutex fallbacks */
+    uint64_t total_adds;             /* entry-slot insertions */
+    uint64_t total_evictions;        /* capacity evictions of key nodes */
+    uint64_t blocks_applied;         /* apply_batch blocks processed */
+} ArenaObject;
+
+/* ---------------------------------------------------------------------- */
+/* Allocation                                                             */
+/* ---------------------------------------------------------------------- */
+
+static void *arena_slab(ArenaObject *a, size_t sz) {
+    if (a->n_slabs == a->slabs_cap) {
+        size_t ncap = a->slabs_cap ? a->slabs_cap * 2 : 16;
+        void **ns = (void **)realloc(a->slabs, ncap * sizeof(void *));
+        if (!ns) return NULL;
+        a->slabs = ns;
+        a->slabs_cap = ncap;
+    }
+    void *p = calloc(1, sz);
+    if (!p) return NULL;
+    a->slabs[a->n_slabs++] = p;
+    a->bytes_allocated += sz;
+    return p;
+}
+
+/* Writer mutex held. */
+static KeyNode *key_node_alloc(ArenaObject *a) {
+    if (a->key_free) {
+        KeyNode *n = a->key_free;
+        a->key_free = n->free_next;
+        return n;
+    }
+    char *slab = (char *)arena_slab(a, KVS_SLAB_NODES * a->key_stride);
+    if (!slab) return NULL;
+    for (size_t i = 1; i < KVS_SLAB_NODES; i++) {
+        KeyNode *n = (KeyNode *)(slab + i * a->key_stride);
+        n->free_next = a->key_free;
+        a->key_free = n;
+    }
+    return (KeyNode *)slab;
+}
+
+static EngNode *eng_node_alloc(ArenaObject *a) {
+    if (a->eng_free) {
+        EngNode *n = a->eng_free;
+        a->eng_free = n->free_next;
+        return n;
+    }
+    EngNode *slab = (EngNode *)arena_slab(a, KVS_SLAB_NODES * sizeof(EngNode));
+    if (!slab) return NULL;
+    for (size_t i = 1; i < KVS_SLAB_NODES; i++) {
+        slab[i].free_next = a->eng_free;
+        a->eng_free = &slab[i];
+    }
+    return &slab[0];
+}
+
+/* ---------------------------------------------------------------------- */
+/* Hashing / buckets                                                      */
+/* ---------------------------------------------------------------------- */
+
+static inline size_t bucket_of(const ArenaObject *a, uint32_t model,
+                               uint64_t hash) {
+    uint64_t x = hash ^ ((uint64_t)model * 0x9E3779B97F4A7C15ULL);
+    x ^= x >> 33;
+    x *= 0xFF51AFD7ED558CCDULL;
+    x ^= x >> 33;
+    return (size_t)(x & a->mask);
+}
+
+/* ---------------------------------------------------------------------- */
+/* Seqlock (Boehm pattern)                                                */
+/* ---------------------------------------------------------------------- */
+
+static inline void node_write_begin(KeyNode *n) {
+    uint64_t v = atomic_load_explicit(&n->version, memory_order_relaxed);
+    atomic_store_explicit(&n->version, v + 1, memory_order_relaxed);
+    atomic_thread_fence(memory_order_release);
+}
+
+static inline void node_write_end(KeyNode *n) {
+    uint64_t v = atomic_load_explicit(&n->version, memory_order_relaxed);
+    atomic_store_explicit(&n->version, v + 1, memory_order_release);
+}
+
+/* Snapshot a node's identity + entry slots. Returns:
+ *   >= 0  consistent snapshot, hash/model matched; value = entry count
+ *   -1    torn read (writer active / version moved): retry the walk
+ *   -2    consistent snapshot but identity mismatch: not our key, walk on
+ */
+static inline int node_read(const KeyNode *n, uint32_t want_model,
+                            uint64_t want_hash, uint64_t *out, uint32_t cap) {
+    uint64_t v1 = atomic_load_explicit(&n->version, memory_order_acquire);
+    if (v1 & 1) return -1;
+    uint64_t h = atomic_load_explicit(&n->hash, memory_order_relaxed);
+    uint32_t m = atomic_load_explicit(&n->model_id, memory_order_relaxed);
+    uint32_t ne = atomic_load_explicit(&n->n_entries, memory_order_relaxed);
+    if (ne > cap) ne = cap;
+    for (uint32_t i = 0; i < ne; i++)
+        out[i] = atomic_load_explicit(&n->entries[i], memory_order_relaxed);
+    atomic_thread_fence(memory_order_acquire);
+    uint64_t v2 = atomic_load_explicit(&n->version, memory_order_relaxed);
+    if (v1 != v2) return -1;
+    if (h != want_hash || m != want_model) return -2;
+    return (int)ne;
+}
+
+/* Lock-free point lookup. Returns:
+ *   1  hit: entries copied into out[], *n_out set
+ *   0  definite miss (epoch stable across the walk)
+ *  -1  unstable (torn node / epoch moved / walk bound hit): caller retries
+ */
+static int arena_find_lockfree(ArenaObject *a, uint32_t model, uint64_t hash,
+                               uint64_t *out, int *n_out) {
+    uint64_t e1 = atomic_load_explicit(&a->epoch, memory_order_acquire);
+    KeyNode *n = atomic_load_explicit(&a->buckets[bucket_of(a, model, hash)],
+                                      memory_order_acquire);
+    int steps = 0;
+    while (n) {
+        if (++steps > KVS_MAX_WALK) return -1;
+        int r = node_read(n, model, hash, out, a->cap);
+        if (r >= 0) {
+            *n_out = r;
+            return 1;
+        }
+        if (r == -1) return -1;
+        n = atomic_load_explicit(&n->next, memory_order_acquire);
+    }
+    atomic_thread_fence(memory_order_acquire);
+    if (atomic_load_explicit(&a->epoch, memory_order_relaxed) != e1) return -1;
+    *n_out = 0;
+    return 0;
+}
+
+/* Writer-side (mutex held) exact find; no seqlock dance needed. */
+static KeyNode *key_find_locked(ArenaObject *a, uint32_t model, uint64_t hash) {
+    KeyNode *n = atomic_load_explicit(&a->buckets[bucket_of(a, model, hash)],
+                                      memory_order_relaxed);
+    while (n) {
+        if (atomic_load_explicit(&n->hash, memory_order_relaxed) == hash &&
+            atomic_load_explicit(&n->model_id, memory_order_relaxed) == model)
+            return n;
+        n = atomic_load_explicit(&n->next, memory_order_relaxed);
+    }
+    return NULL;
+}
+
+/* Point lookup with bounded lock-free retries, then mutex fallback.
+ * Call WITHOUT the mutex held (and, on hot paths, without the GIL). */
+static int arena_find(ArenaObject *a, uint32_t model, uint64_t hash,
+                      uint64_t *out) {
+    int n_out = 0;
+    for (int attempt = 0; attempt < KVS_FIND_RETRIES; attempt++) {
+        int r = arena_find_lockfree(a, model, hash, out, &n_out);
+        if (r == 1) return n_out;
+        if (r == 0) return 0;
+    }
+    pthread_mutex_lock(&a->mu);
+    a->locked_lookups++;
+    KeyNode *n = key_find_locked(a, model, hash);
+    n_out = 0;
+    if (n) {
+        uint32_t ne = atomic_load_explicit(&n->n_entries, memory_order_relaxed);
+        if (ne > a->cap) ne = a->cap;
+        for (uint32_t i = 0; i < ne; i++)
+            out[i] = atomic_load_explicit(&n->entries[i], memory_order_relaxed);
+        n_out = (int)ne;
+    }
+    pthread_mutex_unlock(&a->mu);
+    return n_out;
+}
+
+/* ---------------------------------------------------------------------- */
+/* Writer primitives (mutex held throughout; no Python API)               */
+/* ---------------------------------------------------------------------- */
+
+static void key_lru_unlink(ArenaObject *a, KeyNode *n) {
+    if (n->lru_prev) n->lru_prev->lru_next = n->lru_next;
+    else a->key_lru_head = n->lru_next;
+    if (n->lru_next) n->lru_next->lru_prev = n->lru_prev;
+    else a->key_lru_tail = n->lru_prev;
+    n->lru_prev = n->lru_next = NULL;
+}
+
+static void key_lru_push_tail(ArenaObject *a, KeyNode *n) {
+    n->lru_prev = a->key_lru_tail;
+    n->lru_next = NULL;
+    if (a->key_lru_tail) a->key_lru_tail->lru_next = n;
+    else a->key_lru_head = n;
+    a->key_lru_tail = n;
+}
+
+static void key_lru_touch(ArenaObject *a, KeyNode *n) {
+    if (a->key_lru_tail == n) return;
+    key_lru_unlink(a, n);
+    key_lru_push_tail(a, n);
+}
+
+/* Unlink a key node from its bucket chain + LRU and put it on the free
+ * list, wiped so stale readers see a non-matching identity. The epoch is
+ * bumped BEFORE any structural store so a concurrent lock-free miss that
+ * raced this unlink gets invalidated and retried. */
+static void key_node_remove(ArenaObject *a, KeyNode *victim) {
+    atomic_fetch_add_explicit(&a->epoch, 1, memory_order_seq_cst);
+    /* Unlink from bucket chain (release stores: readers chase `next`). */
+    _Atomic(KeyNode *) *slot = &a->buckets[victim->bucket];
+    KeyNode *cur = atomic_load_explicit(slot, memory_order_relaxed);
+    if (cur == victim) {
+        atomic_store_explicit(
+            slot, atomic_load_explicit(&victim->next, memory_order_relaxed),
+            memory_order_release);
+    } else {
+        while (cur) {
+            KeyNode *nxt = atomic_load_explicit(&cur->next,
+                                                memory_order_relaxed);
+            if (nxt == victim) {
+                atomic_store_explicit(
+                    &cur->next,
+                    atomic_load_explicit(&victim->next, memory_order_relaxed),
+                    memory_order_release);
+                break;
+            }
+            cur = nxt;
+        }
+    }
+    key_lru_unlink(a, victim);
+    /* Wipe identity under the seqlock so a reader mid-snapshot rejects. */
+    node_write_begin(victim);
+    atomic_store_explicit(&victim->hash, 0, memory_order_relaxed);
+    atomic_store_explicit(&victim->model_id, 0, memory_order_relaxed);
+    atomic_store_explicit(&victim->n_entries, 0, memory_order_relaxed);
+    node_write_end(victim);
+    atomic_store_explicit(&victim->next, NULL, memory_order_relaxed);
+    victim->free_next = a->key_free;
+    a->key_free = victim;
+    a->n_keys--;
+}
+
+/* Find-or-create + recency touch (mirrors LRUCache.add for the key map:
+ * present -> move to end; absent -> append, evicting the oldest at
+ * capacity — capacity eviction does NOT sweep the engine map, exactly
+ * like the Python backends). Returns NULL only on allocation failure. */
+static KeyNode *key_get_or_create(ArenaObject *a, uint32_t model,
+                                  uint64_t hash, int *created) {
+    KeyNode *n = key_find_locked(a, model, hash);
+    if (n) {
+        key_lru_touch(a, n);
+        if (created) *created = 0;
+        return n;
+    }
+    if (a->n_keys >= a->max_keys && a->key_lru_head) {
+        key_node_remove(a, a->key_lru_head);
+        a->total_evictions++;
+    }
+    n = key_node_alloc(a);
+    if (!n) return NULL;
+    /* Reuse of a node a stale reader may still point at: bump the epoch
+     * BEFORE re-initializing so any walk through the old linkage retries. */
+    atomic_fetch_add_explicit(&a->epoch, 1, memory_order_seq_cst);
+    node_write_begin(n);
+    atomic_store_explicit(&n->hash, hash, memory_order_relaxed);
+    atomic_store_explicit(&n->model_id, model, memory_order_relaxed);
+    atomic_store_explicit(&n->n_entries, 0, memory_order_relaxed);
+    for (uint32_t i = 0; i < a->cap; i++)
+        atomic_store_explicit(&n->entries[i], 0, memory_order_relaxed);
+    node_write_end(n);
+    size_t b = bucket_of(a, model, hash);
+    n->bucket = b;
+    atomic_store_explicit(
+        &n->next, atomic_load_explicit(&a->buckets[b], memory_order_relaxed),
+        memory_order_relaxed);
+    atomic_store_explicit(&a->buckets[b], n, memory_order_release);
+    key_lru_push_tail(a, n);
+    a->n_keys++;
+    if (created) *created = 1;
+    return n;
+}
+
+/* Per-key entry-slot add with LRUCache.add semantics over the packed
+ * slots: present -> move to the end (shift the tail down); absent ->
+ * append, dropping slot 0 (the oldest) at capacity. One seqlock write
+ * section per call. */
+static void node_entry_add(ArenaObject *a, KeyNode *n, uint64_t packed) {
+    uint32_t ne = atomic_load_explicit(&n->n_entries, memory_order_relaxed);
+    uint32_t i;
+    for (i = 0; i < ne; i++) {
+        if (atomic_load_explicit(&n->entries[i], memory_order_relaxed) ==
+            packed)
+            break;
+    }
+    node_write_begin(n);
+    if (i < ne) {
+        /* Move to end: shift everything after i down one slot. */
+        for (uint32_t j = i; j + 1 < ne; j++)
+            atomic_store_explicit(
+                &n->entries[j],
+                atomic_load_explicit(&n->entries[j + 1], memory_order_relaxed),
+                memory_order_relaxed);
+        atomic_store_explicit(&n->entries[ne - 1], packed,
+                              memory_order_relaxed);
+    } else if (ne < a->cap) {
+        atomic_store_explicit(&n->entries[ne], packed, memory_order_relaxed);
+        atomic_store_explicit(&n->n_entries, ne + 1, memory_order_relaxed);
+    } else {
+        /* At capacity: drop the oldest (slot 0), append at the end. */
+        for (uint32_t j = 0; j + 1 < ne; j++)
+            atomic_store_explicit(
+                &n->entries[j],
+                atomic_load_explicit(&n->entries[j + 1], memory_order_relaxed),
+                memory_order_relaxed);
+        atomic_store_explicit(&n->entries[ne - 1], packed,
+                              memory_order_relaxed);
+    }
+    node_write_end(n);
+    a->total_adds++;
+}
+
+/* Remove one exact packed entry. Returns 1 if removed. */
+static int node_entry_remove(ArenaObject *a, KeyNode *n, uint64_t packed) {
+    uint32_t ne = atomic_load_explicit(&n->n_entries, memory_order_relaxed);
+    for (uint32_t i = 0; i < ne; i++) {
+        if (atomic_load_explicit(&n->entries[i], memory_order_relaxed) !=
+            packed)
+            continue;
+        node_write_begin(n);
+        for (uint32_t j = i; j + 1 < ne; j++)
+            atomic_store_explicit(
+                &n->entries[j],
+                atomic_load_explicit(&n->entries[j + 1], memory_order_relaxed),
+                memory_order_relaxed);
+        atomic_store_explicit(&n->entries[ne - 1], 0, memory_order_relaxed);
+        atomic_store_explicit(&n->n_entries, ne - 1, memory_order_relaxed);
+        node_write_end(n);
+        return 1;
+    }
+    return 0;
+}
+
+/* -- engine map (writer mutex held; plain memory) ----------------------- */
+
+static EngNode *eng_find(ArenaObject *a, uint32_t model, uint64_t hash) {
+    EngNode *n = a->e_buckets[bucket_of(a, model, hash)];
+    while (n) {
+        if (n->hash == hash && n->model_id == model) return n;
+        n = n->next;
+    }
+    return NULL;
+}
+
+static void eng_lru_unlink(ArenaObject *a, EngNode *n) {
+    if (n->lru_prev) n->lru_prev->lru_next = n->lru_next;
+    else a->eng_lru_head = n->lru_next;
+    if (n->lru_next) n->lru_next->lru_prev = n->lru_prev;
+    else a->eng_lru_tail = n->lru_prev;
+    n->lru_prev = n->lru_next = NULL;
+}
+
+static void eng_lru_push_tail(ArenaObject *a, EngNode *n) {
+    n->lru_prev = a->eng_lru_tail;
+    n->lru_next = NULL;
+    if (a->eng_lru_tail) a->eng_lru_tail->lru_next = n;
+    else a->eng_lru_head = n;
+    a->eng_lru_tail = n;
+}
+
+static void eng_remove(ArenaObject *a, EngNode *victim) {
+    EngNode **slot = &a->e_buckets[victim->bucket];
+    while (*slot && *slot != victim) slot = &(*slot)->next;
+    if (*slot) *slot = victim->next;
+    eng_lru_unlink(a, victim);
+    victim->next = NULL;
+    victim->free_next = a->eng_free;
+    a->eng_free = victim;
+    a->n_eng--;
+}
+
+/* LRUCache.add semantics: present -> touch + replace value; absent ->
+ * append, evicting the oldest mapping at capacity. Returns 0 on alloc
+ * failure (mapping silently dropped — matches a full LRU more than an
+ * error, and the Python fallback path still exists). */
+static int eng_add(ArenaObject *a, uint32_t model, uint64_t hash,
+                   uint32_t req_model, uint64_t req_hash) {
+    EngNode *n = eng_find(a, model, hash);
+    if (n) {
+        n->req_model = req_model;
+        n->req_hash = req_hash;
+        eng_lru_unlink(a, n);
+        eng_lru_push_tail(a, n);
+        return 1;
+    }
+    if (a->n_eng >= a->max_keys && a->eng_lru_head)
+        eng_remove(a, a->eng_lru_head);
+    n = eng_node_alloc(a);
+    if (!n) return 0;
+    n->hash = hash;
+    n->model_id = model;
+    n->req_model = req_model;
+    n->req_hash = req_hash;
+    size_t b = bucket_of(a, model, hash);
+    n->bucket = b;
+    n->next = a->e_buckets[b];
+    a->e_buckets[b] = n;
+    eng_lru_push_tail(a, n);
+    a->n_eng++;
+    return 1;
+}
+
+/* LRUCache.get semantics: hit touches recency. */
+static EngNode *eng_get(ArenaObject *a, uint32_t model, uint64_t hash) {
+    EngNode *n = eng_find(a, model, hash);
+    if (n) {
+        eng_lru_unlink(a, n);
+        eng_lru_push_tail(a, n);
+    }
+    return n;
+}
+
+/* ---------------------------------------------------------------------- */
+/* Argument conversion helpers (GIL held)                                 */
+/* ---------------------------------------------------------------------- */
+
+/* Sequence of (model_id, hash) pairs -> parallel C arrays. */
+static int parse_pairs(PyObject *obj, uint32_t **models, uint64_t **hashes,
+                       Py_ssize_t *n) {
+    PyObject *seq = PySequence_Fast(obj, "expected a sequence of key pairs");
+    if (!seq) return -1;
+    Py_ssize_t len = PySequence_Fast_GET_SIZE(seq);
+    uint32_t *ms = (uint32_t *)PyMem_Malloc(len ? len * sizeof(uint32_t) : 1);
+    uint64_t *hs = (uint64_t *)PyMem_Malloc(len ? len * sizeof(uint64_t) : 1);
+    if (!ms || !hs) {
+        PyMem_Free(ms);
+        PyMem_Free(hs);
+        Py_DECREF(seq);
+        PyErr_NoMemory();
+        return -1;
+    }
+    PyObject **items = PySequence_Fast_ITEMS(seq);
+    for (Py_ssize_t i = 0; i < len; i++) {
+        PyObject *pair = items[i];
+        if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) != 2) {
+            PyErr_SetString(PyExc_TypeError,
+                            "key pair must be a (model_id, hash) tuple");
+            goto fail;
+        }
+        uint64_t m, h;
+        if (kv_as_u64(PyTuple_GET_ITEM(pair, 0), &m) < 0) goto fail;
+        if (kv_as_u64(PyTuple_GET_ITEM(pair, 1), &h) < 0) goto fail;
+        ms[i] = (uint32_t)m;
+        hs[i] = h;
+    }
+    Py_DECREF(seq);
+    *models = ms;
+    *hashes = hs;
+    *n = len;
+    return 0;
+fail:
+    PyMem_Free(ms);
+    PyMem_Free(hs);
+    Py_DECREF(seq);
+    return -1;
+}
+
+/* Sequence of packed entry ints -> uint64 array. */
+static int parse_packed(PyObject *obj, uint64_t **out, Py_ssize_t *n) {
+    return (*out = kv_tokens_to_array(obj, n)) ? 0 : -1;
+}
+
+/* Optional bytes-like bitmap: borrowed pointer + length (no copy; caller
+ * must keep `obj` alive across use). Py_None -> NULL. */
+static int parse_bitmap(PyObject *obj, const uint8_t **buf, Py_ssize_t *len) {
+    if (obj == NULL || obj == Py_None) {
+        *buf = NULL;
+        *len = 0;
+        return 0;
+    }
+    char *b;
+    Py_ssize_t l;
+    if (PyBytes_AsStringAndSize(obj, &b, &l) < 0) return -1;
+    *buf = (const uint8_t *)b;
+    *len = l;
+    return 0;
+}
+
+static inline int bitmap_test(const uint8_t *buf, Py_ssize_t len, uint32_t id) {
+    Py_ssize_t byte = (Py_ssize_t)(id >> 3);
+    if (byte >= len) return 0;
+    return (buf[byte] >> (id & 7)) & 1;
+}
+
+/* Optional sequence of doubles -> malloc'd array (Py_None -> NULL). */
+static int parse_f64_table(PyObject *obj, double **out, Py_ssize_t *n) {
+    if (obj == NULL || obj == Py_None) {
+        *out = NULL;
+        *n = 0;
+        return 0;
+    }
+    PyObject *seq = PySequence_Fast(obj, "expected a float sequence");
+    if (!seq) return -1;
+    Py_ssize_t len = PySequence_Fast_GET_SIZE(seq);
+    double *arr = (double *)PyMem_Malloc(len ? len * sizeof(double) : 1);
+    if (!arr) {
+        Py_DECREF(seq);
+        PyErr_NoMemory();
+        return -1;
+    }
+    PyObject **items = PySequence_Fast_ITEMS(seq);
+    for (Py_ssize_t i = 0; i < len; i++) {
+        arr[i] = PyFloat_AsDouble(items[i]);
+        if (arr[i] == -1.0 && PyErr_Occurred()) {
+            PyMem_Free(arr);
+            Py_DECREF(seq);
+            return -1;
+        }
+    }
+    Py_DECREF(seq);
+    *out = arr;
+    *n = len;
+    return 0;
+}
+
+/* Optional sequence of small ints -> malloc'd uint32 array. */
+static int parse_u32_table(PyObject *obj, uint32_t **out, Py_ssize_t *n) {
+    if (obj == NULL || obj == Py_None) {
+        *out = NULL;
+        *n = 0;
+        return 0;
+    }
+    uint64_t *wide;
+    Py_ssize_t len;
+    wide = kv_tokens_to_array(obj, &len);
+    if (!wide) return -1;
+    uint32_t *arr = (uint32_t *)PyMem_Malloc(len ? len * sizeof(uint32_t) : 1);
+    if (!arr) {
+        PyMem_Free(wide);
+        PyErr_NoMemory();
+        return -1;
+    }
+    for (Py_ssize_t i = 0; i < len; i++) arr[i] = (uint32_t)wide[i];
+    PyMem_Free(wide);
+    *out = arr;
+    *n = len;
+    return 0;
+}
+
+/* ---------------------------------------------------------------------- */
+/* Arena object protocol                                                  */
+/* ---------------------------------------------------------------------- */
+
+static PyObject *Arena_new(PyTypeObject *type, PyObject *args,
+                           PyObject *kwds) {
+    static char *kwlist[] = {"max_keys", "pods_per_key", NULL};
+    Py_ssize_t max_keys = 0, cap = 0;
+    if (!PyArg_ParseTupleAndKeywords(args, kwds, "nn", kwlist, &max_keys,
+                                     &cap))
+        return NULL;
+    if (max_keys <= 0) {
+        PyErr_SetString(PyExc_ValueError, "index size must be positive");
+        return NULL;
+    }
+    if (cap <= 0 || cap > 0xFFFF) {
+        PyErr_SetString(PyExc_ValueError,
+                        "pods_per_key must be in [1, 65535]");
+        return NULL;
+    }
+    ArenaObject *self = (ArenaObject *)type->tp_alloc(type, 0);
+    if (!self) return NULL;
+    pthread_mutex_init(&self->mu, NULL);
+    self->cap = (uint32_t)cap;
+    self->max_keys = max_keys;
+    size_t stride = sizeof(KeyNode) + (size_t)cap * sizeof(uint64_t);
+    self->key_stride = (stride + 63) & ~(size_t)63;
+
+    size_t nb = 1024;
+    while (nb < (size_t)max_keys * 2 && nb < (1u << 21)) nb <<= 1;
+    self->n_buckets = nb;
+    self->mask = nb - 1;
+    self->buckets = (_Atomic(KeyNode *) *)calloc(nb, sizeof(KeyNode *));
+    self->e_buckets = (EngNode **)calloc(nb, sizeof(EngNode *));
+    if (!self->buckets || !self->e_buckets) {
+        free(self->buckets);
+        free(self->e_buckets);
+        self->buckets = NULL;
+        self->e_buckets = NULL;
+        Py_DECREF(self);
+        return PyErr_NoMemory();
+    }
+    self->bytes_allocated = 2 * nb * sizeof(void *);
+    return (PyObject *)self;
+}
+
+static void Arena_dealloc(ArenaObject *self) {
+    for (size_t i = 0; i < self->n_slabs; i++) free(self->slabs[i]);
+    free(self->slabs);
+    free(self->buckets);
+    free(self->e_buckets);
+    pthread_mutex_destroy(&self->mu);
+    Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+/* add(engine_pairs, request_pairs, entries) — Index.add semantics with
+ * interned ids; raises the exact ValueError messages of the Python
+ * backends. */
+static PyObject *Arena_add(ArenaObject *self, PyObject *args) {
+    PyObject *eng_obj, *req_obj, *ent_obj;
+    if (!PyArg_ParseTuple(args, "OOO", &eng_obj, &req_obj, &ent_obj))
+        return NULL;
+    uint32_t *em = NULL, *rm = NULL;
+    uint64_t *eh = NULL, *rh = NULL, *packed = NULL;
+    Py_ssize_t ne = 0, nr = 0, np = 0;
+    if (parse_pairs(eng_obj, &em, &eh, &ne) < 0) return NULL;
+    if (parse_pairs(req_obj, &rm, &rh, &nr) < 0) goto fail;
+    if (parse_packed(ent_obj, &packed, &np) < 0) goto fail;
+    if (ne == 0 || nr == 0 || np == 0) {
+        PyErr_SetString(PyExc_ValueError,
+                        "no keys or entries provided for adding to index");
+        goto fail;
+    }
+    if (ne != nr) {
+        PyErr_Format(PyExc_ValueError,
+                     "engine/request key length mismatch: %zd != %zd", ne, nr);
+        goto fail;
+    }
+    Py_BEGIN_ALLOW_THREADS
+    pthread_mutex_lock(&self->mu);
+    for (Py_ssize_t i = 0; i < ne; i++)
+        eng_add(self, em[i], eh[i], rm[i], rh[i]);
+    for (Py_ssize_t i = 0; i < nr; i++) {
+        KeyNode *n = key_get_or_create(self, rm[i], rh[i], NULL);
+        if (!n) break; /* allocation failure: stop, arena stays coherent */
+        for (Py_ssize_t j = 0; j < np; j++)
+            node_entry_add(self, n, packed[j]);
+    }
+    pthread_mutex_unlock(&self->mu);
+    Py_END_ALLOW_THREADS
+    PyMem_Free(em);
+    PyMem_Free(eh);
+    PyMem_Free(rm);
+    PyMem_Free(rh);
+    PyMem_Free(packed);
+    Py_RETURN_NONE;
+fail:
+    PyMem_Free(em);
+    PyMem_Free(eh);
+    PyMem_Free(rm);
+    PyMem_Free(rh);
+    PyMem_Free(packed);
+    return NULL;
+}
+
+/* evict(model_id, hash, entries) -> removed count, or -1 when the engine
+ * key is unknown (the Python path logs-and-returns there). */
+static PyObject *Arena_evict(ArenaObject *self, PyObject *args) {
+    unsigned long model;
+    unsigned long long hash;
+    PyObject *ent_obj;
+    if (!PyArg_ParseTuple(args, "kKO", &model, &hash, &ent_obj)) return NULL;
+    uint64_t *packed = NULL;
+    Py_ssize_t np = 0;
+    if (parse_packed(ent_obj, &packed, &np) < 0) return NULL;
+    if (np == 0) {
+        PyMem_Free(packed);
+        PyErr_SetString(PyExc_ValueError,
+                        "no entries provided for eviction from index");
+        return NULL;
+    }
+    long removed = 0;
+    Py_BEGIN_ALLOW_THREADS
+    pthread_mutex_lock(&self->mu);
+    EngNode *e = eng_get(self, (uint32_t)model, hash);
+    if (!e) {
+        removed = -1;
+    } else {
+        KeyNode *n = key_find_locked(self, e->req_model, e->req_hash);
+        if (!n) {
+            eng_remove(self, e);
+        } else {
+            key_lru_touch(self, n);
+            for (Py_ssize_t j = 0; j < np; j++)
+                removed += node_entry_remove(self, n, packed[j]);
+            if (atomic_load_explicit(&n->n_entries, memory_order_relaxed) ==
+                0) {
+                key_node_remove(self, n);
+                eng_remove(self, e);
+            }
+        }
+    }
+    pthread_mutex_unlock(&self->mu);
+    Py_END_ALLOW_THREADS
+    PyMem_Free(packed);
+    return PyLong_FromLong(removed);
+}
+
+/* get_request_key(model_id, hash) -> (req_model_id, req_hash) | None.
+ * Touches engine-map recency exactly like LRUCache.get. */
+static PyObject *Arena_get_request_key(ArenaObject *self, PyObject *args) {
+    unsigned long model;
+    unsigned long long hash;
+    if (!PyArg_ParseTuple(args, "kK", &model, &hash)) return NULL;
+    uint32_t rmodel = 0;
+    uint64_t rhash = 0;
+    int found = 0;
+    pthread_mutex_lock(&self->mu);
+    EngNode *e = eng_get(self, (uint32_t)model, hash);
+    if (e) {
+        rmodel = e->req_model;
+        rhash = e->req_hash;
+        found = 1;
+    }
+    pthread_mutex_unlock(&self->mu);
+    if (!found) Py_RETURN_NONE;
+    return Py_BuildValue("(kK)", (unsigned long)rmodel,
+                         (unsigned long long)rhash);
+}
+
+/* lookup_chain(model_id, hashes) -> [(packed, ...), ...] stopping at the
+ * first miss/empty key (the seed's chain-cut semantics). Lock-free. */
+static PyObject *Arena_lookup_chain(ArenaObject *self, PyObject *args) {
+    unsigned long model;
+    PyObject *hashes_obj;
+    if (!PyArg_ParseTuple(args, "kO", &model, &hashes_obj)) return NULL;
+    uint64_t *hashes = NULL;
+    Py_ssize_t n = 0;
+    if (parse_packed(hashes_obj, &hashes, &n) < 0) return NULL;
+    uint64_t *buf =
+        (uint64_t *)PyMem_Malloc(n ? (size_t)n * self->cap * 8 : 1);
+    int *counts = (int *)PyMem_Malloc(n ? n * sizeof(int) : 1);
+    if (!buf || !counts) {
+        PyMem_Free(hashes);
+        PyMem_Free(buf);
+        PyMem_Free(counts);
+        return PyErr_NoMemory();
+    }
+    Py_ssize_t hit = 0;
+    Py_BEGIN_ALLOW_THREADS
+    for (; hit < n; hit++) {
+        int c = arena_find(self, (uint32_t)model, hashes[hit],
+                           buf + (size_t)hit * self->cap);
+        if (c <= 0) break;
+        counts[hit] = c;
+    }
+    Py_END_ALLOW_THREADS
+    PyObject *out = PyList_New(hit);
+    if (out) {
+        for (Py_ssize_t i = 0; i < hit; i++) {
+            PyObject *tup = PyTuple_New(counts[i]);
+            if (!tup) {
+                Py_CLEAR(out);
+                break;
+            }
+            for (int j = 0; j < counts[i]; j++) {
+                PyObject *v = PyLong_FromUnsignedLongLong(
+                    buf[(size_t)i * self->cap + j]);
+                if (!v) {
+                    Py_DECREF(tup);
+                    Py_CLEAR(out);
+                    goto done;
+                }
+                PyTuple_SET_ITEM(tup, j, v);
+            }
+            PyList_SET_ITEM(out, i, tup);
+        }
+    }
+done:
+    PyMem_Free(hashes);
+    PyMem_Free(buf);
+    PyMem_Free(counts);
+    return out;
+}
+
+typedef struct {
+    uint32_t model;
+    uint64_t hash;
+} KeyId;
+
+static int keyid_cmp(const void *pa, const void *pb) {
+    const KeyId *x = (const KeyId *)pa, *y = (const KeyId *)pb;
+    if (x->hash != y->hash) return x->hash < y->hash ? -1 : 1;
+    if (x->model != y->model) return x->model < y->model ? -1 : 1;
+    return 0;
+}
+
+/* remove_matching(pod_bitmap, tier_bitmap|None, request_pairs|None) -> n.
+ * Backs remove_pod (pairs=None: every key, no recency touch) and
+ * remove_entries (explicit keys, peek semantics). Keys emptied BY THIS
+ * CALL get their engine mappings swept — capacity evictions never do. */
+static PyObject *Arena_remove_matching(ArenaObject *self, PyObject *args) {
+    PyObject *pod_obj, *tier_obj, *pairs_obj;
+    if (!PyArg_ParseTuple(args, "OOO", &pod_obj, &tier_obj, &pairs_obj))
+        return NULL;
+    const uint8_t *pod_bm, *tier_bm;
+    Py_ssize_t pod_len, tier_len;
+    if (parse_bitmap(pod_obj, &pod_bm, &pod_len) < 0) return NULL;
+    if (parse_bitmap(tier_obj, &tier_bm, &tier_len) < 0) return NULL;
+    if (pod_bm == NULL) {
+        PyErr_SetString(PyExc_TypeError, "pod bitmap must be bytes");
+        return NULL;
+    }
+    uint32_t *pm = NULL;
+    uint64_t *ph = NULL;
+    Py_ssize_t npairs = -1;
+    if (pairs_obj != Py_None &&
+        parse_pairs(pairs_obj, &pm, &ph, &npairs) < 0)
+        return NULL;
+
+    long removed = 0;
+    KeyId *emptied = NULL;
+    size_t n_emptied = 0, cap_emptied = 0;
+    int oom = 0;
+
+    Py_BEGIN_ALLOW_THREADS
+    pthread_mutex_lock(&self->mu);
+    Py_ssize_t n_targets =
+        npairs >= 0 ? npairs : self->n_keys;
+    KeyNode *walk = self->key_lru_head;
+    for (Py_ssize_t t = 0; t < n_targets; t++) {
+        KeyNode *n;
+        if (npairs >= 0) {
+            n = key_find_locked(self, pm[t], ph[t]);
+            if (!n) continue;
+        } else {
+            n = walk;
+            if (!n) break;
+            walk = n->lru_next; /* before any unlink */
+        }
+        uint32_t ne = atomic_load_explicit(&n->n_entries,
+                                           memory_order_relaxed);
+        int hit = 0;
+        for (uint32_t i = 0; i < ne;) {
+            uint64_t packed =
+                atomic_load_explicit(&n->entries[i], memory_order_relaxed);
+            uint32_t pod = (uint32_t)(packed >> 16);
+            uint32_t tier = (uint32_t)(packed & 0xFFFF);
+            if (bitmap_test(pod_bm, pod_len, pod) &&
+                (tier_bm == NULL || bitmap_test(tier_bm, tier_len, tier))) {
+                node_entry_remove(self, n, packed);
+                removed++;
+                hit = 1;
+                ne--;
+            } else {
+                i++;
+            }
+        }
+        if (hit && ne == 0) {
+            if (n_emptied == cap_emptied) {
+                size_t ncap = cap_emptied ? cap_emptied * 2 : 64;
+                KeyId *ne2 = (KeyId *)realloc(emptied, ncap * sizeof(KeyId));
+                if (!ne2) {
+                    oom = 1;
+                } else {
+                    emptied = ne2;
+                    cap_emptied = ncap;
+                }
+            }
+            if (!oom) {
+                emptied[n_emptied].model =
+                    atomic_load_explicit(&n->model_id, memory_order_relaxed);
+                emptied[n_emptied].hash =
+                    atomic_load_explicit(&n->hash, memory_order_relaxed);
+                n_emptied++;
+            }
+            key_node_remove(self, n);
+        }
+    }
+    if (n_emptied) {
+        qsort(emptied, n_emptied, sizeof(KeyId), keyid_cmp);
+        EngNode *e = self->eng_lru_head;
+        while (e) {
+            EngNode *next = e->lru_next;
+            KeyId probe = {e->req_model, e->req_hash};
+            if (bsearch(&probe, emptied, n_emptied, sizeof(KeyId),
+                        keyid_cmp))
+                eng_remove(self, e);
+            e = next;
+        }
+    }
+    pthread_mutex_unlock(&self->mu);
+    Py_END_ALLOW_THREADS
+    free(emptied);
+    PyMem_Free(pm);
+    PyMem_Free(ph);
+    if (oom) return PyErr_NoMemory();
+    return PyLong_FromLong(removed);
+}
+
+/* dump() -> (entry_rows, engine_rows): oldest-first snapshots for
+ * export_view / debugging. */
+static PyObject *Arena_dump(ArenaObject *self, PyObject *noarg) {
+    (void)noarg;
+    pthread_mutex_lock(&self->mu);
+    PyObject *entries = PyList_New(0);
+    PyObject *engines = PyList_New(0);
+    if (!entries || !engines) goto fail;
+    for (KeyNode *n = self->key_lru_head; n; n = n->lru_next) {
+        uint32_t ne = atomic_load_explicit(&n->n_entries,
+                                           memory_order_relaxed);
+        PyObject *tup = PyTuple_New(ne);
+        if (!tup) goto fail;
+        for (uint32_t i = 0; i < ne; i++) {
+            PyObject *v = PyLong_FromUnsignedLongLong(
+                atomic_load_explicit(&n->entries[i], memory_order_relaxed));
+            if (!v) {
+                Py_DECREF(tup);
+                goto fail;
+            }
+            PyTuple_SET_ITEM(tup, i, v);
+        }
+        PyObject *row = Py_BuildValue(
+            "(kKN)",
+            (unsigned long)atomic_load_explicit(&n->model_id,
+                                                memory_order_relaxed),
+            (unsigned long long)atomic_load_explicit(&n->hash,
+                                                     memory_order_relaxed),
+            tup);
+        if (!row || PyList_Append(entries, row) < 0) {
+            Py_XDECREF(row);
+            goto fail;
+        }
+        Py_DECREF(row);
+    }
+    for (EngNode *e = self->eng_lru_head; e; e = e->lru_next) {
+        PyObject *row = Py_BuildValue(
+            "(kKkK)", (unsigned long)e->model_id,
+            (unsigned long long)e->hash, (unsigned long)e->req_model,
+            (unsigned long long)e->req_hash);
+        if (!row || PyList_Append(engines, row) < 0) {
+            Py_XDECREF(row);
+            goto fail;
+        }
+        Py_DECREF(row);
+    }
+    pthread_mutex_unlock(&self->mu);
+    return Py_BuildValue("(NN)", entries, engines);
+fail:
+    pthread_mutex_unlock(&self->mu);
+    Py_XDECREF(entries);
+    Py_XDECREF(engines);
+    return NULL;
+}
+
+static PyObject *Arena_stats(ArenaObject *self, PyObject *noarg) {
+    (void)noarg;
+    pthread_mutex_lock(&self->mu);
+    PyObject *d = Py_BuildValue(
+        "{s:n,s:n,s:n,s:n,s:K,s:K,s:K,s:K,s:K,s:n}",
+        "keys", self->n_keys,
+        "engine_keys", self->n_eng,
+        "max_keys", self->max_keys,
+        "pods_per_key", (Py_ssize_t)self->cap,
+        "bytes", (unsigned long long)self->bytes_allocated,
+        "epoch",
+        (unsigned long long)atomic_load_explicit(&self->epoch,
+                                                 memory_order_relaxed),
+        "locked_lookups", (unsigned long long)self->locked_lookups,
+        "adds", (unsigned long long)self->total_adds,
+        "capacity_evictions", (unsigned long long)self->total_evictions,
+        "blocks_applied", (Py_ssize_t)self->blocks_applied);
+    pthread_mutex_unlock(&self->mu);
+    return d;
+}
+
+/* ---------------------------------------------------------------------- */
+/* score_batch: the fused read path                                       */
+/* ---------------------------------------------------------------------- */
+
+typedef struct {
+    uint32_t model;
+    uint64_t *hashes;        /* solo: full chain; fork: tail keys only */
+    Py_ssize_t n_keys;
+    const uint8_t *filter;   /* borrowed from the item's bytes object */
+    Py_ssize_t filter_len;
+    Py_ssize_t ref_pos;      /* -1 = solo */
+    Py_ssize_t shared;       /* fork: shared leading blocks with ref */
+    int keep;                /* solo: snapshot states for later forks */
+    /* walk state / outputs */
+    uint32_t m;              /* number of block-0 pods */
+    uint32_t *pod_order;     /* local slot -> pod_id, first-seen order */
+    double *scores;
+    uint32_t *match;
+    uint8_t *active;
+    uint8_t *dropped;
+    uint32_t active_count;
+    double *snap_scores;     /* keep: n_snaps * m matrices */
+    uint32_t *snap_match;
+    uint8_t *snap_active;
+    Py_ssize_t n_snaps;
+    int override_flag;
+    int routing_ran;
+    int oom;
+} ScoreItem;
+
+static void score_item_snapshot(ScoreItem *it) {
+    Py_ssize_t s = it->n_snaps;
+    memcpy(it->snap_scores + s * it->m, it->scores, it->m * sizeof(double));
+    memcpy(it->snap_match + s * it->m, it->match, it->m * sizeof(uint32_t));
+    memcpy(it->snap_active + s * it->m, it->active, it->m);
+    it->n_snaps = s + 1;
+}
+
+/* One key's entries folded into the per-pod max-weight staging arrays —
+ * the exact `_pod_max_weights` arithmetic: first weight wins unless a
+ * strictly greater one appears (same floats, same comparison). */
+static inline void fold_key_entries(
+    const uint64_t *ebuf, int ne, uint64_t stamp, const ScoreItem *it,
+    Py_ssize_t n_pods, const double *tier_w, Py_ssize_t n_tiers,
+    uint64_t *here_stamp, double *here_val, uint32_t *pod_slot,
+    ScoreItem *grow /* non-NULL: block 0, append first-seen pods */) {
+    for (int j = 0; j < ne; j++) {
+        uint64_t packed = ebuf[j];
+        uint32_t pod = (uint32_t)(packed >> 16);
+        uint32_t tier = (uint32_t)(packed & 0xFFFF);
+        if ((Py_ssize_t)pod >= n_pods) continue; /* interned mid-flight */
+        if (it->filter && !bitmap_test(it->filter, it->filter_len, pod))
+            continue;
+        double w = (Py_ssize_t)tier < n_tiers ? tier_w[tier] : 1.0;
+        if (here_stamp[pod] != stamp) {
+            here_stamp[pod] = stamp;
+            here_val[pod] = w;
+            if (grow) {
+                uint32_t m = grow->m;
+                pod_slot[pod] = m;
+                grow->pod_order[m] = pod;
+                grow->scores[m] = w;
+                grow->match[m] = 1;
+                grow->active[m] = 1;
+                grow->m = m + 1;
+            }
+        } else if (w > here_val[pod]) {
+            here_val[pod] = w;
+            if (grow) grow->scores[pod_slot[pod]] = w;
+        }
+    }
+}
+
+/* score_batch(items, tier_weights, lex_rank, health_factor, health_modes,
+ *             ae_factors, divisors)
+ *
+ * items: sequence of (model_id, hashes, filter_bitmap|None, ref_pos,
+ * shared_blocks, keep_states) — ref_pos < 0 is a solo walk over `hashes`;
+ * ref_pos >= 0 forks from that earlier item's state snapshot after
+ * `shared_blocks` keys and walks `hashes` as the tail. Mirrors
+ * LongestPrefixScorer.score_plan + the per-item adjustment pipeline
+ * (fleet-health modes / anti-entropy factors / routing divisors), all per
+ * pod_id against the pushed factor tables, in ONE GIL-released crossing.
+ *
+ * Returns [ (((pod_id, score, match_blocks, dropped), ...), override,
+ *            routing_ran), ... ] with pods in block-0 first-seen order —
+ * the exact dict insertion order of the Python scorer. */
+static PyObject *Arena_score_batch(ArenaObject *self, PyObject *args) {
+    PyObject *items_obj, *tierw_obj, *lex_obj, *hm_obj, *ae_obj, *div_obj;
+    double health_factor;
+    if (!PyArg_ParseTuple(args, "OOOdOOO", &items_obj, &tierw_obj, &lex_obj,
+                          &health_factor, &hm_obj, &ae_obj, &div_obj))
+        return NULL;
+
+    double *tier_w = NULL, *ae = NULL, *divs = NULL;
+    uint32_t *lex = NULL;
+    Py_ssize_t n_tiers = 0, n_ae = 0, n_div = 0, n_pods = 0;
+    const uint8_t *hm = NULL;
+    Py_ssize_t hm_len = 0;
+    ScoreItem *its = NULL;
+    Py_ssize_t n_items = 0, parsed = 0;
+    PyObject *seq = NULL, *out = NULL;
+    uint64_t *ebuf = NULL, *here_stamp = NULL;
+    double *here_val = NULL;
+    uint32_t *pod_slot = NULL;
+
+    if (parse_f64_table(tierw_obj, &tier_w, &n_tiers) < 0) goto cleanup;
+    if (parse_u32_table(lex_obj, &lex, &n_pods) < 0) goto cleanup;
+    if (parse_bitmap(hm_obj, &hm, &hm_len) < 0) goto cleanup;
+    if (parse_f64_table(ae_obj, &ae, &n_ae) < 0) goto cleanup;
+    if (parse_f64_table(div_obj, &divs, &n_div) < 0) goto cleanup;
+
+    seq = PySequence_Fast(items_obj, "score_batch items must be a sequence");
+    if (!seq) goto cleanup;
+    n_items = PySequence_Fast_GET_SIZE(seq);
+    its = (ScoreItem *)PyMem_Calloc(n_items ? n_items : 1, sizeof(ScoreItem));
+    if (!its) {
+        PyErr_NoMemory();
+        goto cleanup;
+    }
+    for (parsed = 0; parsed < n_items; parsed++) {
+        PyObject *t = PySequence_Fast_GET_ITEM(seq, parsed);
+        if (!PyTuple_Check(t) || PyTuple_GET_SIZE(t) != 6) {
+            PyErr_SetString(PyExc_TypeError,
+                            "score item must be a 6-tuple");
+            goto cleanup;
+        }
+        ScoreItem *it = &its[parsed];
+        uint64_t model;
+        if (kv_as_u64(PyTuple_GET_ITEM(t, 0), &model) < 0) goto cleanup;
+        it->model = (uint32_t)model;
+        it->hashes = kv_tokens_to_array(PyTuple_GET_ITEM(t, 1), &it->n_keys);
+        if (!it->hashes) goto cleanup;
+        if (parse_bitmap(PyTuple_GET_ITEM(t, 2), &it->filter,
+                         &it->filter_len) < 0)
+            goto cleanup;
+        it->ref_pos = PyLong_AsSsize_t(PyTuple_GET_ITEM(t, 3));
+        it->shared = PyLong_AsSsize_t(PyTuple_GET_ITEM(t, 4));
+        if (PyErr_Occurred()) goto cleanup;
+        it->keep = PyObject_IsTrue(PyTuple_GET_ITEM(t, 5));
+        if (it->keep < 0) goto cleanup;
+        if (it->ref_pos >= parsed) {
+            PyErr_SetString(PyExc_ValueError,
+                            "fork ref_pos must point at an earlier item");
+            goto cleanup;
+        }
+        uint32_t cap = self->cap;
+        it->pod_order =
+            (uint32_t *)PyMem_Malloc(cap * sizeof(uint32_t));
+        it->scores = (double *)PyMem_Malloc(cap * sizeof(double));
+        it->match = (uint32_t *)PyMem_Malloc(cap * sizeof(uint32_t));
+        it->active = (uint8_t *)PyMem_Malloc(cap);
+        it->dropped = (uint8_t *)PyMem_Calloc(cap, 1);
+        if (!it->pod_order || !it->scores || !it->match || !it->active ||
+            !it->dropped) {
+            PyErr_NoMemory();
+            goto cleanup;
+        }
+        if (it->keep) {
+            size_t ns = (size_t)it->n_keys + 1;
+            it->snap_scores =
+                (double *)PyMem_Malloc(ns * cap * sizeof(double));
+            it->snap_match =
+                (uint32_t *)PyMem_Malloc(ns * cap * sizeof(uint32_t));
+            it->snap_active = (uint8_t *)PyMem_Malloc(ns * cap);
+            if (!it->snap_scores || !it->snap_match || !it->snap_active) {
+                PyErr_NoMemory();
+                goto cleanup;
+            }
+        }
+    }
+
+    ebuf = (uint64_t *)PyMem_Malloc(self->cap * sizeof(uint64_t));
+    here_stamp = (uint64_t *)PyMem_Calloc(n_pods ? n_pods : 1,
+                                          sizeof(uint64_t));
+    here_val = (double *)PyMem_Malloc((n_pods ? n_pods : 1) * sizeof(double));
+    pod_slot =
+        (uint32_t *)PyMem_Malloc((n_pods ? n_pods : 1) * sizeof(uint32_t));
+    if (!ebuf || !here_stamp || !here_val || !pod_slot) {
+        PyErr_NoMemory();
+        goto cleanup;
+    }
+
+    Py_BEGIN_ALLOW_THREADS
+    uint64_t stamp = 0;
+    for (Py_ssize_t p = 0; p < n_items; p++) {
+        ScoreItem *it = &its[p];
+        it->m = 0;
+        it->active_count = 0;
+        it->n_snaps = 0;
+        Py_ssize_t start_key = 0;
+        if (it->ref_pos >= 0) {
+            /* Fork: resume from the reference's snapshot after `shared`
+             * keys (a cut freezes the list; its last state IS the
+             * post-cut state), then walk the tail keys. */
+            ScoreItem *ref = &its[it->ref_pos];
+            if (ref->n_snaps > 0) {
+                Py_ssize_t si = it->shared < ref->n_snaps ? it->shared
+                                                          : ref->n_snaps;
+                si -= 1;
+                if (si < 0) si = 0;
+                it->m = ref->m;
+                memcpy(it->pod_order, ref->pod_order,
+                       it->m * sizeof(uint32_t));
+                memcpy(it->scores, ref->snap_scores + si * ref->m,
+                       it->m * sizeof(double));
+                memcpy(it->match, ref->snap_match + si * ref->m,
+                       it->m * sizeof(uint32_t));
+                memcpy(it->active, ref->snap_active + si * ref->m, it->m);
+                for (uint32_t i = 0; i < it->m; i++)
+                    if (it->active[i]) it->active_count++;
+            }
+            /* Tail keys replay the later-key loop below from key 0. */
+            for (Py_ssize_t k = 0; k < it->n_keys; k++) {
+                if (it->active_count == 0) break;
+                stamp++;
+                int ne = arena_find(self, it->model, it->hashes[k], ebuf);
+                fold_key_entries(ebuf, ne, stamp, it, n_pods, tier_w,
+                                 n_tiers, here_stamp, here_val, pod_slot,
+                                 NULL);
+                for (uint32_t i = 0; i < it->m; i++) {
+                    if (!it->active[i]) continue;
+                    uint32_t pod = it->pod_order[i];
+                    if (here_stamp[pod] == stamp) {
+                        it->scores[i] += here_val[pod];
+                        it->match[i] += 1;
+                    } else {
+                        it->active[i] = 0;
+                        it->active_count--;
+                    }
+                }
+            }
+        } else if (it->n_keys > 0) {
+            /* Solo: block 0 seeds scores/active/match ... */
+            stamp++;
+            int ne = arena_find(self, it->model, it->hashes[0], ebuf);
+            fold_key_entries(ebuf, ne, stamp, it, n_pods, tier_w, n_tiers,
+                             here_stamp, here_val, pod_slot, it);
+            it->active_count = it->m;
+            if (it->keep) score_item_snapshot(it);
+            /* ... then each later key intersects + accumulates. */
+            for (Py_ssize_t k = 1; k < it->n_keys; k++) {
+                if (it->active_count == 0) break;
+                stamp++;
+                ne = arena_find(self, it->model, it->hashes[k], ebuf);
+                fold_key_entries(ebuf, ne, stamp, it, n_pods, tier_w,
+                                 n_tiers, here_stamp, here_val, pod_slot,
+                                 NULL);
+                for (uint32_t i = 0; i < it->m; i++) {
+                    if (!it->active[i]) continue;
+                    uint32_t pod = it->pod_order[i];
+                    if (here_stamp[pod] == stamp) {
+                        it->scores[i] += here_val[pod];
+                        it->match[i] += 1;
+                    } else {
+                        it->active[i] = 0;
+                        it->active_count--;
+                    }
+                }
+                if (it->keep) score_item_snapshot(it);
+            }
+            (void)start_key;
+        }
+
+        /* Per-item adjustment pipeline, same order as the Python path:
+         * fleet-health (STALE drop / SUSPECT x factor) -> anti-entropy
+         * accuracy (<1.0 multiplies) -> routing load demotion (divide +
+         * argmax override detection). Dropped pods keep their match
+         * count: match_blocks is never filtered in the Python path. */
+        uint32_t n_live = it->m;
+        if (hm) {
+            for (uint32_t i = 0; i < it->m; i++) {
+                uint32_t pod = it->pod_order[i];
+                uint8_t mode =
+                    (Py_ssize_t)pod < hm_len ? hm[pod] : 0;
+                if (mode == 2) {
+                    it->dropped[i] = 1;
+                    n_live--;
+                } else if (mode == 1) {
+                    it->scores[i] *= health_factor;
+                }
+            }
+        }
+        if (ae) {
+            for (uint32_t i = 0; i < it->m; i++) {
+                if (it->dropped[i]) continue;
+                uint32_t pod = it->pod_order[i];
+                double f = (Py_ssize_t)pod < n_ae ? ae[pod] : 1.0;
+                if (f < 1.0) it->scores[i] *= f;
+            }
+        }
+        it->routing_ran = 0;
+        it->override_flag = 0;
+        if (divs && n_live > 0) {
+            double best = 0.0;
+            uint32_t best_rank = 0;
+            int first = 1;
+            for (uint32_t i = 0; i < it->m; i++) {
+                if (it->dropped[i]) continue;
+                uint32_t pod = it->pod_order[i];
+                uint32_t rank =
+                    (Py_ssize_t)pod < n_pods ? lex[pod] : 0xFFFFFFFFu;
+                double v = it->scores[i];
+                if (first || v > best) {
+                    best = v;
+                    best_rank = rank;
+                    first = 0;
+                } else if (v == best && rank < best_rank) {
+                    best_rank = rank;
+                }
+            }
+            uint32_t before = best_rank;
+            for (uint32_t i = 0; i < it->m; i++) {
+                if (it->dropped[i]) continue;
+                uint32_t pod = it->pod_order[i];
+                double d = (Py_ssize_t)pod < n_div ? divs[pod] : 1.0;
+                it->scores[i] = it->scores[i] / d;
+            }
+            first = 1;
+            for (uint32_t i = 0; i < it->m; i++) {
+                if (it->dropped[i]) continue;
+                uint32_t pod = it->pod_order[i];
+                uint32_t rank =
+                    (Py_ssize_t)pod < n_pods ? lex[pod] : 0xFFFFFFFFu;
+                double v = it->scores[i];
+                if (first || v > best) {
+                    best = v;
+                    best_rank = rank;
+                    first = 0;
+                } else if (v == best && rank < best_rank) {
+                    best_rank = rank;
+                }
+            }
+            it->routing_ran = 1;
+            it->override_flag = before != best_rank;
+        }
+    }
+    Py_END_ALLOW_THREADS
+
+    /* Box results. */
+    out = PyList_New(n_items);
+    if (!out) goto cleanup;
+    for (Py_ssize_t p = 0; p < n_items; p++) {
+        ScoreItem *it = &its[p];
+        PyObject *pods = PyTuple_New(it->m);
+        if (!pods) {
+            Py_CLEAR(out);
+            goto cleanup;
+        }
+        for (uint32_t i = 0; i < it->m; i++) {
+            PyObject *row = Py_BuildValue(
+                "(IdIi)", (unsigned int)it->pod_order[i], it->scores[i],
+                (unsigned int)it->match[i], (int)it->dropped[i]);
+            if (!row) {
+                Py_DECREF(pods);
+                Py_CLEAR(out);
+                goto cleanup;
+            }
+            PyTuple_SET_ITEM(pods, i, row);
+        }
+        PyObject *res = Py_BuildValue("(Nii)", pods, it->override_flag,
+                                      it->routing_ran);
+        if (!res) {
+            Py_CLEAR(out);
+            goto cleanup;
+        }
+        PyList_SET_ITEM(out, p, res);
+    }
+
+cleanup:
+    if (its) {
+        for (Py_ssize_t p = 0; p < n_items; p++) {
+            PyMem_Free(its[p].hashes);
+            PyMem_Free(its[p].pod_order);
+            PyMem_Free(its[p].scores);
+            PyMem_Free(its[p].match);
+            PyMem_Free(its[p].active);
+            PyMem_Free(its[p].dropped);
+            PyMem_Free(its[p].snap_scores);
+            PyMem_Free(its[p].snap_match);
+            PyMem_Free(its[p].snap_active);
+        }
+        PyMem_Free(its);
+    }
+    PyMem_Free(tier_w);
+    PyMem_Free(lex);
+    PyMem_Free(ae);
+    PyMem_Free(divs);
+    PyMem_Free(ebuf);
+    PyMem_Free(here_stamp);
+    PyMem_Free(here_val);
+    PyMem_Free(pod_slot);
+    Py_XDECREF(seq);
+    return out;
+}
+
+/* ---------------------------------------------------------------------- */
+/* apply_batch: the fused write path                                      */
+/* ---------------------------------------------------------------------- */
+
+/* hash_as_uint64 with skip-instead-of-raise semantics (the digest loop
+ * catches TypeError/ValueError per hash): bools and non-int/bytes types
+ * skip, ints are masked to 64 bits, bytes take their last 8 bytes
+ * big-endian (empty bytes skip). Returns 1 ok / 0 skip. */
+static int coerce_hash(PyObject *raw, uint64_t *out) {
+    if (PyBool_Check(raw)) return 0;
+    if (PyLong_Check(raw)) {
+        uint64_t v = PyLong_AsUnsignedLongLongMask(raw);
+        if (v == (uint64_t)-1 && PyErr_Occurred()) {
+            PyErr_Clear();
+            return 0;
+        }
+        *out = v;
+        return 1;
+    }
+    const uint8_t *buf = NULL;
+    Py_ssize_t len = 0;
+    if (PyBytes_Check(raw)) {
+        buf = (const uint8_t *)PyBytes_AS_STRING(raw);
+        len = PyBytes_GET_SIZE(raw);
+    } else if (PyByteArray_Check(raw)) {
+        buf = (const uint8_t *)PyByteArray_AS_STRING(raw);
+        len = PyByteArray_GET_SIZE(raw);
+    } else {
+        return 0;
+    }
+    if (len == 0) return 0; /* int.from_bytes(b"") -> ValueError path */
+    if (len > 8) {
+        buf += len - 8;
+        len = 8;
+    }
+    uint64_t v = 0;
+    for (Py_ssize_t i = 0; i < len; i++) v = (v << 8) | buf[i];
+    *out = v;
+    return 1;
+}
+
+typedef struct {
+    int kind;          /* 1 = BlockStored, 0 = BlockRemoved */
+    int drop;          /* stored: bad parent hash -> drop whole event */
+    int has_parent;
+    uint64_t parent;
+    uint64_t *hashes;  /* coerced engine block hashes, bad ones skipped */
+    Py_ssize_t n_hashes;
+    uint64_t *tokens;
+    Py_ssize_t n_tokens;
+    uint64_t *extra;   /* lora extra keys or NULL */
+    Py_ssize_t n_extra;
+    uint64_t packed;   /* (pod_id<<16)|tier_id entry */
+} ApplyEvent;
+
+/* apply_batch(model_id, root_hash, block_size, events) -> blocks applied.
+ *
+ * events: sequence of
+ *   (1, block_hashes, parent_hash|None, token_ids, extra|None, packed)
+ *   (0, block_hashes, packed)
+ * with hashes still raw off the wire (coercion happens here, mirroring
+ * hash_as_uint64 + the per-hash try/except), tokens as int sequences,
+ * and pod/tier already validated + interned by the wrapper.
+ *
+ * Conversion is all-or-nothing BEFORE any mutation: a hard conversion
+ * error raises with the arena untouched, so the wrapper can fall back to
+ * the pure-Python digest and reach the exact same final state. The apply
+ * loop then runs under the writer mutex with the GIL released — request
+ * keys are chain-derived with kv_hash_block (bit-identical to the
+ * token_processor) and events land with the Python digest's semantics:
+ * parent via the engine map (recency touch) else the root hash, length
+ * mismatches skipped like the caught ValueError, removals that empty a
+ * key drop the key and its engine mapping. */
+static PyObject *Arena_apply_batch(ArenaObject *self, PyObject *args) {
+    unsigned long model_l;
+    unsigned long long root;
+    Py_ssize_t block_size;
+    PyObject *events_obj;
+    if (!PyArg_ParseTuple(args, "kKnO", &model_l, &root, &block_size,
+                          &events_obj))
+        return NULL;
+    if (block_size <= 0) {
+        PyErr_SetString(PyExc_ValueError, "block_size must be positive");
+        return NULL;
+    }
+    uint32_t model = (uint32_t)model_l;
+    PyObject *seq =
+        PySequence_Fast(events_obj, "events must be a sequence");
+    if (!seq) return NULL;
+    Py_ssize_t n_events = PySequence_Fast_GET_SIZE(seq);
+    ApplyEvent *evs =
+        (ApplyEvent *)PyMem_Calloc(n_events ? n_events : 1,
+                                   sizeof(ApplyEvent));
+    if (!evs) {
+        Py_DECREF(seq);
+        return PyErr_NoMemory();
+    }
+
+    /* Phase 1 (GIL held): convert everything. */
+    Py_ssize_t max_req = 0;
+    int ok = 1;
+    for (Py_ssize_t i = 0; i < n_events && ok; i++) {
+        PyObject *t = PySequence_Fast_GET_ITEM(seq, i);
+        ApplyEvent *ev = &evs[i];
+        if (!PyTuple_Check(t) || PyTuple_GET_SIZE(t) < 3) {
+            PyErr_SetString(PyExc_TypeError, "event must be a tuple");
+            ok = 0;
+            break;
+        }
+        long kind = PyLong_AsLong(PyTuple_GET_ITEM(t, 0));
+        if (kind == -1 && PyErr_Occurred()) {
+            ok = 0;
+            break;
+        }
+        ev->kind = (int)kind;
+        PyObject *hashes_obj = PyTuple_GET_ITEM(t, 1);
+        PyObject *hseq = PySequence_Fast(
+            hashes_obj, "block_hashes must be a sequence");
+        if (!hseq) {
+            ok = 0;
+            break;
+        }
+        Py_ssize_t nh = PySequence_Fast_GET_SIZE(hseq);
+        ev->hashes =
+            (uint64_t *)PyMem_Malloc(nh ? nh * sizeof(uint64_t) : 1);
+        if (!ev->hashes) {
+            Py_DECREF(hseq);
+            PyErr_NoMemory();
+            ok = 0;
+            break;
+        }
+        Py_ssize_t kept = 0;
+        for (Py_ssize_t j = 0; j < nh; j++) {
+            uint64_t h;
+            if (coerce_hash(PySequence_Fast_GET_ITEM(hseq, j), &h))
+                ev->hashes[kept++] = h;
+        }
+        Py_DECREF(hseq);
+        ev->n_hashes = kept;
+        if (ev->kind == 1) {
+            if (PyTuple_GET_SIZE(t) != 6) {
+                PyErr_SetString(PyExc_TypeError,
+                                "BlockStored event must be a 6-tuple");
+                ok = 0;
+                break;
+            }
+            PyObject *parent_obj = PyTuple_GET_ITEM(t, 2);
+            if (parent_obj != Py_None) {
+                if (coerce_hash(parent_obj, &ev->parent)) {
+                    ev->has_parent = 1;
+                } else {
+                    ev->drop = 1; /* bad parent -> drop whole event */
+                    continue;
+                }
+            }
+            ev->tokens =
+                kv_tokens_to_array(PyTuple_GET_ITEM(t, 3), &ev->n_tokens);
+            if (!ev->tokens) {
+                ok = 0;
+                break;
+            }
+            if (kv_extra_to_array(PyTuple_GET_ITEM(t, 4), &ev->extra,
+                                  &ev->n_extra) < 0) {
+                ok = 0;
+                break;
+            }
+            if (kv_as_u64(PyTuple_GET_ITEM(t, 5), &ev->packed) < 0) {
+                ok = 0;
+                break;
+            }
+            Py_ssize_t n_req = ev->n_tokens / block_size;
+            if (n_req > max_req) max_req = n_req;
+            if (ev->n_extra + 1 > max_req) max_req = ev->n_extra + 1;
+        } else {
+            if (kv_as_u64(PyTuple_GET_ITEM(t, 2), &ev->packed) < 0) {
+                ok = 0;
+                break;
+            }
+        }
+    }
+    Py_DECREF(seq);
+
+    uint64_t *req_hashes = NULL;
+    uint8_t *hash_buf = NULL;
+    if (ok) {
+        req_hashes = (uint64_t *)PyMem_Malloc(
+            (max_req ? max_req : 1) * sizeof(uint64_t));
+        /* Worst-case canonical CBOR for one block + extras. */
+        size_t buf_sz = 20 + 9 * (size_t)block_size + 9 * ((size_t)max_req + 1);
+        hash_buf = (uint8_t *)PyMem_Malloc(buf_sz);
+        if (!req_hashes || !hash_buf) {
+            PyErr_NoMemory();
+            ok = 0;
+        }
+    }
+
+    long applied = 0;
+    if (ok) {
+        /* Phase 2 (GIL released, writer mutex): apply everything. */
+        Py_BEGIN_ALLOW_THREADS
+        pthread_mutex_lock(&self->mu);
+        for (Py_ssize_t i = 0; i < n_events; i++) {
+            ApplyEvent *ev = &evs[i];
+            if (ev->kind == 1) {
+                if (ev->drop) continue;
+                uint64_t parent_hash = root;
+                if (ev->has_parent) {
+                    EngNode *pe = eng_get(self, model, ev->parent);
+                    if (pe) parent_hash = pe->req_hash;
+                }
+                Py_ssize_t n_req = ev->n_tokens / block_size;
+                if (ev->n_hashes == 0) continue;   /* `if engine_keys:` */
+                if (n_req == 0 || ev->n_hashes != n_req)
+                    continue; /* the caught ValueError paths */
+                uint64_t h = parent_hash;
+                for (Py_ssize_t b = 0; b < n_req; b++) {
+                    h = kv_hash_block(hash_buf, h,
+                                      ev->tokens + b * block_size,
+                                      block_size, ev->extra, ev->n_extra);
+                    req_hashes[b] = h;
+                }
+                for (Py_ssize_t b = 0; b < n_req; b++)
+                    eng_add(self, model, ev->hashes[b], model,
+                            req_hashes[b]);
+                for (Py_ssize_t b = 0; b < n_req; b++) {
+                    KeyNode *n =
+                        key_get_or_create(self, model, req_hashes[b], NULL);
+                    if (!n) break;
+                    node_entry_add(self, n, ev->packed);
+                }
+                applied += n_req;
+            } else {
+                for (Py_ssize_t j = 0; j < ev->n_hashes; j++) {
+                    EngNode *e = eng_get(self, model, ev->hashes[j]);
+                    if (!e) continue;
+                    KeyNode *n =
+                        key_find_locked(self, e->req_model, e->req_hash);
+                    if (!n) {
+                        eng_remove(self, e);
+                        continue;
+                    }
+                    key_lru_touch(self, n);
+                    node_entry_remove(self, n, ev->packed);
+                    if (atomic_load_explicit(&n->n_entries,
+                                             memory_order_relaxed) == 0) {
+                        key_node_remove(self, n);
+                        eng_remove(self, e);
+                    }
+                    applied++;
+                }
+            }
+        }
+        self->blocks_applied += (uint64_t)applied;
+        pthread_mutex_unlock(&self->mu);
+        Py_END_ALLOW_THREADS
+    }
+
+    for (Py_ssize_t i = 0; i < n_events; i++) {
+        PyMem_Free(evs[i].hashes);
+        PyMem_Free(evs[i].tokens);
+        PyMem_Free(evs[i].extra);
+    }
+    PyMem_Free(evs);
+    PyMem_Free(req_hashes);
+    PyMem_Free(hash_buf);
+    if (!ok) return NULL;
+    return PyLong_FromLong(applied);
+}
+
+
+/* seed_key(model_id, hash, packed_entries): import_view helper — insert
+ * entries for a request key WITHOUT touching the engine map. */
+static PyObject *Arena_seed_key(ArenaObject *self, PyObject *args) {
+    unsigned long model;
+    unsigned long long hash;
+    PyObject *ent_obj;
+    if (!PyArg_ParseTuple(args, "kKO", &model, &hash, &ent_obj)) return NULL;
+    uint64_t *packed = NULL;
+    Py_ssize_t np = 0;
+    if (parse_packed(ent_obj, &packed, &np) < 0) return NULL;
+    long added = 0;
+    Py_BEGIN_ALLOW_THREADS
+    pthread_mutex_lock(&self->mu);
+    KeyNode *n = key_get_or_create(self, (uint32_t)model, hash, NULL);
+    if (n) {
+        for (Py_ssize_t j = 0; j < np; j++) {
+            node_entry_add(self, n, packed[j]);
+            added++;
+        }
+    }
+    pthread_mutex_unlock(&self->mu);
+    Py_END_ALLOW_THREADS
+    PyMem_Free(packed);
+    return PyLong_FromLong(added);
+}
+
+/* seed_engine(model_id, hash, req_model_id, req_hash): import_view helper
+ * for one engine→request mapping. */
+static PyObject *Arena_seed_engine(ArenaObject *self, PyObject *args) {
+    unsigned long model, req_model;
+    unsigned long long hash, req_hash;
+    if (!PyArg_ParseTuple(args, "kKkK", &model, &hash, &req_model, &req_hash))
+        return NULL;
+    Py_BEGIN_ALLOW_THREADS
+    pthread_mutex_lock(&self->mu);
+    eng_add(self, (uint32_t)model, hash, (uint32_t)req_model, req_hash);
+    pthread_mutex_unlock(&self->mu);
+    Py_END_ALLOW_THREADS
+    Py_RETURN_NONE;
+}
+
+/* ---------------------------------------------------------------------- */
+/* Type + module                                                          */
+/* ---------------------------------------------------------------------- */
+
+static PyMethodDef Arena_methods[] = {
+    {"add", (PyCFunction)Arena_add, METH_VARARGS,
+     "add(engine_pairs, request_pairs, entries): Index.add over ids."},
+    {"evict", (PyCFunction)Arena_evict, METH_VARARGS,
+     "evict(model_id, hash, entries) -> removed | -1 on engine miss."},
+    {"get_request_key", (PyCFunction)Arena_get_request_key, METH_VARARGS,
+     "get_request_key(model_id, hash) -> (model_id, hash) | None."},
+    {"lookup_chain", (PyCFunction)Arena_lookup_chain, METH_VARARGS,
+     "lookup_chain(model_id, hashes) -> [(packed, ...), ...] (chain cut)."},
+    {"remove_matching", (PyCFunction)Arena_remove_matching, METH_VARARGS,
+     "remove_matching(pod_bitmap, tier_bitmap|None, pairs|None) -> n."},
+    {"seed_key", (PyCFunction)Arena_seed_key, METH_VARARGS,
+     "seed_key(model_id, hash, entries) -> n (import_view helper)."},
+    {"seed_engine", (PyCFunction)Arena_seed_engine, METH_VARARGS,
+     "seed_engine(model_id, hash, req_model_id, req_hash)."},
+    {"dump", (PyCFunction)Arena_dump, METH_NOARGS,
+     "dump() -> (entry_rows, engine_rows), oldest-first."},
+    {"stats", (PyCFunction)Arena_stats, METH_NOARGS,
+     "stats() -> dict of arena counters."},
+    {"score_batch", (PyCFunction)Arena_score_batch, METH_VARARGS,
+     "Fused lookup + longest-prefix score + adjustments, one crossing."},
+    {"apply_batch", (PyCFunction)Arena_apply_batch, METH_VARARGS,
+     "Apply decoded BlockStored/BlockRemoved events, one crossing."},
+    {NULL, NULL, 0, NULL},
+};
+
+static PyTypeObject ArenaType = {
+    PyVarObject_HEAD_INIT(NULL, 0).tp_name = "_kvtpu_kvscore.Arena",
+    .tp_basicsize = sizeof(ArenaObject),
+    .tp_itemsize = 0,
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_doc = "GIL-free KV-block index arena with a fused batch scorer.",
+    .tp_new = Arena_new,
+    .tp_dealloc = (destructor)Arena_dealloc,
+    .tp_methods = Arena_methods,
+};
+
+static struct PyModuleDef kvscore_module = {
+    PyModuleDef_HEAD_INIT,
+    "_kvtpu_kvscore",
+    "Native index arena + fused GIL-free batch scorer.",
+    -1,
+    NULL,
+};
+
+PyMODINIT_FUNC PyInit__kvtpu_kvscore(void) {
+    if (PyType_Ready(&ArenaType) < 0) return NULL;
+    PyObject *m = PyModule_Create(&kvscore_module);
+    if (!m) return NULL;
+    Py_INCREF(&ArenaType);
+    if (PyModule_AddObject(m, "Arena", (PyObject *)&ArenaType) < 0) {
+        Py_DECREF(&ArenaType);
+        Py_DECREF(m);
+        return NULL;
+    }
+    return m;
+}

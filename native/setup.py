@@ -30,8 +30,17 @@ setup(
         Extension(
             "_kvtpu_native",
             sources=[os.path.join(HERE, "fnvcbor.c")],
+            include_dirs=[HERE],
+            depends=[os.path.join(HERE, "kvhash.h")],
             extra_compile_args=["-O3"],
-        )
+        ),
+        Extension(
+            "_kvtpu_kvscore",
+            sources=[os.path.join(HERE, "kvscore.c")],
+            include_dirs=[HERE],
+            depends=[os.path.join(HERE, "kvhash.h")],
+            extra_compile_args=["-O3"],
+        ),
     ],
     cmdclass={"build_ext": BuildInPackage},
     script_args=sys.argv[1:] or ["build_ext"],

@@ -190,3 +190,15 @@ class TestDifferentialFuzz:
         finally:
             index.close()
             server.close()
+
+    def test_native(self, seed):
+        # The C arena (kvcache/kvblock/native_index.py) is an in-memory
+        # family member: cut-at-missing, continue past filtered-out keys.
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.native_index import (
+            NativeScoringIndex,
+            have_native_index,
+        )
+
+        if not have_native_index():
+            pytest.skip("native scoring core not built — run `make native`")
+        _fuzz(NativeScoringIndex(), cut="missing", seed=seed)

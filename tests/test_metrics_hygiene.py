@@ -148,6 +148,10 @@ def test_collectors_exist():
     # enforced.
     assert "autopilot_actuations" in collectors
     assert "autopilot_knob_position" in collectors
+    # Native scoring core (kvcache/kvblock/native_index.py): batches the
+    # C arena handed back to the pure-Python path. A plain counter — no
+    # labels — so it rides the namespace/label walks for free.
+    assert "native_fallbacks" in collectors
 
 
 def test_prefetch_drop_source_values_are_code_defined():

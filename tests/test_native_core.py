@@ -1,0 +1,678 @@
+"""Native scoring core (native/kvscore.c + kvcache/kvblock/native_index.py).
+
+The tentpole claim is bit-identity: the C arena's fused crossing (lookup +
+longest-prefix score + fleet-health/anti-entropy/routing adjustments) and
+its lock-free event digestion must be indistinguishable — score for score,
+state for state — from the pure-Python pipeline they replace. These tests
+pin that claim directly:
+
+- the Index contract (add/evict/lookup/get_request_key/remove_*/export/
+  import) against ShardedIndex on identical op sequences, exact error
+  messages included,
+- score_plan parity vs the full Python pipeline across LoRA keyspaces,
+  fleet-health states (deferred-refresh semantics), anti-entropy accuracy
+  demotions, and routing-policy load demotion — including the post-call
+  tracker state machines,
+- event-digest parity through EventPool's seam with adversarial wire
+  shapes (oversized ints, bytes, bools, bad LoRA ids, removal churn),
+- every fallback seam (non-native backend, custom scorer, crossing
+  error, non-native hash algo) lands on the Python path with the
+  fallback counter telling the story,
+- concurrent digest-while-scoring: readers on the seqlock'd path while a
+  writer mutates, then final-state equality with a sequential replay
+  (this is the test `make native-tsan` runs under ThreadSanitizer),
+- the /readyz `native_core` section and /score_explain surface.
+
+Most tests skip with a visible reason until `make native` has run; the
+fallback-seam tests for the NON-native paths run regardless.
+"""
+
+import asyncio
+import random
+import threading
+
+import pytest
+
+from tests.conftest import TEST_MODEL_NAME, TEST_TOKENIZER_JSON
+from llm_d_kv_cache_manager_tpu.antientropy.tracker import (
+    AntiEntropyConfig,
+    AntiEntropyTracker,
+)
+from llm_d_kv_cache_manager_tpu.fleethealth.tracker import (
+    FleetHealthConfig,
+    FleetHealthTracker,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import (
+    Indexer,
+    IndexerConfig,
+    ScoreRequest,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.index import (
+    IndexConfig,
+    new_index,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import Key, PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.native_index import (
+    NativeIndexConfig,
+    NativeScoringIndex,
+    fallback_total,
+    have_native_index,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.sharded import (
+    ShardedIndex,
+    ShardedIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.routing import (
+    LOAD_BLEND,
+    RoutingPolicy,
+    RoutingPolicyConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.scorer import LongestPrefixScorer
+from llm_d_kv_cache_manager_tpu.kvevents.events import (
+    AllBlocksCleared,
+    BlockRemoved,
+    BlockStored,
+    EventBatch,
+)
+from llm_d_kv_cache_manager_tpu.kvevents.pool import EventPool, EventPoolConfig
+from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+    TokenizationPool,
+    TokenizersPoolConfig,
+)
+
+needs_native = pytest.mark.skipif(
+    not have_native_index(),
+    reason="native scoring core (_kvtpu_kvscore) not built — run `make native`",
+)
+
+MODEL = "native-core-model"
+PODS = [f"pod-{i}" for i in range(6)] + ["pod-2@dp1"]
+TIERS = ["hbm", "host"]
+WEIGHTS = {"hbm": 1.0, "host": 0.8}
+
+
+def _pair(size=10_000):
+    return (
+        NativeScoringIndex(NativeIndexConfig(size=size, pod_cache_size=4)),
+        ShardedIndex(ShardedIndexConfig(size=size, pod_cache_size=4)),
+    )
+
+
+def _populate(rng, indexes, n_chains=10, models=(MODEL,)):
+    chains = {m: [] for m in models}
+    for model in models:
+        for _ in range(n_chains):
+            chain = [rng.getrandbits(64) for _ in range(rng.randint(1, 8))]
+            chains[model].append(chain)
+            for h in chain:
+                req = [Key(model, h)]
+                eng = [Key(model, h ^ 0xABCDEF)]
+                ents = [
+                    PodEntry(rng.choice(PODS), rng.choice(TIERS))
+                    for _ in range(rng.randint(1, 4))
+                ]
+                for ix in indexes:
+                    ix.add(eng, req, ents)
+    return chains
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class _Load:
+    """Deterministic per-pod load for the routing-policy legs."""
+
+    def __init__(self):
+        self.loads = {}
+
+    def load_of(self, pod, now=None):
+        class L:
+            pass
+
+        load = L()
+        load.queue_depth, load.busy_s, load.preemption_rate = self.loads.get(
+            pod, (0, 0.0, 0.0)
+        )
+        return load
+
+
+@needs_native
+class TestIndexContract:
+    def test_lookup_evict_request_key_parity(self):
+        rng = random.Random(11)
+        nat, sha = _pair()
+        chains = _populate(rng, (nat, sha), models=(MODEL, "other/model"))
+        for model, model_chains in chains.items():
+            for chain in model_chains[:3]:
+                ek = Key(model, chain[0] ^ 0xABCDEF)
+                ents = [PodEntry("pod-1", "hbm")]
+                nat.evict(ek, ents)
+                sha.evict(ek, ents)
+        assert nat.remove_pod("pod-3") == sha.remove_pod("pod-3")
+        rk = [Key(MODEL, c[0]) for c in chains[MODEL][:4]]
+        assert nat.remove_entries("pod-2", rk) == sha.remove_entries(
+            "pod-2", rk
+        )
+        for model, model_chains in chains.items():
+            for chain in model_chains:
+                keys = [Key(model, h) for h in chain]
+                for pods in (set(), {"pod-0", "pod-2"}, {"nope"}):
+                    a = nat.lookup(keys, pods)
+                    b = sha.lookup(keys, pods)
+                    assert {k: list(v) for k, v in a.items()} == {
+                        k: list(v) for k, v in b.items()
+                    }, (model, pods)
+                ek = Key(model, chain[0] ^ 0xABCDEF)
+                assert nat.get_request_key(ek) == sha.get_request_key(ek)
+        assert nat.get_request_key(Key("m/none", 1)) is None
+
+    def test_validation_errors_match_sharded(self):
+        nat, sha = _pair()
+        key = [Key(MODEL, 1)]
+        ents = [PodEntry("p", "hbm")]
+        for args in (
+            ("lookup", ([], set())),
+            ("add", ([], [], ents)),
+            ("add", (key, [], ents)),
+            ("add", (key + key, key, ents)),
+            ("evict", (Key(MODEL, 1), [])),
+        ):
+            name, call = args
+            with pytest.raises(ValueError) as nat_err:
+                getattr(nat, name)(*call)
+            with pytest.raises(ValueError) as sha_err:
+                getattr(sha, name)(*call)
+            assert str(nat_err.value) == str(sha_err.value), name
+
+    def test_export_import_round_trip(self):
+        rng = random.Random(5)
+        nat, _ = _pair()
+        chains = _populate(rng, (nat,))
+        view = nat.export_view()
+        fresh = NativeScoringIndex(NativeIndexConfig(size=10_000))
+        assert fresh.import_view(view) == view.entry_count()
+        for chain in chains[MODEL]:
+            keys = [Key(MODEL, h) for h in chain]
+            assert nat.lookup(keys, set()) == fresh.lookup(keys, set())
+            ek = Key(MODEL, chain[0] ^ 0xABCDEF)
+            assert nat.get_request_key(ek) == fresh.get_request_key(ek)
+
+    def test_config_knob_selects_native_backend(self):
+        config = IndexConfig.default()
+        config.native = True
+        assert isinstance(new_index(config), NativeScoringIndex)
+        # Off by default: the knob is opt-in.
+        assert not isinstance(new_index(IndexConfig.default()),
+                              NativeScoringIndex)
+
+
+@needs_native
+class TestScorePlanParity:
+    def _python_pipeline(self, specs, scorer, index, fh, ae, rp):
+        plan = []
+        for spec in specs:
+            if spec["ref"] is None:
+                hits = index.lookup(spec["keys"], set(spec["pods"]))
+                plan.append(
+                    ("solo", spec["keys"], hits, spec.get("forked", False))
+                )
+            else:
+                hits = (
+                    index.lookup(spec["tail"], set(spec["pods"]))
+                    if spec["tail"] else {}
+                )
+                plan.append(
+                    ("fork", spec["ref"], spec["shared"], spec["tail"], hits)
+                )
+        out = []
+        for scores, match in scorer.score_plan(plan):
+            if fh is not None:
+                scores = fh.filter_scores(scores)
+            if ae is not None:
+                scores = ae.adjust_scores(scores)
+            if rp is not None:
+                scores = rp.adjust(scores)
+            out.append((scores, match))
+        return out
+
+    def test_scores_match_python_across_tracker_states(self):
+        """Randomized solo+fork plans vs the Python pipeline under every
+        tracker combination: fleet-health aging (suspect demotion +
+        deferred refresh), anti-entropy accuracy factors, LOAD_BLEND
+        routing divisors. Scores, match blocks, routing stats, and the
+        post-call health state machines must all agree."""
+        rng = random.Random(7)
+        scorer = LongestPrefixScorer(WEIGHTS)
+        nat, sha = _pair()
+        chains = _populate(rng, (nat, sha), n_chains=12)[MODEL]
+        for trial in range(25):
+            clock = _Clock()
+            use_fh = trial % 2 == 0
+            use_ae = trial % 3 == 0
+            use_rp = trial % 4 == 0
+            fhs, aes, rps = [], [], []
+            load = _Load()
+            for p in PODS:
+                load.loads[p] = (
+                    rng.randint(0, 8), rng.random(), rng.random() * 4,
+                )
+            for _ in range(2):  # independent instances per side
+                fhs.append(
+                    FleetHealthTracker(
+                        FleetHealthConfig(
+                            suspect_after_s=10, stale_after_s=30,
+                            suspect_demotion_factor=0.5,
+                            auto_quarantine=False,
+                        ),
+                        clock=clock,
+                    ) if use_fh else None
+                )
+                aes.append(
+                    AntiEntropyTracker(AntiEntropyConfig(), clock=clock)
+                    if use_ae else None
+                )
+                rps.append(
+                    RoutingPolicy(
+                        RoutingPolicyConfig(
+                            policy=LOAD_BLEND, load_weight=0.7
+                        ),
+                        load_tracker=load,
+                    ) if use_rp else None
+                )
+            for fh in fhs:
+                if fh is None:
+                    continue
+                for p in PODS:
+                    fh.observe_batch(p, "t", None, clock.t)
+            clock.t += 15  # everyone ages to suspect…
+            for fh in fhs:
+                if fh is None:
+                    continue
+                fh.observe_batch("pod-0", "t", None, clock.t)  # …except one
+            for ae in aes:
+                if ae is None:
+                    continue
+                ae.observe_fetch_miss("pod-1", blocks=5)
+                ae.observe_audit("pod-4", verified=1, phantom=9)
+
+            base = rng.choice(chains)
+            keys = [Key(MODEL, h) for h in base]
+            pods_t = rng.choice(
+                [(), tuple(sorted(rng.sample(PODS, 3)))]
+            )
+            shared = rng.randint(1, len(keys))
+            tail = [Key(MODEL, h) for h in rng.choice(chains)][
+                : rng.randint(0, 3)
+            ]
+            specs = [
+                {"item": 0, "keys": keys, "ref": None, "pods": pods_t,
+                 "forked": True},
+                {"item": 1, "keys": keys[:shared] + tail, "ref": 0,
+                 "shared": shared, "tail": tail, "pods": pods_t},
+                {"item": 2, "keys": [Key(MODEL, h) for h in
+                                     rng.choice(chains)],
+                 "ref": None, "pods": ()},
+            ]
+            nat_out = nat.score_plan(
+                specs, WEIGHTS, fleet_health=fhs[0], antientropy=aes[0],
+                routing_policy=rps[0],
+            )
+            py_out = self._python_pipeline(
+                specs, scorer, sha, fhs[1], aes[1], rps[1]
+            )
+            for i, (a, b) in enumerate(zip(nat_out, py_out)):
+                assert a[0] == b[0], (trial, i, a[0], b[0])
+                assert a[1] == b[1], (trial, i)
+            if use_rp:
+                assert rps[0].stats == rps[1].stats, trial
+            if use_fh:
+                for p in PODS:
+                    assert fhs[0].state_of(p) == fhs[1].state_of(p), (
+                        trial, p,
+                    )
+
+
+@needs_native
+class TestDigestParity:
+    def test_event_stream_reaches_identical_state(self):
+        """Adversarial event stream (oversized ints, raw bytes, bools,
+        empty hashes, garbage LoRA ids, parent chaining, mixed mediums,
+        removals, clears) through EventPool's digest seam: the arena and
+        the Python ShardedIndex must hold the same logical state."""
+        rng = random.Random(99)
+        bs = 16
+        pools, indexes = [], []
+        for native in (True, False):
+            tp = ChunkedTokenDatabase(
+                TokenProcessorConfig(block_size=bs, chain_memo=False)
+            )
+            index = (
+                NativeScoringIndex(NativeIndexConfig(size=50_000))
+                if native else ShardedIndex(ShardedIndexConfig(size=50_000))
+            )
+            pools.append(EventPool(EventPoolConfig(), index, tp))
+            indexes.append(index)
+
+        def rand_hash():
+            choice = rng.randint(0, 9)
+            if choice < 6:
+                return rng.getrandbits(64)
+            if choice == 6:
+                return rng.getrandbits(96)  # masked to 64 bits
+            if choice == 7:
+                return rng.getrandbits(64).to_bytes(8, "big")
+            if choice == 8:
+                return True  # bool -> skipped
+            return b""  # empty -> skipped
+
+        stored = []
+        for i in range(200):
+            pod = rng.choice(PODS[:5])
+            kind = rng.randint(0, 5)
+            if kind <= 3:
+                n_blocks = rng.randint(1, 4)
+                toks = [
+                    rng.randint(0, 50000)
+                    for _ in range(n_blocks * bs + rng.randint(0, bs - 1))
+                ]
+                hashes = [rand_hash() for _ in range(n_blocks)]
+                parent = (
+                    rng.choice(rng.choice(stored))
+                    if stored and rng.random() < 0.5 else None
+                )
+                ev = BlockStored(
+                    block_hashes=hashes, parent_block_hash=parent,
+                    token_ids=toks, block_size=bs,
+                    lora_id=rng.choice([None, 0, 3, -1, True, "bad"]),
+                    medium=rng.choice([None, "hbm", "HOST"]),
+                )
+                good = [
+                    h for h in hashes
+                    if not isinstance(h, bool) and h != b""
+                ]
+                if good:
+                    stored.append(good)
+            elif kind == 4 and stored:
+                ev = BlockRemoved(
+                    block_hashes=list(rng.choice(stored)),
+                    medium=rng.choice([None, "hbm"]),
+                )
+            else:
+                ev = AllBlocksCleared()
+            batch = EventBatch(ts=1.0, events=[ev])
+            for pool in pools:
+                pool._digest_events(pod, MODEL, batch)  # noqa: SLF001
+
+        views = [ix.export_view() for ix in indexes]
+        # Same keys, same per-key pod tuples (the per-key LRU order the
+        # scorer folds), same engine mappings. Global view ORDER may
+        # differ: the arena keeps one LRU, the sharded index one per
+        # segment — cross-backend restore parity is pinned elsewhere.
+        state = [
+            {(e[0], e[1]): e[2] for e in v.entries} for v in views
+        ]
+        assert state[0] == state[1]
+        assert {
+            (r[0], r[1]): (r[2], r[3]) for r in views[0].engine_map
+        } == {
+            (r[0], r[1]): (r[2], r[3]) for r in views[1].engine_map
+        }
+        stats = indexes[0].native_status()
+        assert stats["blocks_applied"] > 0
+        assert stats["keys"] == len(state[0])
+
+
+@needs_native
+class TestConcurrentDigestWhileScoring:
+    def test_readers_race_writer_then_state_matches_replay(self):
+        """Reader threads hammer score_plan/lookup on the seqlock'd read
+        path while one writer digests event batches into the same arena.
+        No crash, no exception, and the final arena state equals a fresh
+        arena given the same batches sequentially (single-writer digest is
+        deterministic; readers must not perturb it)."""
+        bs = 16
+        rng = random.Random(3)
+        tp = ChunkedTokenDatabase(
+            TokenProcessorConfig(block_size=bs, chain_memo=False)
+        )
+        nat = NativeScoringIndex(NativeIndexConfig(size=100_000))
+        pool = EventPool(EventPoolConfig(), nat, tp)
+        toks = [rng.randint(0, 50000) for _ in range(bs * 4)]
+        batches = []
+        for i in range(400):
+            hashes = [i * 4 + j + 1 for j in range(4)]
+            events = [BlockStored(
+                block_hashes=hashes, parent_block_hash=None,
+                token_ids=toks, block_size=bs,
+            )]
+            if i % 5 == 4:
+                events.append(BlockRemoved(block_hashes=hashes[:2]))
+            batches.append(EventBatch(ts=float(i), events=events))
+
+        errors = []
+        stop = threading.Event()
+
+        def reader(seed):
+            r = random.Random(seed)
+            try:
+                while not stop.is_set():
+                    view = nat.export_view()
+                    if view.entries:
+                        row = r.choice(view.entries)
+                        key = Key(row[0], row[1])
+                        specs = [{
+                            "item": 0, "keys": [key], "ref": None,
+                            "pods": (),
+                        }]
+                        out = nat.score_plan(specs, WEIGHTS)
+                        assert len(out) == 1
+                        nat.lookup([key], set())
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        readers = [
+            threading.Thread(target=reader, args=(s,)) for s in range(4)
+        ]
+        for t in readers:
+            t.start()
+        for i, b in enumerate(batches):
+            pool._digest_events(f"pod-{i % 4}", MODEL, b)  # noqa: SLF001
+        stop.set()
+        for t in readers:
+            t.join(timeout=30)
+        assert not errors, errors
+
+        replay = NativeScoringIndex(NativeIndexConfig(size=100_000))
+        replay_pool = EventPool(EventPoolConfig(), replay, tp)
+        for i, b in enumerate(batches):
+            replay_pool._digest_events(  # noqa: SLF001
+                f"pod-{i % 4}", MODEL, b
+            )
+        got = {(e[0], e[1]): e[2] for e in nat.export_view().entries}
+        want = {(e[0], e[1]): e[2] for e in replay.export_view().entries}
+        assert got == want
+        # The seqlock's contended-retry escape hatch is observable: the
+        # stat exists and never goes negative (usually 0; a locked lookup
+        # is correctness fallback, not failure).
+        assert nat.native_status()["locked_lookups"] >= 0
+
+
+class TestFallbackSeams:
+    def test_non_native_backend_is_not_a_fallback(self):
+        """An ordinary Python backend takes the ordinary path: no native
+        attempt, no fallback counted."""
+        indexer = _make_indexer(ShardedIndex())
+        try:
+            before = fallback_total()
+            reqs = [ScoreRequest(prompt="a b c", model_name=TEST_MODEL_NAME)]
+            indexer.score_many(reqs)
+            assert fallback_total() == before
+        finally:
+            indexer.shutdown()
+
+    @needs_native
+    def test_crossing_error_falls_back_and_counts(self, monkeypatch):
+        """A native-crossing failure degrades to the Python path — same
+        scores as a healthy Python backend — and increments the counter."""
+        rng = random.Random(13)
+        nat = NativeScoringIndex(NativeIndexConfig(size=4096))
+        indexer = _make_indexer(nat)
+        try:
+            prompt = "the quick brown fox jumps over the lazy dog " * 4
+            _seed(indexer, prompt, "pod-x")
+            healthy = indexer.score_many(
+                [ScoreRequest(prompt=prompt, model_name=TEST_MODEL_NAME)]
+            )
+            monkeypatch.setattr(
+                nat, "score_plan",
+                lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")),
+            )
+            before = fallback_total()
+            broken = indexer.score_many(
+                [ScoreRequest(prompt=prompt, model_name=TEST_MODEL_NAME)]
+            )
+            assert fallback_total() == before + 1
+            assert broken[0].scores == healthy[0].scores
+            assert broken[0].match_blocks == healthy[0].match_blocks
+        finally:
+            indexer.shutdown()
+        del rng
+
+    @needs_native
+    def test_non_native_hash_algo_digests_in_python(self):
+        """The digest seam only engages for fnv64_cbor chains (the hash
+        the C core reimplements); any other algo takes the Python loop and
+        still lands the blocks."""
+        tp = ChunkedTokenDatabase(
+            TokenProcessorConfig(
+                block_size=4, chain_memo=False,
+                hash_algo="sha256_cbor_64bit", hash_seed="42",
+            )
+        )
+        nat = NativeScoringIndex(NativeIndexConfig(size=4096))
+        pool = EventPool(EventPoolConfig(), nat, tp)
+        batch = EventBatch(ts=1.0, events=[BlockStored(
+            block_hashes=[1, 2], parent_block_hash=None,
+            token_ids=list(range(8)), block_size=4,
+        )])
+        pool._digest_events("pod-0", MODEL, batch)  # noqa: SLF001
+        assert nat.native_status()["blocks_applied"] == 0  # Python loop
+        assert nat.stats()["keys"] == 2  # …but the blocks landed
+
+    @needs_native
+    def test_fallback_counter_reaches_prometheus(self):
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock import native_index
+        from llm_d_kv_cache_manager_tpu.metrics import collector as metrics
+
+        metrics.register_metrics()
+        native_index.count_fallback()
+        assert metrics.native_fallbacks is not None
+        assert metrics.native_fallbacks._value.get() > 0  # noqa: SLF001
+
+
+def _make_indexer(kv_block_index, fleet_health=None):
+    indexer = Indexer(
+        config=IndexerConfig(
+            token_processor_config=TokenProcessorConfig(block_size=4),
+        ),
+        tokenization_pool=TokenizationPool(
+            TokenizersPoolConfig(
+                workers=2,
+                local_tokenizer_files={TEST_MODEL_NAME: TEST_TOKENIZER_JSON},
+            ),
+        ),
+        kv_block_index=kv_block_index,
+        fleet_health=fleet_health,
+    )
+    indexer.run()
+    return indexer
+
+
+def _seed(indexer, prompt, pod):
+    enc = indexer.tokenizers_pool.tokenizer.encode(prompt, TEST_MODEL_NAME)
+    keys = indexer.token_processor.tokens_to_kv_block_keys(
+        None, enc.tokens, TEST_MODEL_NAME
+    )
+    engine_keys = [Key(TEST_MODEL_NAME, 50_000 + i) for i in range(len(keys))]
+    indexer.kv_block_index.add(engine_keys, keys, [PodEntry(pod, "hbm")])
+    return len(keys)
+
+
+class TestHttpSurfaces:
+    def _service(self, kv_block_index):
+        from llm_d_kv_cache_manager_tpu.api.http_service import ScoringService
+
+        env = {
+            "zmq_endpoint": "tcp://*:0",
+            "zmq_topic": "kv@",
+            "pool_concurrency": 1,
+            "hash_seed": "",
+            "block_size": 4,
+            "http_port": 0,
+            "enable_metrics": False,
+        }
+        return ScoringService(env, indexer=_make_indexer(kv_block_index))
+
+    @needs_native
+    def test_readyz_native_core_section_enabled(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        service = self._service(
+            NativeScoringIndex(NativeIndexConfig(size=4096))
+        )
+        prompt = "a quick native readiness probe " * 3
+        _seed(service.indexer, prompt, "pod-n")
+
+        async def run():
+            async with TestClient(TestServer(service.make_app())) as client:
+                service.start(with_subscriber=False)
+                resp = await client.get("/readyz")
+                assert resp.status == 200
+                section = (await resp.json())["native_core"]
+                assert section["enabled"] is True
+                assert section["keys"] > 0
+                assert section["fallbacks"] >= 0
+                assert "blocks_applied" in section
+
+                resp = await client.get(
+                    "/debug/score_explain",
+                    params={"prompt": prompt, "model": TEST_MODEL_NAME},
+                )
+                assert resp.status == 200
+                explain = await resp.json()
+                assert explain["native_core"]["enabled"] is True
+
+        try:
+            asyncio.run(run())
+        finally:
+            service.stop()
+            service.indexer.shutdown()
+
+    def test_readyz_native_core_section_disabled(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        service = self._service(ShardedIndex())
+
+        async def run():
+            async with TestClient(TestServer(service.make_app())) as client:
+                service.start(with_subscriber=False)
+                resp = await client.get("/readyz")
+                section = (await resp.json())["native_core"]
+                assert section["enabled"] is False
+                assert section["module_available"] == have_native_index()
+                assert section["fallbacks"] >= 0
+
+        try:
+            asyncio.run(run())
+        finally:
+            service.stop()
+            service.indexer.shutdown()

@@ -103,6 +103,20 @@ def _backend_factories(fake_redis_url=None):
         factories["redis"] = lambda: RedisIndex(
             RedisIndexConfig(url=fake_redis_url)
         )
+    # The C arena backend: score_many takes the fused native crossing
+    # (indexer._native_score_plan) while the sequential singles walk the
+    # ordinary Python lookup+score path over the SAME arena, so the
+    # bit-identity suites pin native-vs-Python score parity directly.
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock.native_index import (
+        NativeIndexConfig,
+        NativeScoringIndex,
+        have_native_index,
+    )
+
+    if have_native_index():
+        factories["native"] = lambda: NativeScoringIndex(
+            NativeIndexConfig(size=4096, pod_cache_size=10)
+        )
     return factories
 
 
@@ -211,11 +225,14 @@ def _assert_identical(batch_results, single_results):
 
 class TestBitIdentity:
     @pytest.mark.parametrize(
-        "backend", ["in_memory", "sharded", "cost_aware", "redis"]
+        "backend", ["in_memory", "sharded", "cost_aware", "redis", "native"]
     )
     def test_score_many_equals_single_calls(self, backend, fake_redis):
         rng = random.Random(42)
-        factory = _backend_factories(fake_redis.url)[backend]
+        factories = _backend_factories(fake_redis.url)
+        if backend not in factories:
+            pytest.skip("native scoring core not built — run `make native`")
+        factory = factories[backend]
         indexer = _make_indexer(kv_block_index=factory())
         try:
             shared = _text(rng, 30)
@@ -323,6 +340,65 @@ class TestBitIdentity:
                 tracker.state_of(p) for p in ("pod-1", "pod-2")
             }
             assert states == {"suspect", "stale"}  # scenario actually bites
+        finally:
+            indexer.shutdown()
+
+    def test_fleet_health_states_native(self):
+        """The same healthy/suspect/stale scenario on the C arena backend:
+        the native crossing folds the demotion factors in-kernel (tier
+        weight x suspect factor) and defers the tracker's state-machine
+        refresh until after the crossing — scores must still match the
+        sequential singles bit for bit, and the settled tracker state must
+        be the same one the Python path reaches."""
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.native_index import (
+            NativeIndexConfig,
+            NativeScoringIndex,
+            have_native_index,
+        )
+
+        if not have_native_index():
+            pytest.skip("native scoring core not built — run `make native`")
+        clock = Clock()
+        tracker = FleetHealthTracker(
+            FleetHealthConfig(suspect_after_s=10.0, stale_after_s=30.0),
+            clock=clock,
+        )
+        rng = random.Random(7)
+        indexer = _make_indexer(
+            kv_block_index=NativeScoringIndex(
+                NativeIndexConfig(size=4096, pod_cache_size=10)
+            ),
+            fleet_health=tracker,
+        )
+        try:
+            prompts = [_text(rng, 20), _text(rng, 25)]
+            _populate(indexer, rng, prompts)
+            _warm_tokenization(indexer, prompts)
+            clock.t = 0.0
+            tracker.observe_batch("pod-2", "kv@pod-2@m", 0, ts=0.0)
+            clock.t = 20.0
+            tracker.observe_batch("pod-1", "kv@pod-1@m", 0, ts=20.0)
+            clock.t = 34.0
+            tracker.observe_batch("pod-0", "kv@pod-0@m", 0, ts=34.0)
+            clock.t = 35.0
+            reqs = [
+                ScoreRequest(prompt=p, model_name=TEST_MODEL_NAME)
+                for p in prompts
+            ] * 2
+            # Settle the one-shot stale purge first (see the Python-backend
+            # variant above for why).
+            for p in prompts:
+                indexer.get_pod_scores_ex(p, TEST_MODEL_NAME, [])
+            singles = [
+                indexer.get_pod_scores_ex(
+                    r.prompt, r.model_name, r.pod_identifiers,
+                    lora_id=r.lora_id,
+                )
+                for r in reqs
+            ]
+            _assert_identical(indexer.score_many(reqs), singles)
+            states = {tracker.state_of(p) for p in ("pod-1", "pod-2")}
+            assert states == {"suspect", "stale"}
         finally:
             indexer.shutdown()
 
