@@ -5,7 +5,8 @@
 .PHONY: native native-asan native-tsan kvtransfer test bench bench-micro \
 	bench-read bench-obs bench-batch bench-native bench-faults bench-chaos \
 	bench-divergence bench-replication bench-placement bench-anticipate \
-	bench-autoscale bench-autopilot bench-geo bench-transfer clean proto \
+	bench-autoscale bench-autopilot bench-pressure bench-geo \
+	bench-transfer clean proto \
 	lint precommit-install image-build image-push
 
 # Container image coordinates (override per environment/registry). The
@@ -200,6 +201,15 @@ bench-autoscale: kvtransfer
 # benchmarking/FLEET_BENCH_AUTOPILOT.json.
 bench-autopilot: kvtransfer
 	JAX_PLATFORMS=cpu python bench.py --autopilot
+
+# Resource-governor pressure scenario (resourcegov/): adversarial
+# flood + session-storm replay governed vs ungoverned (byte budget,
+# pressure-tiered shed ladder), a churn-storm leg with departed-pod
+# reaping, and the feature-off headline bit-identity pin. Pure
+# control-plane sim — no native libs needed. Headless; rewrites
+# benchmarking/FLEET_BENCH_PRESSURE.json.
+bench-pressure:
+	JAX_PLATFORMS=cpu python bench.py --pressure
 
 # Hierarchical-federation geo scenario (federation/): home-pinned sessions
 # with diurnal skew across regions, one region lost mid-replay; flat global

@@ -5328,6 +5328,425 @@ def main_autoscale(args):
     }))
 
 
+# Resource-governor pressure scenario (--pressure; resourcegov/): the
+# adversarial workloads (workloads/adversarial.py) replayed through the
+# sim's REAL control-plane structures — chain memo, prefix store, session
+# table, popularity tracker, KV-block index — with a ResourceAccountant
+# metering them on the same evaluation-grid idiom as the autopilot arm:
+#   ungoverned   flood + session storm, no governor: the accounted-bytes
+#                column must grow monotonically PAST 2x the budget (the
+#                leak the governor exists to cap).
+#   governed     the SAME replay with the governor ticking on the grid:
+#                accounted bytes must hold <= budget for the whole run
+#                while retaining >= 80% of the ungoverned hit rate (the
+#                ladder sheds re-derivable support state before index
+#                capacity, and index sheds take the LRU tail — flood
+#                garbage — first).
+#   churn storm  the cache-friendly churn trace over an elastic fleet
+#                whose roster follows churn_schedule(): with the
+#                DepartureReaper wired to membership leave, per-pod map
+#                cardinality (fleet health / load / anti-entropy rows)
+#                tracks the LIVE pods; the unreaped arm accumulates one
+#                row per pod that EVER existed.
+#   no_pressure  the headline precise arm rerun with resourcegov
+#                importable but OFF — its committed FLEET_BENCH.json
+#                fields must reproduce byte-identically (md5 over the
+#                canonical serialization), the feature-off bit-identity
+#                pin every control-plane PR carries.
+# Oversized pods (PRESSURE_PAGES_PER_POD) keep device eviction from
+# masking control-plane growth: the index must grow with every unique
+# flood prompt, not plateau at device capacity.
+PRESSURE_BUDGET_MB = 8.0
+PRESSURE_EVAL_DT_S = 1.0
+PRESSURE_COOLDOWN_S = 1.0
+PRESSURE_PAGES_PER_POD = 8192
+# Per-entry byte estimates, mirrored from the service wiring
+# (api/http_service.py): estimates by design — the budget is a policy
+# ceiling over the accounted sum, deterministic under the sim clock.
+PRESSURE_BYTES_PER_ENTRY = {
+    "sessions": 512.0,
+    "popularity": 256.0,
+    "chain_memo": 256.0,
+    "prefix_store": 4096.0,
+    "index": 1024.0,
+}
+
+
+def build_pressure_requests():
+    """Flood + session storm merged into one arrival-ordered stream.
+    Session namespaces are disjoint (f* vs x*), so the merge is a pure
+    interleave: per-session turn order survives the sort."""
+    from llm_d_kv_cache_manager_tpu.workloads import (
+        generate_flood,
+        generate_session_explosion,
+    )
+
+    flood = generate_flood()
+    storm = generate_session_explosion()
+    requests = flood.requests() + storm.requests()
+    requests.sort(key=lambda r: (r.arrival_s, r.session, r.turn))
+    return flood, storm, requests
+
+
+def _pressure_accountant(sim):
+    """Meter the sim's live control-plane structures — the same opt-in
+    hooks (`entries()` + `shed(fraction)`) the service wiring registers,
+    pointed at the sim's instances."""
+    from llm_d_kv_cache_manager_tpu.resourcegov import (
+        Meter,
+        ResourceAccountant,
+    )
+
+    acc = ResourceAccountant()
+    acc.register(Meter(
+        "sessions",
+        entries=sim.session_table.sessions,
+        bytes_per_entry=PRESSURE_BYTES_PER_ENTRY["sessions"],
+        shed=sim.session_table.shed,
+    ))
+    acc.register(Meter(
+        "popularity",
+        entries=sim.popularity.entries,
+        bytes_per_entry=PRESSURE_BYTES_PER_ENTRY["popularity"],
+        shed=sim.popularity.shed,
+    ))
+    memo = sim.indexer.token_processor.chain_memo
+    if memo is not None:
+        acc.register(Meter(
+            "chain_memo",
+            entries=memo.entries,
+            bytes_per_entry=PRESSURE_BYTES_PER_ENTRY["chain_memo"],
+            shed=memo.shed,
+        ))
+    store = sim.indexer.prefix_store
+    if hasattr(store, "entries") and hasattr(store, "shed"):
+        acc.register(Meter(
+            "prefix_store",
+            entries=store.entries,
+            bytes_per_entry=PRESSURE_BYTES_PER_ENTRY["prefix_store"],
+            shed=store.shed,
+        ))
+    index = sim.indexer.kv_block_index
+    inner = getattr(index, "inner", index)
+
+    def _index_entries():
+        sizes = getattr(inner, "segment_sizes", None)
+        if sizes is not None:
+            return sum(sizes())
+        data = getattr(inner, "_data", None)
+        return len(data) if data is not None else 0
+
+    acc.register(Meter(
+        "index",
+        entries=_index_entries,
+        bytes_per_entry=PRESSURE_BYTES_PER_ENTRY["index"],
+        shed=getattr(inner, "shed", None),
+    ))
+    return acc
+
+
+def run_pressure_arm(governed: bool):
+    """One adversarial replay (flood + session storm). `governed` wires
+    a ResourceGovernor over the accountant and ticks it on the grid;
+    the ungoverned arm samples the same accountant without actuating."""
+    from llm_d_kv_cache_manager_tpu.resourcegov import (
+        ResourceGovConfig,
+        ResourceGovernor,
+    )
+
+    _flood, _storm, requests = build_pressure_requests()
+    sim = FleetSim(
+        "precise",
+        pages_per_pod=PRESSURE_PAGES_PER_POD,
+        placement=dict(AUTOPILOT_PLACEMENT_BASE),
+        prediction={},
+    )
+    accountant = _pressure_accountant(sim)
+    governor = None
+    if governed:
+        governor = ResourceGovernor(
+            accountant,
+            ResourceGovConfig(
+                budget_mb=PRESSURE_BUDGET_MB,
+                cooldown_s=PRESSURE_COOLDOWN_S,
+                min_interval_s=PRESSURE_EVAL_DT_S,
+            ),
+            clock=lambda: sim.now,
+        )
+    timeline = []  # (t, accounted_bytes, level) on the evaluation grid
+    next_eval = [PRESSURE_EVAL_DT_S]
+
+    def _evaluate(now):
+        # Governed samples are taken AFTER the tick: the acceptance is
+        # on what the governor leaves behind, not on the instant before
+        # it acts.
+        if governor is not None:
+            governor.tick(now)
+        timeline.append((
+            round(now, 3),
+            int(accountant.total_bytes()),
+            governor.level if governor is not None else "off",
+        ))
+
+    try:
+        for req in requests:
+            while next_eval[0] <= req.arrival_s:
+                _evaluate(next_eval[0])
+                next_eval[0] += PRESSURE_EVAL_DT_S
+            sim.serve(req.arrival_s, req.prompt,
+                      response_words=req.output_len)
+        _evaluate(next_eval[0])  # final sample past the last arrival
+        hit_rate = sim.hit_tokens / max(sim.total_tokens, 1)
+        peak = max(b for _t, b, _lvl in timeline)
+        return {
+            "requests": len(requests),
+            "hit_rate": round(hit_rate, 4),
+            "timeline": timeline,
+            "peak_accounted_bytes": peak,
+            "final_accounted_bytes": timeline[-1][1],
+            "meters": {
+                name: doc["entries"]
+                for name, doc in sorted(accountant.snapshot().items())
+            },
+            "governor": governor.status() if governor is not None else None,
+        }
+    finally:
+        sim.shutdown()
+
+
+def run_pressure_churn(reaped: bool):
+    """The churn-storm leg: the cache-friendly trace served while the
+    roster follows churn_schedule() through the full membership
+    choreography. `reaped` wires a DepartureReaper's forget_pod fan-out
+    to every leave — the treatment whose per-pod map cardinality must
+    track LIVE pods; the unreaped arm shows the cumulative leak."""
+    from llm_d_kv_cache_manager_tpu.fleethealth import FleetHealthConfig
+    from llm_d_kv_cache_manager_tpu.resourcegov import DepartureReaper
+    from llm_d_kv_cache_manager_tpu.workloads import (
+        ChurnStormConfig,
+        churn_schedule,
+        generate_churn_storm,
+    )
+
+    cfg = ChurnStormConfig()
+    requests = generate_churn_storm(cfg).requests()
+    schedule = churn_schedule(cfg)
+    sim = FleetSim(
+        "precise",
+        n_pods=cfg.base_pods,
+        routing_policy=dict(AUTOSCALE_POLICY),
+        membership={},
+        health_config=FleetHealthConfig(),
+        antientropy=dict(AUTOPILOT_AE_CFG, seed=42),
+    )
+    reaper = None
+    if reaped:
+        reaper = DepartureReaper()
+        reaper.register("fleethealth", sim.health.forget_pod)
+        reaper.register("load", sim.load_tracker.forget_pod)
+        reaper.register("antientropy", sim.antientropy.forget_pod)
+    # schedule name ("churn-i") -> sim pod id ("pod-j"); join order.
+    roster = {}
+    live = {f"pod-{i}" for i in range(cfg.base_pods)}
+    ever = set(live)
+    cardinality = []  # (t, live, ever, fleethealth, load, antientropy)
+
+    def _record(now):
+        cardinality.append((
+            round(now, 3),
+            len(live),
+            len(ever),
+            sim.health.entries(),
+            sim.load_tracker.entries(),
+            sim.antientropy.entries(),
+        ))
+
+    def _apply(event):
+        at, action, name = event
+        sim.now = max(sim.now, at)
+        if action == "join":
+            joins = sim.scale_out(1)
+            pod_id = next(iter(joins))
+            roster[name] = pod_id
+            live.add(pod_id)
+            ever.add(pod_id)
+        else:
+            pod_id = roster[name]
+            sim.scale_in(int(pod_id.split("-")[1]))
+            live.discard(pod_id)
+            if reaper is not None:
+                reaper.reap(pod_id)
+        _record(sim.now)
+
+    pending = list(schedule)
+    try:
+        for req in requests:
+            while pending and pending[0][0] <= req.arrival_s:
+                _apply(pending.pop(0))
+            sim.serve(req.arrival_s, req.prompt,
+                      response_words=req.output_len)
+        # The roster script outlives the short trace on purpose: the
+        # leak (or its absence) keeps accumulating with zero traffic.
+        while pending:
+            _apply(pending.pop(0))
+        hit_rate = sim.hit_tokens / max(sim.total_tokens, 1)
+        final = cardinality[-1]
+        return {
+            "requests": len(requests),
+            "churn_events": len(schedule),
+            "hit_rate": round(hit_rate, 4),
+            "cardinality": cardinality,
+            "final": {
+                "live_pods": final[1],
+                "ever_pods": final[2],
+                "fleethealth_rows": final[3],
+                "load_rows": final[4],
+                "antientropy_rows": final[5],
+            },
+            "reaper": reaper.status() if reaper is not None else None,
+        }
+    finally:
+        sim.shutdown()
+
+
+# The committed-headline fields the no-pressure arm must reproduce.
+PRESSURE_PIN_FIELDS = (
+    "prefix_hit_rate", "ttft_p50_precise_s", "ttft_mean_precise_s",
+)
+
+
+def run_pressure_baseline():
+    """Feature-off bit-identity pin: rerun the headline precise +
+    round-robin arms with resourcegov imported (the code is resident,
+    the governor simply never constructed — exactly the RESOURCEGOV=0
+    service) and md5-compare the canonical serialization of the
+    headline fields against the committed FLEET_BENCH.json."""
+    import hashlib
+
+    import llm_d_kv_cache_manager_tpu.resourcegov  # noqa: F401
+
+    ttft_precise, hit_rate, _read_p50, _ = run_strategy("precise")
+    ttft_rr, _, _, _ = run_strategy("round_robin")
+    rerun = {
+        "prefix_hit_rate": round(hit_rate, 4),
+        "ttft_p50_precise_s": round(p50(ttft_precise), 4),
+        "ttft_mean_precise_s": round(
+            sum(ttft_precise) / len(ttft_precise), 4
+        ),
+    }
+    doc = {
+        "rerun": rerun,
+        "rerun_ttft_p50_round_robin_s": round(p50(ttft_rr), 4),
+    }
+    fleet_bench = os.path.join(REPO, "benchmarking", "FLEET_BENCH.json")
+    if os.path.exists(fleet_bench):
+        with open(fleet_bench, "rb") as f:
+            raw = f.read()
+        committed = {
+            k: json.loads(raw).get(k) for k in PRESSURE_PIN_FIELDS
+        }
+        canon = lambda d: json.dumps(  # noqa: E731
+            d, sort_keys=True, separators=(",", ":")
+        ).encode()
+        doc.update({
+            "committed": committed,
+            "fleet_bench_md5": hashlib.md5(raw).hexdigest(),
+            "rerun_md5": hashlib.md5(canon(rerun)).hexdigest(),
+            "committed_md5": hashlib.md5(canon(committed)).hexdigest(),
+            "byte_identical": canon(rerun) == canon(committed),
+        })
+    else:
+        doc["byte_identical"] = None
+    return doc
+
+
+def main_pressure(args):
+    """--pressure: the resource-governor acceptance run. Writes
+    benchmarking/FLEET_BENCH_PRESSURE.json."""
+    t_start = time.time()
+    ungoverned = run_pressure_arm(governed=False)
+    governed = run_pressure_arm(governed=True)
+    churn_reaped = run_pressure_churn(reaped=True)
+    churn_unreaped = run_pressure_churn(reaped=False)
+    baseline = run_pressure_baseline()
+
+    budget_bytes = int(PRESSURE_BUDGET_MB * 1024 * 1024)
+    un_bytes = [b for _t, b, _lvl in ungoverned["timeline"]]
+    monotonic = all(b2 >= b1 for b1, b2 in zip(un_bytes, un_bytes[1:]))
+    retention = round(
+        governed["hit_rate"] / max(ungoverned["hit_rate"], 1e-9), 4
+    )
+    reaped_rows = [
+        max(fh, ld) for _t, _lv, _ev, fh, ld, _ae
+        in churn_reaped["cardinality"]
+    ]
+    reaped_live = [
+        lv for _t, lv, _ev, _fh, _ld, _ae in churn_reaped["cardinality"]
+    ]
+    verdicts = {
+        "governed_held_budget": (
+            governed["peak_accounted_bytes"] <= budget_bytes
+        ),
+        "hit_retention_ge_80pct": retention >= 0.8,
+        "ungoverned_monotonic": monotonic,
+        "ungoverned_past_2x_budget": (
+            ungoverned["peak_accounted_bytes"] > 2 * budget_bytes
+        ),
+        # Tracking live means bounded BY live at every churn sample;
+        # the unreaped control must end with the cumulative roster.
+        "churn_rows_track_live": all(
+            rows <= lv for rows, lv in zip(reaped_rows, reaped_live)
+        ),
+        "churn_unreaped_cumulative": (
+            churn_unreaped["final"]["fleethealth_rows"]
+            >= churn_unreaped["final"]["ever_pods"] - 1
+            > churn_unreaped["final"]["live_pods"]
+        ),
+        "no_pressure_bit_identical": baseline.get("byte_identical"),
+    }
+    stats = {
+        "scenario": {
+            "budget_mb": PRESSURE_BUDGET_MB,
+            "eval_dt_s": PRESSURE_EVAL_DT_S,
+            "cooldown_s": PRESSURE_COOLDOWN_S,
+            "pages_per_pod": PRESSURE_PAGES_PER_POD,
+            "bytes_per_entry": PRESSURE_BYTES_PER_ENTRY,
+        },
+        "arms": {
+            "ungoverned": ungoverned,
+            "governed": governed,
+            "churn_reaped": churn_reaped,
+            "churn_unreaped": churn_unreaped,
+        },
+        "no_pressure": baseline,
+        "hit_retention": retention,
+        "verdicts": verdicts,
+        "wall_s": round(time.time() - t_start, 1),
+    }
+    print(json.dumps(stats), file=sys.stderr)
+    artifact = {k: v for k, v in stats.items() if k != "wall_s"}
+    out = os.path.join(REPO, "benchmarking", "FLEET_BENCH_PRESSURE.json")
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({
+        "metric": "pressure_hit_retention_governed_vs_ungoverned",
+        "value": retention,
+        "unit": "fraction (target >= 0.8)",
+        "governed_peak_mb": round(
+            governed["peak_accounted_bytes"] / 1024 / 1024, 2
+        ),
+        "ungoverned_peak_mb": round(
+            ungoverned["peak_accounted_bytes"] / 1024 / 1024, 2
+        ),
+        "budget_mb": PRESSURE_BUDGET_MB,
+        "churn_final_rows_reaped": churn_reaped["final"],
+        "churn_final_rows_unreaped": churn_unreaped["final"],
+        "verdicts_met": all(bool(v) for v in verdicts.values()),
+        "source": "benchmarking/FLEET_BENCH_PRESSURE.json",
+    }))
+
+
 def run_batch_window_arm(window: int, qps: float = QPS):
     """The synthetic chat workload served through router arrival windows:
     requests are grouped into windows of `window` arrivals, each window
@@ -5887,6 +6306,15 @@ def parse_args(argv=None):
              "benchmarking/FLEET_BENCH_AUTOPILOT.json",
     )
     ap.add_argument(
+        "--pressure", action="store_true",
+        help="run the resource-governor scenario (resourcegov/ "
+             "subsystem): adversarial flood + session-storm replay "
+             "governed vs ungoverned (byte budget, shed ladder), a "
+             "churn-storm leg with departed-pod reaping, and the "
+             "feature-off headline bit-identity pin, writing "
+             "benchmarking/FLEET_BENCH_PRESSURE.json",
+    )
+    ap.add_argument(
         "--replication", action="store_true",
         help="run the indexer kill-and-restart scenario (FaultPlan "
              "indexer_crash) over the ShareGPT replay: cold restart vs "
@@ -5912,6 +6340,8 @@ if __name__ == "__main__":
         main_cluster_check(_args)
     elif _args.autopilot:
         main_autopilot(_args)
+    elif _args.pressure:
+        main_pressure(_args)
     elif _args.replication:
         main_replication(_args)
     elif _args.divergence:

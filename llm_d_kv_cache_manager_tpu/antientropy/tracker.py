@@ -206,6 +206,22 @@ class AntiEntropyTracker:
                 # never earn its trust back.
                 self._observe_accuracy(rec, 1.0)
 
+    def forget_pod(self, pod_identifier: str) -> int:
+        """Drop a departed pod's trust record (the resourcegov reap hook;
+        DP-ranked identities fold onto the base key). Forgetting resets
+        the pod to the unseen default — accuracy 1.0 — which is correct
+        for a departure: a pod that comes back is a new pod and earns
+        distrust only from new evidence. Returns rows removed (0 or 1)."""
+        pod = base_pod_identifier(pod_identifier)
+        with self._mu:
+            return 1 if self._pods.pop(pod, None) is not None else 0
+
+    def entries(self) -> int:
+        """Tracked per-pod trust rows — the resource accountant's O(1)
+        meter read."""
+        with self._mu:
+            return len(self._pods)
+
     # -- read-path hook ----------------------------------------------------
 
     def accuracy(self, pod_identifier: str) -> float:

@@ -378,6 +378,25 @@ def config_from_env() -> dict:
         "autopilot_decay_after_s": float(
             os.environ.get("AUTOPILOT_DECAY_AFTER_S", "15")
         ),
+        # Resource governor (resourcegov/): RESOURCEGOV=1 attaches the
+        # accountant + pressure state machine over every stateful
+        # structure this construction wired. Ticks ride the /readyz and
+        # /resource/status poll cadence — no background thread. The
+        # departed-entity reaper is attached unconditionally (membership
+        # leaves must shrink per-pod maps with or without a budget).
+        # RESOURCEGOV=0 (default) leaves the governor None; a fleet that
+        # never crosses the budget sheds nothing and scores
+        # bit-identically either way.
+        "resourcegov": os.environ.get("RESOURCEGOV", "0") == "1",
+        "resourcegov_budget_mb": float(
+            os.environ.get("RESOURCEGOV_BUDGET_MB", "256")
+        ),
+        "resourcegov_cooldown_s": float(
+            os.environ.get("RESOURCEGOV_COOLDOWN_S", "10")
+        ),
+        "resourcegov_rss_probe": (
+            os.environ.get("RESOURCEGOV_RSS_PROBE", "0") == "1"
+        ),
     }
 
 
@@ -783,6 +802,7 @@ class ScoringService:
         # transfer client) are resolved lazily per snapshot.
         self.autopilot = None
         self.autopilot_registry = None
+        self.autopilot_signals = None
         if env.get("autopilot"):
             from llm_d_kv_cache_manager_tpu.autopilot import (
                 AutopilotConfig,
@@ -808,6 +828,9 @@ class ScoringService:
                     ),
                 },
             )
+            # Kept visible so the resourcegov block (wired below) can
+            # attach itself as the memory_pressure source.
+            self.autopilot_signals = assembler
             self.autopilot = AutopilotController(
                 self.autopilot_registry,
                 assembler,
@@ -822,6 +845,156 @@ class ScoringService:
                     ),
                 ),
             )
+
+        # Resource governance (resourcegov/): two planes with different
+        # opt-ins. The departed-entity REAPER is always constructed —
+        # per-pod rows must be able to die with their pod whether or not
+        # a byte budget is configured (a leak fix, not a pressure
+        # policy). Embedders that own a FleetMembership attach it with
+        # `membership.reaper = service.reaper`; under RESOURCEGOV=1 the
+        # fleet-health stale-quarantine path fans out through it too.
+        # The GOVERNOR (accountant + pressure state machine + shed
+        # ladder) attaches only under RESOURCEGOV=1, metering exactly
+        # the structures this construction wired; its ticks ride the
+        # /readyz and /resource/status poll cadence — no background
+        # thread.
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import (
+            base_pod_identifier,
+        )
+        from llm_d_kv_cache_manager_tpu.resourcegov import DepartureReaper
+
+        self.reaper = DepartureReaper()
+        self.reaper.register("fleethealth", self.fleet_health.forget_pod)
+        if self.load_tracker is not None:
+            self.reaper.register("load", self.load_tracker.forget_pod)
+        if self.antientropy is not None:
+            self.reaper.register(
+                "antientropy", self.antientropy.forget_pod
+            )
+
+        def _reap_transfer(pod_identifier: str) -> int:
+            # Resolved per reap, like the autopilot's lazy sources: the
+            # transfer client usually appears after construction. Peer
+            # addressing uses the base pod identity as the host.
+            client = self.transfer_client or _peek_transfer_client()
+            if client is None:
+                return 0
+            return client.forget_host(base_pod_identifier(pod_identifier))
+
+        self.reaper.register("transfer", _reap_transfer)
+
+        self.resourcegov = None
+        self.resource_accountant = None
+        if env.get("resourcegov"):
+            from llm_d_kv_cache_manager_tpu.resourcegov import (
+                STRUCT_ANTIENTROPY,
+                STRUCT_CHAIN_MEMO,
+                STRUCT_FLEETHEALTH,
+                STRUCT_INDEX,
+                STRUCT_LOAD,
+                STRUCT_OBS,
+                STRUCT_POPULARITY,
+                STRUCT_PREFIX_STORE,
+                STRUCT_SESSIONS,
+                STRUCT_TRANSFER_PEERS,
+                Meter,
+                ResourceAccountant,
+                ResourceGovConfig,
+                ResourceGovernor,
+            )
+
+            accountant = ResourceAccountant()
+            recorder = obs.get_recorder()
+            accountant.register(Meter(
+                STRUCT_OBS, recorder.entries,
+                bytes_per_entry=2048.0, shed=recorder.shed,
+            ))
+            if self.session_table is not None:
+                accountant.register(Meter(
+                    STRUCT_SESSIONS, self.session_table.sessions,
+                    bytes_per_entry=512.0, shed=self.session_table.shed,
+                ))
+            if self.popularity is not None:
+                sketch = self.popularity.sketch
+                accountant.register(Meter(
+                    STRUCT_POPULARITY, self.popularity.entries,
+                    bytes_per_entry=256.0,
+                    fixed_bytes=float(sketch.width * sketch.depth * 8),
+                    shed=self.popularity.shed,
+                ))
+            memo = self.indexer.token_processor.chain_memo
+            if memo is not None:
+                accountant.register(Meter(
+                    STRUCT_CHAIN_MEMO, memo.entries,
+                    bytes_per_entry=256.0, shed=memo.shed,
+                ))
+            store = getattr(self.indexer, "prefix_store", None)
+            if store is not None and hasattr(store, "shed"):
+                accountant.register(Meter(
+                    STRUCT_PREFIX_STORE, store.entries,
+                    bytes_per_entry=4096.0, shed=store.shed,
+                ))
+            index = self.indexer.kv_block_index
+            inner = getattr(index, "inner", index)
+
+            def _index_entries() -> int:
+                sizes = getattr(inner, "segment_sizes", None)
+                if callable(sizes):
+                    return sum(sizes())
+                data = getattr(inner, "_data", None)
+                return len(data) if data is not None else 0
+
+            accountant.register(Meter(
+                STRUCT_INDEX, _index_entries,
+                bytes_per_entry=1024.0,
+                shed=getattr(inner, "shed", None),
+            ))
+            accountant.register(Meter(
+                STRUCT_FLEETHEALTH, self.fleet_health.entries,
+                bytes_per_entry=512.0,
+            ))
+            if self.load_tracker is not None:
+                accountant.register(Meter(
+                    STRUCT_LOAD, self.load_tracker.entries,
+                    bytes_per_entry=256.0,
+                ))
+            if self.antientropy is not None:
+                accountant.register(Meter(
+                    STRUCT_ANTIENTROPY, self.antientropy.entries,
+                    bytes_per_entry=256.0,
+                ))
+
+            def _transfer_entries() -> int:
+                client = self.transfer_client or _peek_transfer_client()
+                return client.entries() if client is not None else 0
+
+            accountant.register(Meter(
+                STRUCT_TRANSFER_PEERS, _transfer_entries,
+                bytes_per_entry=4096.0,
+            ))
+
+            self.resource_accountant = accountant
+            self.resourcegov = ResourceGovernor(
+                accountant,
+                ResourceGovConfig(
+                    budget_mb=float(
+                        env.get("resourcegov_budget_mb", 256.0)
+                    ),
+                    cooldown_s=float(
+                        env.get("resourcegov_cooldown_s", 10.0)
+                    ),
+                    rss_probe=bool(
+                        env.get("resourcegov_rss_probe", False)
+                    ),
+                ),
+            )
+            # Under governance, a stale quarantine reaps like an
+            # explicit leave (same fan-out, same idempotent hooks).
+            self.fleet_health.on_departed = self.reaper.reap
+            if self.autopilot_registry is not None:
+                self.resourcegov.register_knobs(self.autopilot_registry)
+            if self.autopilot_signals is not None:
+                self.autopilot_signals.resourcegov = self.resourcegov
 
     def start(self, with_subscriber: bool = True) -> None:
         self.indexer.run()
@@ -1223,6 +1396,13 @@ class ScoringService:
             # Never gates readiness — the fallback path is bit-identical,
             # just slower.
             "native_core": self._native_core_section(),
+            # Resource governor: accounted bytes per structure, pressure
+            # level, shed ladder + actuation journal, reaper stats. The
+            # /readyz poll is one of the governor's tick cadences (rate-
+            # limited internally). NEVER gates readiness — even critical
+            # pressure means the process is shedding re-derivable caches
+            # to keep serving: degraded, but ready by construction.
+            "resource": self._resource_section(),
         }
 
     def _native_core_section(self) -> dict:
@@ -1250,6 +1430,18 @@ class ScoringService:
             return None
         self.autopilot.tick()
         return self.autopilot.status()
+
+    def _resource_section(self) -> Optional[dict]:
+        if self.resourcegov is None:
+            # No governor: still surface the reaper (it runs either way)
+            # once it has fanned out at least one departure.
+            if self.reaper.stats_counters["reaps"]:
+                return {"reaper": self.reaper.status()}
+            return None
+        self.resourcegov.tick()
+        section = self.resourcegov.status()
+        section["reaper"] = self.reaper.status()
+        return section
 
     def _index_health_section(self) -> Optional[dict]:
         if self.antientropy is None:
@@ -1359,6 +1551,28 @@ class ScoringService:
             )
         return web.json_response(
             await asyncio.to_thread(self._autopilot_section)
+        )
+
+    async def handle_resource_status(
+        self, request: web.Request
+    ) -> web.Response:
+        """Resource-governor introspection: one governor tick (rate-
+        limited internally), then the status document the /readyz
+        `resource` section embeds (per-structure meters, pressure level,
+        shed ladder, actuation journal, reaper stats). Critical pressure
+        is a degraded-but-ready condition — this endpoint never serves
+        503 on its own."""
+        if self.resourcegov is None:
+            return web.json_response(
+                {
+                    "error": "resource governor disabled "
+                             "(set RESOURCEGOV=1)",
+                    "reaper": self.reaper.status(),
+                },
+                status=400,
+            )
+        return web.json_response(
+            await asyncio.to_thread(self._resource_section)
         )
 
     async def handle_placement_status(self, request: web.Request) -> web.Response:
@@ -1615,6 +1829,7 @@ class ScoringService:
         app.router.add_post("/cluster/snapshot", self.handle_cluster_snapshot)
         app.router.add_get("/slo/status", self.handle_slo_status)
         app.router.add_get("/autopilot/status", self.handle_autopilot_status)
+        app.router.add_get("/resource/status", self.handle_resource_status)
         app.router.add_get(
             "/debug/critical_path", self.handle_debug_critical_path
         )

@@ -46,6 +46,7 @@ KNOB_PREDICTION_JOBS = "prediction.max_jobs_per_tick"
 KNOB_TRANSFER_HEDGE_FLOOR = "transfer.hedge_delay_floor_s"
 KNOB_ADMISSION_QUEUE = "admission.max_queue_depth"
 KNOB_AUDIT_INTERVAL = "antientropy.interval_s"
+KNOB_RESOURCEGOV_BUDGET = "resourcegov.budget_mb"
 AUTOPILOT_KNOBS = (
     KNOB_PLACEMENT_K,
     KNOB_PLACEMENT_JOBS,
@@ -53,6 +54,7 @@ AUTOPILOT_KNOBS = (
     KNOB_TRANSFER_HEDGE_FLOOR,
     KNOB_ADMISSION_QUEUE,
     KNOB_AUDIT_INTERVAL,
+    KNOB_RESOURCEGOV_BUDGET,
 )
 
 

@@ -55,6 +55,9 @@ class SignalSnapshot:
     prefetch_drops: Dict[str, int] = field(default_factory=dict)
     # Load plane: {pod: load dict} (PodLoadTracker.snapshot).
     load: Dict[str, dict] = field(default_factory=dict)
+    # Memory plane: accounted-bytes / budget from the resource governor
+    # (0.0 with no governor attached — absent pressure is no pressure).
+    memory_pressure: float = 0.0
 
     def objective_status(self, objective: str) -> str:
         doc = self.slo.get("objectives", {}).get(objective)
@@ -78,12 +81,17 @@ class SignalAssembler:
         transfer_client=None,
         antientropy=None,
         prefetchers: Optional[Dict[str, object]] = None,
+        resourcegov=None,
         clock: Callable[[], float] = time.monotonic,
     ):
         self.slo_monitor = slo_monitor
         self.load_tracker = load_tracker
         self.transfer_client = transfer_client
         self.antientropy = antientropy
+        # resourcegov.ResourceGovernor (or anything with a `pressure()`
+        # float): the memory plane. Attached after construction by the
+        # service wiring (the governor meters subsystems built later).
+        self.resourcegov = resourcegov
         # {plane_name: RoutePrefetcher} — the service attaches e.g.
         # {"placement": ..., "prediction": ...}; drops are summed per
         # SOURCE label across them (the queues already tag per source).
@@ -173,6 +181,13 @@ class SignalAssembler:
             except Exception:  # noqa: BLE001
                 load = {}
 
+        memory_pressure = 0.0
+        if self.resourcegov is not None:
+            try:
+                memory_pressure = float(self.resourcegov.pressure())
+            except Exception:  # noqa: BLE001
+                memory_pressure = 0.0
+
         return SignalSnapshot(
             t=now,
             slo=slo_doc,
@@ -184,4 +199,5 @@ class SignalAssembler:
             min_accuracy=min_accuracy,
             prefetch_drops=drops,
             load=load,
+            memory_pressure=memory_pressure,
         )

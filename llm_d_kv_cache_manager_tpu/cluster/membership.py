@@ -258,6 +258,12 @@ class FleetMembership:
         self.watermark_fn = watermark_fn
         self.journal_fn = journal_fn
         self.clock = clock
+        # resourcegov.DepartureReaper (optional): leave() fans the
+        # departure out to every registered per-pod forget hook after the
+        # quarantine/purge, so breaker rows, trust EWMAs, load records
+        # and negative-cache entries die with the pod instead of
+        # accumulating across churn. Attached by the service wiring.
+        self.reaper = None
         self._mu = threading.Lock()
         self._phase: Dict[str, str] = {}
         self._since: Dict[str, float] = {}
@@ -402,9 +408,17 @@ class FleetMembership:
             self.table.clear_override(pod)
             self._refresh_filters()
         self._transition(pod, LEFT)
+        reaped = 0
+        if self.reaper is not None:
+            try:
+                reaped = sum(self.reaper.reap(pod).values())
+            except Exception as e:  # noqa: BLE001 - a reap failure must
+                # not fail the departure; the pod is already unroutable
+                logger.warning("departure reap failed for %s: %s", pod, e)
         with self._mu:
             self.stats["leaves"] += 1
-        return {"pod": pod, "purged_entries": purged}
+        return {"pod": pod, "purged_entries": purged,
+                "reaped_rows": reaped}
 
     # -- partition handoff -------------------------------------------------
 

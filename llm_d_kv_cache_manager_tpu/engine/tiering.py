@@ -945,6 +945,25 @@ class IndexBackedPeerResolver:
                 k: t for k, t in self._negative.items() if t > now
             }
 
+    def forget_pod(self, pod_identifier: str) -> int:
+        """Departure reap hook: drop every negative-cache entry addressed
+        to the departed pod (resolved through `pod_addrs` by bare
+        identity). Its phantom-miss memory protects nothing once the pod
+        is gone, and a replacement pod reusing the address must not
+        inherit its predecessor's disclaimers. Returns rows removed."""
+        bare = base_pod_identifier(pod_identifier)
+        addr = self.pod_addrs.get(pod_identifier) or self.pod_addrs.get(bare)
+        if addr is None or not self._negative:
+            return 0
+        victims = [k for k in self._negative if k[0] == addr]
+        for k in victims:
+            self._negative.pop(k, None)
+        return len(victims)
+
+    def negative_entries(self) -> int:
+        """Current negative-cache cardinality (the resourcegov meter)."""
+        return len(self._negative)
+
     def _negatively_cached(
         self, addr: Tuple[str, int], chunk_hash: int, now: float
     ) -> bool:

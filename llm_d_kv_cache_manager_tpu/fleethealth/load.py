@@ -197,6 +197,21 @@ class PodLoadTracker:
                 out.busy_s = max(0.0, rec.busy_at - now)
             return out
 
+    def forget_pod(self, pod_identifier: str) -> int:
+        """Drop a departed pod's load record (the resourcegov reap hook;
+        DP-rank-qualified identities fold onto their base key). A
+        returning pod re-learns from its first report. Returns rows
+        removed (0 or 1 — load is one record per base identity)."""
+        pod = base_pod_identifier(pod_identifier)
+        with self._mu:
+            return 1 if self._pods.pop(pod, None) is not None else 0
+
+    def entries(self) -> int:
+        """Tracked per-pod load rows — the resource accountant's O(1)
+        meter read."""
+        with self._mu:
+            return len(self._pods)
+
     def snapshot(self, now: Optional[float] = None) -> Dict[str, dict]:
         """{pod: load dict} for /readyz-style introspection."""
         if now is None:

@@ -51,6 +51,9 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import (
+    base_pod_identifier,
+)
 from llm_d_kv_cache_manager_tpu.metrics import collector as metrics
 from llm_d_kv_cache_manager_tpu.utils import logging as kvlog
 
@@ -155,6 +158,11 @@ class FleetHealthTracker:
         # transfer NIC is dark — so it is reported alongside, not merged
         # into, the pod liveness state machine.
         self._transfer_peers: Dict[str, dict] = {}
+        # Departure seam: fired (outside the lock) after a stale
+        # quarantine finishes purging, with the pod identity — the
+        # resourcegov DepartureReaper attaches here so a pod that is gone
+        # in practice is reaped like one that left on purpose.
+        self.on_departed: Optional[Callable[[str], None]] = None
 
     def bind_index(self, index) -> None:
         """Late-bind the index quarantine target (Indexer wiring order)."""
@@ -318,6 +326,7 @@ class FleetHealthTracker:
 
     def _purge(self, pod: str) -> None:
         if self.index is None:
+            self._fire_departed(pod)
             return
         try:
             removed = self.index.remove_pod(pod)
@@ -334,6 +343,17 @@ class FleetHealthTracker:
             "quarantined stale pod %s: purged %d index entr%s",
             pod, removed, "y" if removed == 1 else "ies",
         )
+        self._fire_departed(pod)
+
+    def _fire_departed(self, pod: str) -> None:
+        cb = self.on_departed
+        if cb is None:
+            return
+        try:
+            cb(pod)
+        except Exception as e:  # noqa: BLE001 - the reap fan-out must
+            # never unwind the state machine that detected the departure
+            logger.warning("on_departed callback failed for %s: %s", pod, e)
 
     def quarantine(self, pod_identifier: str) -> int:
         """Force a pod stale and purge its index entries now. Returns the
@@ -350,12 +370,49 @@ class FleetHealthTracker:
                 self._transition(rec, pod_identifier, STALE, now)
                 rec.stale_detected_at = now
         if self.index is None:
+            self._fire_departed(pod_identifier)
             return 0
         removed = self.index.remove_pod(pod_identifier)
         metrics.count_stale_purged(removed)
         with self._mu:
-            self._pods[pod_identifier].purged_entries += removed
+            rec = self._pods.get(pod_identifier)
+            if rec is not None:
+                rec.purged_entries += removed
+        self._fire_departed(pod_identifier)
         return removed
+
+    def forget_pod(self, pod_identifier: str) -> int:
+        """Drop every record belonging to a departed pod — all DP-rank-
+        qualified variants of its base identity, plus its transfer-peer
+        breaker rows (peer host == base identity). The tracker re-learns
+        a returning pod from its first decoded batch; forgetting costs
+        anomaly history, never correctness. Returns rows removed."""
+        base = base_pod_identifier(pod_identifier)
+        removed = 0
+        with self._mu:
+            for key in [
+                k for k in self._pods if base_pod_identifier(k) == base
+            ]:
+                del self._pods[key]
+                removed += 1
+            for peer in [
+                p for p in self._transfer_peers
+                if p.rsplit(":", 1)[0] == base
+            ]:
+                del self._transfer_peers[peer]
+                removed += 1
+        if removed:
+            logger.info(
+                "forgot departed pod %s: %d fleet-health row(s)",
+                pod_identifier, removed,
+            )
+        return removed
+
+    def entries(self) -> int:
+        """Tracked per-pod + per-peer rows — the resource accountant's
+        O(1) meter read."""
+        with self._mu:
+            return len(self._pods) + len(self._transfer_peers)
 
     def state_of(self, pod_identifier: str, now: Optional[float] = None) -> str:
         """Current state; pods the tracker has never seen are healthy (an

@@ -276,6 +276,14 @@ class TransferClientConfig:
     # deviation — a p99 proxy), clamped to [floor, cap].
     hedge_delay_floor_s: float = 0.005
     hedge_delay_cap_s: float = 2.0
+    # Idle-TTL on per-peer state: pooled keep-alive connections and
+    # peer failure-memory rows untouched for this long are closed/
+    # dropped by `sweep_idle` (ridden by `status()` — no threads).
+    # A peer whose breaker is NOT closed is never dropped: an open
+    # breaker on a live peer is active protection, and it re-closes
+    # through its own half-open probe, not through forgetting. 0
+    # disables the sweep (the seed behavior).
+    peer_idle_ttl_s: float = 0.0
 
     @classmethod
     def from_env(cls) -> "TransferClientConfig":
@@ -298,6 +306,9 @@ class TransferClientConfig:
             hedge_delay_cap_s=_env_float(
                 "KVTPU_TRANSFER_HEDGE_CAP_MS", 2000.0
             ) / 1e3,
+            peer_idle_ttl_s=_env_float(
+                "KVTPU_TRANSFER_PEER_IDLE_TTL_S", 0.0
+            ),
         )
 
 
@@ -423,6 +434,7 @@ class _PeerState:
     __slots__ = (
         "key", "breaker", "lock", "lat_ewma", "lat_dev", "lat_n",
         "fetches", "failures", "corrupt_blocks", "breaker_skips",
+        "last_used",
     )
 
     _ALPHA = 0.2  # EWMA smoothing for the latency profile
@@ -440,6 +452,7 @@ class _PeerState:
         self.failures = 0
         self.corrupt_blocks = 0
         self.breaker_skips = 0
+        self.last_used = 0.0
 
     def note_latency(self, seconds: float) -> None:
         with self.lock:
@@ -468,11 +481,12 @@ class _PeerState:
 
 
 class _Conn:
-    __slots__ = ("fd", "lock")
+    __slots__ = ("fd", "lock", "last_used")
 
     def __init__(self):
         self.fd = -1
         self.lock = threading.Lock()
+        self.last_used = 0.0
 
 
 class TransferClient:
@@ -537,7 +551,8 @@ class TransferClient:
             "batch_fetches": 0, "blocks_fetched": 0,
             "corrupt_blocks": 0, "oversized_blocks": 0,
             "breaker_skipped_blocks": 0, "hedges": 0, "hedge_wins": 0,
-            "missing_blocks": 0,
+            "missing_blocks": 0, "idle_closed_conns": 0,
+            "idle_dropped_peers": 0, "reaped_peers": 0,
         }
 
     def _conn(self, host: str, port: int) -> _Conn:
@@ -545,6 +560,7 @@ class TransferClient:
             conn = self._pool.get((host, port))
             if conn is None:
                 conn = self._pool[(host, port)] = _Conn()
+            conn.last_used = self.clock()
             return conn
 
     def peer_state(self, host: str, port: int) -> _PeerState:
@@ -554,7 +570,71 @@ class TransferClient:
                 peer = self._peers[(host, port)] = _PeerState(
                     f"{host}:{port}", self.config
                 )
+            peer.last_used = self.clock()
             return peer
+
+    def sweep_idle(self, now: Optional[float] = None) -> int:
+        """Close pooled connections and drop peer failure-memory rows
+        untouched for `peer_idle_ttl_s` (0 disables). Lazy and clock-
+        driven — `status()` rides it, the resource governor's reap plane
+        may call it on its own cadence. Peer rows whose breaker is not
+        CLOSED survive any idle age: an open breaker is live protection
+        for the next fetch, and dropping it would reset the peer to
+        trusted mid-outage. Returns rows removed (conns + peers)."""
+        ttl = self.config.peer_idle_ttl_s
+        if ttl <= 0:
+            return 0
+        if now is None:
+            now = self.clock()
+        removed = 0
+        to_close: List[_Conn] = []
+        with self._mu:
+            for addr in [
+                a for a, c in self._pool.items()
+                if now - c.last_used >= ttl
+            ]:
+                to_close.append(self._pool.pop(addr))
+            for addr in [
+                a for a, p in self._peers.items()
+                if now - p.last_used >= ttl
+                and p.breaker.state == BREAKER_CLOSED
+            ]:
+                del self._peers[addr]
+                self.stats["idle_dropped_peers"] += 1
+                removed += 1
+        for conn in to_close:
+            with conn.lock:
+                self._drop(conn)
+            self.stats["idle_closed_conns"] += 1
+            removed += 1
+        return removed
+
+    def forget_host(self, host: str) -> int:
+        """Departure reap hook: drop every pooled connection and peer row
+        addressed to `host`, whatever its port and breaker state — the
+        pod behind the address left the fleet, so its failure memory
+        protects nothing and its sockets lead nowhere. Returns rows
+        removed."""
+        removed = 0
+        to_close: List[_Conn] = []
+        with self._mu:
+            for addr in [a for a in self._pool if a[0] == host]:
+                to_close.append(self._pool.pop(addr))
+            for addr in [a for a in self._peers if a[0] == host]:
+                del self._peers[addr]
+                self.stats["reaped_peers"] += 1
+                removed += 1
+        for conn in to_close:
+            with conn.lock:
+                self._drop(conn)
+            removed += 1
+        return removed
+
+    def entries(self) -> int:
+        """Per-peer rows + pooled connections — the resource accountant's
+        O(1) meter read."""
+        with self._mu:
+            return len(self._peers) + len(self._pool)
 
     def _ensure_connected(self, conn: _Conn, host: str, port: int) -> bool:
         if conn.fd >= 0:
@@ -927,14 +1007,18 @@ class TransferClient:
         aggregate counters plus per-peer breaker state, consecutive
         failures, and the EWMA fetch-latency profile."""
         now = self.clock()
+        self.sweep_idle(now)
         with self._mu:
             peers = dict(self._peers)
+            pooled = len(self._pool)
         return {
             "stats": dict(self.stats),
             "breaker": {
                 "failure_threshold": self.config.breaker_failure_threshold,
                 "cooldown_s": self.config.breaker_cooldown_s,
             },
+            "pooled_connections": pooled,
+            "peer_idle_ttl_s": self.config.peer_idle_ttl_s,
             "verify_integrity": (
                 self.config.verify_integrity and integrity_api_available()
             ),

@@ -226,6 +226,32 @@ class InMemoryIndex(Index):
                     self._engine_to_request.remove(engine_key)
         return removed
 
+    def shed(self, fraction: float) -> int:
+        """Resource-governor hook: drop the oldest `fraction` of request
+        keys — the LRU tail, exactly what capacity eviction would reclaim
+        next, so a shed is indistinguishable from running at a smaller
+        index. A dropped block stops scoring until its pod re-advertises
+        it (re-derivable state, never truth). Returns pod entries removed."""
+        fraction = min(max(fraction, 0.0), 1.0)
+        if fraction <= 0.0:
+            return 0
+        removed = 0
+        emptied = set()
+        keys = self._data.keys()
+        for request_key in keys[: int(len(keys) * fraction)]:
+            pod_cache = self._data.peek(request_key)
+            if pod_cache is None:
+                continue
+            with pod_cache.mu:
+                removed += len(pod_cache.cache)
+            self._data.remove(request_key)
+            emptied.add(request_key)
+        if emptied:
+            for engine_key, request_key in self._engine_to_request.items():
+                if request_key in emptied:
+                    self._engine_to_request.remove(engine_key)
+        return removed
+
     def remove_entries(
         self, pod_identifier: str, request_keys, device_tiers=None
     ) -> int:

@@ -424,6 +424,36 @@ class ShardedIndex(Index):
                         seg.engine_to_request.remove(engine_key)
         return removed
 
+    def shed(self, fraction: float) -> int:
+        """Resource-governor hook: drop the oldest `fraction` of request
+        keys in every segment — the LRU tail, exactly what capacity
+        eviction would reclaim next, so a shed is indistinguishable from
+        running at a smaller index. The segment LRU's on_evict hook
+        prunes each dropped key's read-view entry under the segment
+        lock; engine mappings pointing at a dropped key are swept after.
+        Returns pod entries removed."""
+        fraction = min(max(fraction, 0.0), 1.0)
+        if fraction <= 0.0:
+            return 0
+        removed = 0
+        emptied = set()
+        for seg in self._segments:
+            keys = seg.data.keys()
+            for request_key in keys[: int(len(keys) * fraction)]:
+                pod_cache = seg.data.peek(request_key)
+                if pod_cache is None:
+                    continue
+                with pod_cache.mu:
+                    removed += len(pod_cache.cache)
+                seg.data.remove(request_key)
+                emptied.add(request_key)
+        if emptied:
+            for seg in self._segments:
+                for engine_key, request_key in seg.engine_to_request.items():
+                    if request_key in emptied:
+                        seg.engine_to_request.remove(engine_key)
+        return removed
+
     def remove_entries(
         self, pod_identifier: str, request_keys, device_tiers=None
     ) -> int:

@@ -171,6 +171,15 @@ index_divergence_negative_skips: Optional[Counter] = None
 autopilot_actuations: Optional[Counter] = None
 autopilot_knob_position: Optional[Gauge] = None
 
+# Resource governor (resourcegov/): accounted bytes per structure,
+# pressure-level transitions, and shed actuations. Both labels take
+# values from FIXED code vocabularies (RESOURCE_STRUCTURES in
+# resourcegov/accountant.py, RESOURCE_LEVELS in resourcegov/
+# governor.py) — structure/level topology, never traffic.
+resource_accounted_bytes: Optional[Gauge] = None
+resource_pressure_transitions: Optional[Counter] = None
+resource_shed_events: Optional[Counter] = None
+
 _APPLY_DELAY_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
     5.0, 10.0, 30.0, 60.0,
@@ -213,6 +222,8 @@ def register_metrics(registry=None) -> None:
     global index_divergence_readmitted, index_divergence_audits
     global index_divergence_negative_skips
     global autopilot_actuations, autopilot_knob_position
+    global resource_accounted_bytes, resource_pressure_transitions
+    global resource_shed_events
 
     with _register_lock:
         if _registered:
@@ -599,6 +610,27 @@ def register_metrics(registry=None) -> None:
             labelnames=("knob",),
             registry=reg,
         )
+        resource_accounted_bytes = Gauge(
+            "kvcache_resource_accounted_bytes",
+            "Estimated bytes held by each registered stateful structure "
+            "(the resource governor's accounting plane)",
+            labelnames=("structure",),
+            registry=reg,
+        )
+        resource_pressure_transitions = Counter(
+            "kvcache_resource_pressure_transitions_total",
+            "Memory-pressure level transitions, labeled by the level "
+            "entered (ok / elevated / critical)",
+            labelnames=("level",),
+            registry=reg,
+        )
+        resource_shed_events = Counter(
+            "kvcache_resource_shed_events_total",
+            "Shed-ladder actuations applied by the resource governor, "
+            "by structure",
+            labelnames=("structure",),
+            registry=reg,
+        )
         _registered = True
 
 
@@ -871,6 +903,21 @@ def count_autopilot_actuation(rule: str, direction: str) -> None:
 def set_autopilot_knob_position(knob: str, value: float) -> None:
     if autopilot_knob_position is not None:
         autopilot_knob_position.labels(knob=knob).set(value)
+
+
+def set_resource_accounted_bytes(structure: str, n: float) -> None:
+    if resource_accounted_bytes is not None:
+        resource_accounted_bytes.labels(structure=structure).set(n)
+
+
+def count_pressure_transition(level: str) -> None:
+    if resource_pressure_transitions is not None:
+        resource_pressure_transitions.labels(level=level).inc()
+
+
+def count_shed_event(structure: str, n: int = 1) -> None:
+    if resource_shed_events is not None and n:
+        resource_shed_events.labels(structure=structure).inc(n)
 
 
 def counter_value(c: Optional[Counter]) -> float:

@@ -81,6 +81,30 @@ class FlightRecorder:
             self._completed = 0
             self._dropped = 0
 
+    def shed(self, fraction: float) -> int:
+        """Resource-governor hook: drop the oldest `fraction` of the ring
+        and the fastest `fraction` of the slow reservoir (heap roots —
+        the least interesting outliers go first, the slowest evidence
+        survives longest). Pure diagnostics loss: no score, route, or
+        counter depends on a retained trace. Returns traces dropped."""
+        fraction = min(max(fraction, 0.0), 1.0)
+        dropped = 0
+        with self._mu:
+            n_ring = int(len(self._ring) * fraction)
+            for _ in range(n_ring):
+                self._ring.popleft()
+            n_slow = int(len(self._slow) * fraction)
+            for _ in range(n_slow):
+                heapq.heappop(self._slow)
+            dropped = n_ring + n_slow
+        return dropped
+
+    def entries(self) -> int:
+        """Retained traces (ring + slow reservoir) — the resource
+        accountant's O(1) meter read."""
+        with self._mu:
+            return len(self._ring) + len(self._slow)
+
     # -- introspection -----------------------------------------------------
 
     def recent(self, n: Optional[int] = None) -> List[_spans.Trace]:

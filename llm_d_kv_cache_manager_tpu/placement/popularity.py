@@ -250,6 +250,7 @@ class ChainPopularityTracker:
             "admissions": 0,
             "displacements": 0,
             "rejected_cold": 0,
+            "shed_chains": 0,
         }
 
     # -- ingest ------------------------------------------------------------
@@ -384,6 +385,46 @@ class ChainPopularityTracker:
             prefix_tokens=list(prefix_tokens or []),
             observations=1,
         )
+
+    def shed(self, fraction: float) -> int:
+        """Resource-governor hook: drop the coldest `fraction` of the
+        top-K table (by decayed score at shed time) and scale every
+        sketch cell down by the same fraction — popularity is a decayed
+        rate, so a uniform down-scale is indistinguishable from letting
+        extra half-lives elapse; genuinely hot chains re-earn their
+        admission within a few observations. Returns chains dropped."""
+        fraction = min(max(fraction, 0.0), 1.0)
+        now = self.clock()
+        half_life = self.config.half_life_s
+        with self._mu:
+            n = int(len(self._chains) * fraction)
+            if n > 0:
+                by_cold = sorted(
+                    self._chains.items(),
+                    key=lambda kv: kv[1].decayed_score(now, half_life),
+                )
+                for head, _ in by_cold[:n]:
+                    del self._chains[head]
+                self.stats_counters["shed_chains"] += n
+            if fraction > 0.0 and fraction < 1.0:
+                # Equivalent to _rescale's renormalization, with a decay
+                # multiplier instead of an inflation reset.
+                keep = 1.0 - fraction
+                for row in self.sketch.rows:
+                    for i, v in enumerate(row):
+                        if v:
+                            row[i] = v * keep
+            elif fraction >= 1.0:
+                for row in self.sketch.rows:
+                    for i in range(len(row)):
+                        row[i] = 0.0
+            return n
+
+    def entries(self) -> int:
+        """Tracked top-K chains — the resource accountant's O(1) meter
+        read (sketch rows are its constant `fixed_bytes` floor)."""
+        with self._mu:
+            return len(self._chains)
 
     # -- queries -----------------------------------------------------------
 

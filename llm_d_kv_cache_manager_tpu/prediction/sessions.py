@@ -193,6 +193,7 @@ class SessionTable:
             "mispredicted_blocks": 0,
             "mispredicted_bytes": 0,
             "clamped_gaps": 0,
+            "shed_sessions": 0,
         }
 
     # -- ingest (the Indexer observation seam) -----------------------------
@@ -428,6 +429,28 @@ class SessionTable:
                     rec.pending = None
                     expired += 1
         return expired
+
+    def shed(self, fraction: float) -> int:
+        """Resource-governor hook: evict the `fraction` least-recently-
+        observed sessions, SKIPPING any with an outstanding prefetch —
+        an in-flight prediction's misprediction accounting rides the
+        record, so dropping it would both lose cost evidence and orphan
+        the executor's `note_landed` feedback. Sessions are re-learned
+        from their next turn (as a fresh session, losing only the ETA
+        history). Returns sessions evicted."""
+        fraction = min(max(fraction, 0.0), 1.0)
+        with self._mu:
+            target = int(len(self._by_tail) * fraction)
+            if target <= 0:
+                return 0
+            victims = [
+                tail for tail, rec in self._by_tail.items()
+                if rec.pending is None
+            ][:target]
+            for tail in victims:
+                del self._by_tail[tail]
+            self.stats_counters["shed_sessions"] += len(victims)
+            return len(victims)
 
     # -- queries -----------------------------------------------------------
 

@@ -23,8 +23,21 @@ makes that measurable without network egress:
                 and fan-out/fan-in sub-agent sessions branching off a
                 shared tool prefix (the anticipatory-prefetch bench's
                 best-case replay).
+- `adversarial` — the resource governor's stress diet: unique-prompt
+                floods, session explosions, and churn storms with a
+                deterministic pod join/leave schedule.
 """
 
+from llm_d_kv_cache_manager_tpu.workloads.adversarial import (  # noqa: F401
+    ChurnStormConfig,
+    FloodConfig,
+    SessionExplosionConfig,
+    churn_schedule,
+    generate_churn_storm,
+    generate_flood,
+    generate_session_explosion,
+    transient_pod_name,
+)
 from llm_d_kv_cache_manager_tpu.workloads.agentic import (  # noqa: F401
     AgenticConfig,
     is_root,
@@ -66,7 +79,15 @@ from llm_d_kv_cache_manager_tpu.workloads.trace import (  # noqa: F401
 
 __all__ = [
     "AgenticConfig",
+    "ChurnStormConfig",
+    "FloodConfig",
     "GeoConfig",
+    "SessionExplosionConfig",
+    "churn_schedule",
+    "generate_churn_storm",
+    "generate_flood",
+    "generate_session_explosion",
+    "transient_pod_name",
     "MultiTenantConfig",
     "ShareGPTConfig",
     "diurnal_weights",

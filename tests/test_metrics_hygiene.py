@@ -47,9 +47,15 @@ from llm_d_kv_cache_manager_tpu.obs import spans as obs_spans
 #               AUTOPILOT_DIRECTIONS: up/down/revert)
 #   knob      — autopilot knob names (autopilot/knobs.py AUTOPILOT_KNOBS
 #               tuple — policy surfaces, never traffic)
+#   structure — resource-governor structure names (resourcegov/
+#               accountant.py RESOURCE_STRUCTURES tuple — one per metered
+#               subsystem, never traffic)
+#   level     — resource-governor pressure levels (resourcegov/governor.py
+#               RESOURCE_LEVELS: ok/elevated/critical)
 ALLOWED_LABELS = {
     "state", "kind", "backend", "op", "plane", "stage", "phase", "region",
     "source", "objective", "window", "rule", "direction", "knob",
+    "structure", "level",
 }
 # The plane vocabulary is committed in code (obs/spans.py) — the walk and
 # the span-inventory scan both pin against the same tuple, so a new plane
@@ -152,6 +158,13 @@ def test_collectors_exist():
     # C arena handed back to the pure-Python path. A plain counter — no
     # labels — so it rides the namespace/label walks for free.
     assert "native_fallbacks" in collectors
+    # Resource governor (resourcegov/): per-structure accounted bytes,
+    # pressure-level transitions, and shed events by structure — both
+    # labels from fixed code-defined vocabularies (RESOURCE_STRUCTURES /
+    # RESOURCE_LEVELS), inside the walk so the bounds stay enforced.
+    assert "resource_accounted_bytes" in collectors
+    assert "resource_pressure_transitions" in collectors
+    assert "resource_shed_events" in collectors
 
 
 def test_prefetch_drop_source_values_are_code_defined():
@@ -304,6 +317,7 @@ def test_autopilot_label_values_are_code_defined():
         "placement.k_replicas", "placement.max_jobs_per_tick",
         "prediction.max_jobs_per_tick", "transfer.hedge_delay_floor_s",
         "admission.max_queue_depth", "antientropy.interval_s",
+        "resourcegov.budget_mb",
     }
     metrics.register_metrics()
     for metric in REGISTRY.collect():
@@ -325,6 +339,43 @@ def test_autopilot_label_values_are_code_defined():
                 if knob is not None:
                     assert knob in AUTOPILOT_KNOBS, (
                         f"unexpected autopilot knob {knob!r}"
+                    )
+
+
+def test_resource_label_values_are_code_defined():
+    """The resource-governor accounted-bytes gauge and shed-event counter
+    carry only the fixed `structure` vocabulary, and the pressure
+    transition counter only the fixed `level` vocabulary — metered
+    subsystem identity and controller state, never traffic."""
+    from llm_d_kv_cache_manager_tpu.resourcegov import (
+        RESOURCE_LEVELS,
+        RESOURCE_STRUCTURES,
+    )
+
+    assert set(RESOURCE_STRUCTURES) == {
+        "obs", "sessions", "popularity", "chain_memo", "prefix_store",
+        "index", "fleethealth", "load", "antientropy", "transfer_peers",
+        "negative_cache",
+    }
+    assert set(RESOURCE_LEVELS) == {"ok", "elevated", "critical"}
+    metrics.register_metrics()
+    for metric in REGISTRY.collect():
+        if metric.name in (
+            "kvcache_resource_accounted_bytes",
+            "kvcache_resource_shed_events",
+        ):
+            for sample in metric.samples:
+                structure = sample.labels.get("structure")
+                if structure is not None:
+                    assert structure in RESOURCE_STRUCTURES, (
+                        f"unexpected resource structure {structure!r}"
+                    )
+        elif metric.name == "kvcache_resource_pressure_transitions":
+            for sample in metric.samples:
+                level = sample.labels.get("level")
+                if level is not None:
+                    assert level in RESOURCE_LEVELS, (
+                        f"unexpected pressure level {level!r}"
                     )
 
 

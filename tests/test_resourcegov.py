@@ -1,0 +1,805 @@
+"""Resource governor suite (resourcegov/): accounting, pressure,
+shedding, reaping.
+
+Covers the three planes the module docstrings promise:
+
+- accountant: opt-in meter registry, exception-guarded reads, byte
+  estimate math, shed/restore delegation.
+- governor: pressure state machine with hysteresis, shed-ladder
+  priority order and per-rung cooldowns, critical-only rungs, bounded
+  journal, last-shed-first restore, read-only status().
+- reaper + owners: departure fan-out, DP-rank folding in the trackers'
+  forget_pod hooks, transfer-peer idle TTL vs open-breaker protection.
+
+Plus the two properties the ladder is SAFE by (pinned here by contract
+with accountant.Meter's docstring): a shed never drops in-flight state,
+and a full shed-to-floor followed by a re-warm reproduces bit-identical
+scores — shedding is indistinguishable from running at a smaller cache.
+"""
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.resourcegov import (
+    LEVEL_CRITICAL,
+    LEVEL_ELEVATED,
+    LEVEL_OK,
+    Meter,
+    DepartureReaper,
+    ResourceAccountant,
+    ResourceGovConfig,
+    ResourceGovernor,
+    SHED_LADDER,
+    ShedRung,
+    shed_lru_oldest,
+)
+
+pytestmark = pytest.mark.resourcegov
+
+MB = 1024.0 * 1024.0
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# Accountant
+# ---------------------------------------------------------------------------
+
+
+class TestAccountant:
+    def test_register_and_names(self):
+        acc = ResourceAccountant()
+        acc.register(Meter("obs", lambda: 3, bytes_per_entry=10.0))
+        acc.register(Meter("sessions", lambda: 2, bytes_per_entry=5.0))
+        assert acc.names() == ["obs", "sessions"]
+        assert acc.get("obs") is not None
+        assert acc.get("nope") is None
+
+    def test_duplicate_registration_raises(self):
+        acc = ResourceAccountant()
+        acc.register(Meter("obs", lambda: 0))
+        with pytest.raises(ValueError, match="already registered"):
+            acc.register(Meter("obs", lambda: 0))
+
+    def test_unknown_structure_name_raises(self):
+        with pytest.raises(ValueError, match="unknown structure"):
+            Meter("bogus", lambda: 0)
+
+    def test_negative_estimates_raise(self):
+        with pytest.raises(ValueError):
+            Meter("obs", lambda: 0, bytes_per_entry=-1.0)
+        with pytest.raises(ValueError):
+            Meter("obs", lambda: 0, fixed_bytes=-1.0)
+
+    def test_byte_estimate_math(self):
+        m = Meter("popularity", lambda: 7, bytes_per_entry=8.0,
+                  fixed_bytes=100.0)
+        assert m.read() == {"entries": 7, "bytes": 156.0}
+        # An explicit nbytes callable wins over the linear estimate.
+        m2 = Meter("index", lambda: 7, bytes_per_entry=8.0,
+                   nbytes=lambda: 4242)
+        assert m2.read()["bytes"] == 4242.0
+
+    def test_read_is_exception_guarded(self):
+        def boom():
+            raise RuntimeError("mid-teardown")
+
+        m = Meter("obs", boom, bytes_per_entry=8.0, fixed_bytes=64.0)
+        # entries guard: reads as empty (the fixed floor still counts —
+        # the sketch exists whether or not any entry does).
+        assert m.read() == {"entries": 0, "bytes": 64.0}
+        m2 = Meter("obs", lambda: 3, nbytes=boom)
+        assert m2.read() == {"entries": 3, "bytes": 0.0}
+
+    def test_snapshot_and_total(self):
+        acc = ResourceAccountant()
+        acc.register(Meter("obs", lambda: 4, bytes_per_entry=10.0))
+        acc.register(Meter("load", lambda: 2, bytes_per_entry=100.0))
+        snap = acc.snapshot()
+        assert snap["obs"]["bytes"] == 40.0
+        assert snap["load"]["bytes"] == 200.0
+        assert acc.total_bytes() == 240.0
+
+    def test_shed_absent_hookless_and_failing_all_return_zero(self):
+        acc = ResourceAccountant()
+        acc.register(Meter("load", lambda: 5))  # no shed hook
+
+        def bad_shed(fraction):
+            raise RuntimeError("owner broke")
+
+        acc.register(Meter("obs", lambda: 5, shed=bad_shed))
+        assert acc.shed("sessions", 0.5) == 0  # never registered
+        assert acc.shed("load", 0.5) == 0      # hook-less
+        assert acc.shed("obs", 0.5) == 0       # hook threw: guarded
+        assert acc.stats_counters == {"sheds": 0, "entries_shed": 0}
+
+    def test_shed_delegates_and_counts(self):
+        entries = [10]
+
+        def shed(fraction):
+            dropped = int(entries[0] * fraction)
+            entries[0] -= dropped
+            return dropped
+
+        acc = ResourceAccountant()
+        acc.register(Meter("obs", lambda: entries[0], shed=shed))
+        assert acc.shed("obs", 0.5) == 5
+        assert entries[0] == 5
+        assert acc.stats_counters == {"sheds": 1, "entries_shed": 5}
+
+    def test_restore_step_guards(self):
+        acc = ResourceAccountant()
+        acc.register(Meter("load", lambda: 0))  # no restore hook
+
+        def bad_restore():
+            raise RuntimeError("no")
+
+        acc.register(Meter("obs", lambda: 0, restore=bad_restore))
+        assert acc.restore_step("sessions") is False
+        assert acc.restore_step("load") is False
+        assert acc.restore_step("obs") is False
+
+    def test_shed_lru_oldest_drops_oldest_fraction(self):
+        from llm_d_kv_cache_manager_tpu.utils.lru import LRUCache
+
+        cache = LRUCache(100)
+        for i in range(10):
+            cache.add(i, i)
+        assert shed_lru_oldest(cache, 0.4) == 4
+        # keys() is oldest-first: 0..3 gone, 4..9 kept in order.
+        assert cache.keys() == [4, 5, 6, 7, 8, 9]
+        assert shed_lru_oldest(cache, 0.0) == 0
+
+
+# ---------------------------------------------------------------------------
+# Governor: pressure state machine + ladder
+# ---------------------------------------------------------------------------
+
+
+def _gov(budget_mb=1.0, meters=(), **cfg_kw):
+    """Governor over an accountant pre-loaded with `meters`."""
+    acc = ResourceAccountant()
+    for meter in meters:
+        acc.register(meter)
+    clk = Clock()
+    gov = ResourceGovernor(
+        acc,
+        ResourceGovConfig(budget_mb=budget_mb, min_interval_s=0.0, **cfg_kw),
+        clock=clk,
+    )
+    return gov, acc, clk
+
+
+class TestGovernorConfig:
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ResourceGovConfig(budget_mb=0.0)
+        with pytest.raises(ValueError, match="hysteresis"):
+            ResourceGovConfig(recover_frac=0.9, elevated_frac=0.85)
+        with pytest.raises(ValueError):
+            ResourceGovConfig(elevated_frac=0.9, critical_frac=0.85)
+        with pytest.raises(ValueError):
+            ResourceGovConfig(journal_len=0)
+
+    def test_rung_validation(self):
+        with pytest.raises(ValueError, match="unknown rung"):
+            ShedRung("bogus", 0.5)
+        with pytest.raises(ValueError):
+            ShedRung("obs", 0.0)
+        with pytest.raises(ValueError):
+            ShedRung("obs", 1.5)
+
+    def test_default_ladder_shape(self):
+        """The committed priority order: cheapest evidence first, the
+        index last and only at critical (docs/architecture.md table)."""
+        assert [r.structure for r in SHED_LADDER] == [
+            "obs", "sessions", "popularity", "chain_memo",
+            "prefix_store", "index",
+        ]
+        assert [r.critical_only for r in SHED_LADDER] == [
+            False, False, False, False, False, True,
+        ]
+
+
+class TestPressureStateMachine:
+    def test_levels_and_hysteresis(self):
+        # One hook-less meter: the state machine moves, nothing sheds.
+        entries = [0]
+        gov, _, clk = _gov(meters=[
+            Meter("load", lambda: entries[0], bytes_per_entry=1.0),
+        ])
+        entries[0] = int(0.5 * MB)
+        clk.t = 1.0
+        assert gov.tick() is None
+        assert gov.level == LEVEL_OK
+
+        entries[0] = int(0.90 * MB)
+        clk.t = 2.0
+        out = gov.tick()
+        assert gov.level == LEVEL_ELEVATED
+        assert out["actions"] == [{"transition": LEVEL_ELEVATED}]
+
+        entries[0] = int(0.96 * MB)
+        clk.t = 3.0
+        gov.tick()
+        assert gov.level == LEVEL_CRITICAL
+
+        # Inside the hysteresis band (recover 0.70 .. elevated 0.85):
+        # critical relaxes to elevated but never straight to ok.
+        entries[0] = int(0.75 * MB)
+        clk.t = 4.0
+        gov.tick()
+        assert gov.level == LEVEL_ELEVATED
+
+        # Still in the band: elevated holds (no boundary flapping).
+        entries[0] = int(0.80 * MB)
+        clk.t = 5.0
+        gov.tick()
+        assert gov.level == LEVEL_ELEVATED
+
+        # Below recover_frac: home.
+        entries[0] = int(0.5 * MB)
+        clk.t = 6.0
+        gov.tick()
+        assert gov.level == LEVEL_OK
+        assert gov.stats_counters["transitions"] == 4
+        kinds = [entry[1] for entry in gov.journal()]
+        assert kinds == ["level"] * 4
+
+    def test_min_interval_rate_limits_ticks(self):
+        gov, _, clk = _gov(meters=[Meter("load", lambda: 0)])
+        gov.config.min_interval_s = 1.0
+        clk.t = 10.0
+        gov.tick()
+        clk.t = 10.5
+        assert gov.tick() is None
+        assert gov.stats_counters["ticks"] == 1
+        clk.t = 11.0
+        gov.tick()
+        assert gov.stats_counters["ticks"] == 2
+
+    def test_pressure_signal_is_last_tick_reading(self):
+        entries = [int(0.5 * MB)]
+        gov, _, clk = _gov(meters=[
+            Meter("load", lambda: entries[0], bytes_per_entry=1.0),
+        ])
+        assert gov.pressure() == 0.0  # never ticked
+        clk.t = 1.0
+        gov.tick()
+        assert gov.pressure() == pytest.approx(0.5)
+
+    def test_status_never_actuates(self):
+        entries = [int(2.0 * MB)]  # way over budget
+        shed_calls = []
+        gov, _, _ = _gov(meters=[
+            Meter("obs", lambda: entries[0], bytes_per_entry=1.0,
+                  shed=lambda f: shed_calls.append(f) or 0),
+        ])
+        doc = gov.status()
+        assert doc["pressure"] == pytest.approx(2.0)
+        assert doc["level"] == LEVEL_OK  # status is a read; tick writes
+        assert shed_calls == []
+        assert gov.journal() == []
+        assert doc["ladder"][0] == {
+            "structure": "obs", "fraction": 0.50, "critical_only": False,
+        }
+
+
+def _counting_meter(name, entries, bytes_per_entry=1.0, log=None):
+    """Meter over a 1-element entries list with a fractional shed hook."""
+    holder = [entries]
+
+    def shed(fraction):
+        dropped = int(holder[0] * fraction)
+        holder[0] -= dropped
+        if log is not None:
+            log.append(name)
+        return dropped
+
+    meter = Meter(name, lambda: holder[0], bytes_per_entry=bytes_per_entry,
+                  shed=shed)
+    return meter, holder
+
+
+class TestShedLadder:
+    def test_one_rung_per_elevated_tick_in_priority_order(self):
+        log = []
+        obs, obs_n = _counting_meter("obs", 1000, 100.0, log)
+        ses, ses_n = _counting_meter("sessions", 1000, 800.0, log)
+        gov, _, clk = _gov(meters=[obs, ses], cooldown_s=10.0)
+        # 0.9 MB total: elevated, never critical.
+        clk.t = 1.0
+        out = gov.tick()
+        assert gov.level == LEVEL_ELEVATED
+        assert log == ["obs"]  # the first rung only
+        assert obs_n[0] == 500 and ses_n[0] == 1000
+        assert out["actions"][-1]["shed"] == "obs"
+
+        # Next tick: obs is in cooldown, the ladder moves down a rung.
+        clk.t = 2.0
+        gov.tick()
+        assert log == ["obs", "sessions"]
+        assert ses_n[0] == 750
+
+    def test_rung_cooldown_blocks_refire(self):
+        log = []
+        obs, _ = _counting_meter("obs", 10_000, 200.0, log)
+        gov, _, clk = _gov(meters=[obs], cooldown_s=10.0)
+        clk.t = 1.0
+        gov.tick()
+        clk.t = 2.0
+        gov.tick()  # inside obs's cooldown, nothing else to shed
+        assert log == ["obs"]
+        clk.t = 11.0
+        gov.tick()  # cooldown over: the rung may fire again
+        assert log == ["obs", "obs"]
+
+    def test_critical_only_rung_never_fires_at_elevated(self):
+        log = []
+        idx, idx_n = _counting_meter("index", 1000, 950.0, log)
+        gov, _, clk = _gov(meters=[idx], cooldown_s=0.0)
+        clk.t = 1.0
+        gov.tick()
+        assert gov.level == LEVEL_ELEVATED
+        assert log == []  # the index is the product: elevated spares it
+        idx_n[0] = 1100  # ~1.0 MB: critical
+        clk.t = 2.0
+        gov.tick()
+        assert gov.level == LEVEL_CRITICAL
+        assert log == ["index"]
+
+    def test_critical_walks_ladder_until_under_budget(self):
+        log = []
+        obs, obs_n = _counting_meter("obs", 4000, 200.0, log)
+        ses, ses_n = _counting_meter("sessions", 4000, 200.0, log)
+        gov, _, clk = _gov(meters=[obs, ses], cooldown_s=0.0)
+        # 1.6 MB total: one obs rung (-0.4 MB) is not enough; the
+        # critical walk keeps going down the ladder in one tick.
+        clk.t = 1.0
+        gov.tick()
+        assert log == ["obs", "sessions"]
+        assert (obs_n[0] * 200.0 + ses_n[0] * 200.0) <= MB
+
+    def test_empty_structures_are_skipped(self):
+        log = []
+        obs, _ = _counting_meter("obs", 0, 1.0, log)
+        ses, _ = _counting_meter("sessions", 10_000, 100.0, log)
+        gov, _, clk = _gov(meters=[obs, ses], cooldown_s=0.0)
+        clk.t = 1.0
+        gov.tick()
+        assert log == ["sessions"]  # nothing to shed in obs: no actuation
+
+    def test_journal_is_bounded(self):
+        obs, obs_n = _counting_meter("obs", 1_000_000, 10.0)
+        gov, _, clk = _gov(meters=[obs], cooldown_s=0.0, journal_len=4)
+        for i in range(1, 12):
+            obs_n[0] = 1_000_000  # re-inflate: pressure holds
+            clk.t = float(i)
+            gov.tick()
+        assert len(gov.journal()) == 4
+
+    def test_shed_events_reach_the_metrics_walk(self):
+        """A governor shed lands on the bounded-label shed-event counter
+        (the hygiene walk in test_metrics_hygiene.py pins the label
+        vocabulary; this pins that actuations actually reach it)."""
+        from prometheus_client import REGISTRY
+
+        from llm_d_kv_cache_manager_tpu.metrics import collector as metrics
+
+        metrics.register_metrics()
+
+        def sample_value():
+            for metric in REGISTRY.collect():
+                if metric.name == "kvcache_resource_shed_events":
+                    for s in metric.samples:
+                        if (
+                            s.name.endswith("_total")
+                            and s.labels.get("structure") == "obs"
+                        ):
+                            return s.value
+            return 0.0
+
+        before = sample_value()
+        obs, _ = _counting_meter("obs", 100_000, 100.0)
+        gov, _, clk = _gov(meters=[obs], cooldown_s=0.0)
+        clk.t = 1.0
+        gov.tick()
+        assert sample_value() == before + 1
+
+
+class TestRestore:
+    def test_restore_walks_last_shed_first_one_step_per_ok_tick(self):
+        steps = []
+
+        def make_restore(name, n_steps):
+            remaining = [n_steps]
+
+            def restore():
+                steps.append(name)
+                remaining[0] -= 1
+                return remaining[0] > 0
+
+            return restore
+
+        ps, ps_n = _counting_meter("prefix_store", 6000, 100.0)
+        idx, idx_n = _counting_meter("index", 6000, 100.0)
+        ps.restore = make_restore("prefix_store", 2)
+        idx.restore = make_restore("index", 2)
+        gov, _, clk = _gov(meters=[ps, idx], cooldown_s=0.0)
+        clk.t = 1.0
+        gov.tick()  # critical: both rungs shed, both queue for restore
+        assert gov.level == LEVEL_CRITICAL
+        assert gov.status()["restore_pending"] == ["prefix_store", "index"]
+
+        ps_n[0] = idx_n[0] = 0  # pressure collapses
+        clk.t = 2.0
+        gov.tick()  # back to ok + first restore step
+        assert gov.level == LEVEL_OK
+        # The index walks home before anything re-inflates under it.
+        assert steps == ["index"]
+        clk.t = 3.0
+        gov.tick()
+        clk.t = 4.0
+        gov.tick()
+        clk.t = 5.0
+        gov.tick()
+        assert steps == ["index", "index", "prefix_store", "prefix_store"]
+        assert gov.status()["restore_pending"] == []
+        assert gov.stats_counters["restore_steps"] == 4
+
+
+class TestAutopilotKnob:
+    def test_budget_published_with_bounds(self):
+        from llm_d_kv_cache_manager_tpu.autopilot.knobs import (
+            KNOB_RESOURCEGOV_BUDGET,
+            KnobRegistry,
+        )
+
+        gov, _, _ = _gov(budget_mb=64.0)
+        registry = KnobRegistry()
+        gov.register_knobs(registry)
+        knob = registry.get(KNOB_RESOURCEGOV_BUDGET)
+        assert knob is not None
+        assert knob.spec.floor == 32.0
+        assert knob.spec.ceiling == 256.0
+        # The knob actuates the live config (the autopilot may trade
+        # memory for hit-rate SLO, inside the operator's bounds).
+        assert knob.nudge(knob.spec.max_step) == 8.0
+        assert gov.config.budget_mb == 72.0
+        assert gov.budget_bytes == 72.0 * MB
+
+
+# ---------------------------------------------------------------------------
+# Departure reaping
+# ---------------------------------------------------------------------------
+
+
+class TestDepartureReaper:
+    def test_duplicate_hook_raises(self):
+        reaper = DepartureReaper()
+        reaper.register("load", lambda pod: 0)
+        with pytest.raises(ValueError, match="already registered"):
+            reaper.register("load", lambda pod: 0)
+
+    def test_fanout_counts_and_error_isolation(self):
+        rows = {"pod-1": 3}
+
+        def forget_ok(pod):
+            return rows.pop(pod, 0)
+
+        def forget_boom(pod):
+            raise RuntimeError("broken structure")
+
+        clk = Clock(5.0)
+        reaper = DepartureReaper(clock=clk)
+        reaper.register("fleethealth", forget_ok)
+        reaper.register("load", forget_boom)
+        out = reaper.reap("pod-1")
+        # The failing hook is isolated: counted, zeroed, never re-raised.
+        assert out == {"fleethealth": 3, "load": 0}
+        assert reaper.stats_counters == {
+            "reaps": 1, "rows_removed": 3, "errors": 1,
+        }
+        # Idempotent: leave + stale-quarantine can both fire.
+        assert reaper.reap("pod-1") == {"fleethealth": 0, "load": 0}
+        doc = reaper.status()
+        assert doc["hooks"] == ["fleethealth", "load"]
+        assert doc["recent"][0] == [5.0, "pod-1", 3]
+
+
+class TestForgetPodFoldsDpRanks:
+    def test_fleethealth_forgets_all_ranks_and_transfer_peers(self):
+        from llm_d_kv_cache_manager_tpu.fleethealth import (
+            FleetHealthConfig,
+            FleetHealthTracker,
+        )
+
+        clk = Clock()
+        tracker = FleetHealthTracker(FleetHealthConfig(), clock=clk)
+        tracker.observe_batch("pod-1@dp0", "kv@", 1, 0.0)
+        tracker.observe_batch("pod-1@dp1", "kv@", 1, 0.0)
+        tracker.observe_batch("pod-2@dp0", "kv@", 1, 0.0)
+        tracker.observe_transfer_breaker("pod-1:8001", "closed", "open")
+        tracker.observe_transfer_breaker("pod-2:8001", "closed", "open")
+        assert tracker.entries() == 5
+        # Any rank-qualified form folds onto the base identity; the
+        # pod's transfer-peer rows (host == base) go with it.
+        assert tracker.forget_pod("pod-1@dp1") == 3
+        assert tracker.entries() == 2
+        assert tracker.forget_pod("pod-1") == 0  # idempotent
+
+    def test_load_tracker_folds_ranks_to_one_row(self):
+        from llm_d_kv_cache_manager_tpu.fleethealth.load import (
+            PodLoadTracker,
+        )
+
+        tracker = PodLoadTracker(clock=Clock())
+        tracker.report("pod-1@dp0", queue_depth=3)
+        tracker.report("pod-1@dp1", queue_depth=4)  # same base row
+        tracker.report("pod-2", queue_depth=1)
+        assert tracker.entries() == 2
+        assert tracker.forget_pod("pod-1@dp3") == 1
+        assert tracker.entries() == 1
+        assert tracker.forget_pod("pod-1") == 0
+
+    def test_antientropy_forget_resets_trust_to_unseen(self):
+        from llm_d_kv_cache_manager_tpu.antientropy import (
+            AntiEntropyTracker,
+        )
+
+        tracker = AntiEntropyTracker()
+        tracker.observe_fetch_miss("pod-1@dp0", blocks=4)
+        assert tracker.accuracy("pod-1") < 1.0
+        assert tracker.forget_pod("pod-1@dp0") == 1
+        # A pod that comes back is a new pod: unseen default accuracy.
+        assert tracker.accuracy("pod-1") == 1.0
+        assert tracker.forget_pod("pod-1") == 0
+
+
+class TestTransferPeerBounding:
+    def _client(self, ttl, threshold=0):
+        from llm_d_kv_cache_manager_tpu.kv_connectors.connector import (
+            TransferClient,
+            TransferClientConfig,
+        )
+
+        clk = Clock()
+        client = TransferClient(
+            TransferClientConfig(
+                peer_idle_ttl_s=ttl,
+                breaker_failure_threshold=threshold,
+                breaker_cooldown_s=3600.0,
+            ),
+            clock=clk,
+        )
+        return client, clk
+
+    def test_idle_peers_swept_after_ttl(self):
+        client, clk = self._client(ttl=5.0)
+        client.peer_state("10.0.0.1", 7)
+        clk.t = 3.0
+        client.peer_state("10.0.0.2", 7)  # younger row
+        assert client.entries() == 2
+        clk.t = 6.0
+        assert client.sweep_idle() == 1  # only the first crossed the TTL
+        assert client.entries() == 1
+        assert client.stats["idle_dropped_peers"] == 1
+        assert client.status()["peer_idle_ttl_s"] == 5.0
+
+    def test_ttl_zero_disables_sweep(self):
+        client, clk = self._client(ttl=0.0)
+        client.peer_state("10.0.0.1", 7)
+        clk.t = 1e9
+        assert client.sweep_idle() == 0
+        assert client.entries() == 1
+
+    def test_open_breaker_rows_survive_idle_sweep(self):
+        """Property: a shed/sweep never drops in-flight protection. An
+        open breaker IS live state — dropping it would reset the peer to
+        trusted mid-outage."""
+        client, clk = self._client(ttl=5.0, threshold=1)
+        client.note_result("10.0.0.1", 7, ok=False, latency_s=0.1)
+        state = client.peer_state("10.0.0.1", 7)
+        assert state.breaker.state == "open"
+        clk.t = 1000.0
+        assert client.sweep_idle() == 0
+        assert client.entries() == 1
+
+    def test_forget_host_removes_regardless_of_breaker(self):
+        client, clk = self._client(ttl=5.0, threshold=1)
+        client.note_result("10.0.0.1", 7, ok=False, latency_s=0.1)
+        client.note_result("10.0.0.2", 7, ok=True, latency_s=0.1)
+        assert client.forget_host("10.0.0.1") == 1  # open breaker too:
+        assert client.entries() == 1                # the pod LEFT
+        assert client.stats["reaped_peers"] == 1
+
+
+# ---------------------------------------------------------------------------
+# The two safety properties
+# ---------------------------------------------------------------------------
+
+
+class TestShedPreservesInFlightState:
+    def test_session_shed_skips_outstanding_prefetches(self):
+        from llm_d_kv_cache_manager_tpu.prediction.sessions import (
+            SessionTable,
+        )
+
+        clk = Clock()
+        table = SessionTable(clock=clk)
+        for h in (101, 202, 303):
+            table.observe_route([h], now=clk.t)
+        assert table.sessions() == 3
+        # One session has a prefetch in flight: its record carries the
+        # misprediction accounting and the executor's note_landed target.
+        rec = table.record_by_tail(202)
+        table.note_prefetch(rec, "pod-1", now=clk.t)
+        assert table.shed(1.0) == 2  # everything BUT the in-flight one
+        assert table.sessions() == 1
+        survivor = table.record_by_tail(202)
+        assert survivor is not None
+        assert survivor.pending is not None
+        assert survivor.pending.pod == "pod-1"
+        # Once the prediction resolves/expires, the record is fair game.
+        survivor.pending = None
+        assert table.shed(1.0) == 1
+        assert table.sessions() == 0
+
+
+class TestShedRewarmBitIdentity:
+    def test_full_shed_then_rewarm_reproduces_scores(self):
+        """Shed to the floor, re-advertise the same placements, and the
+        scorer must produce bit-identical scores: a shed is
+        indistinguishable from having run at a smaller index."""
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+            InMemoryIndex,
+            InMemoryIndexConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.key import (
+            Key,
+            PodEntry,
+        )
+        from llm_d_kv_cache_manager_tpu.kvcache.scorer import (
+            LongestPrefixScorer,
+        )
+
+        keys = [Key("m", i) for i in range(8)]
+        engine_keys = [Key("m", 1000 + i) for i in range(8)]
+
+        def warm(index):
+            # pod-a holds the full chain in HBM; pod-b half of it in DRAM.
+            index.add(engine_keys, keys, [PodEntry("pod-a", "hbm")])
+            index.add(engine_keys[:4], keys[:4], [PodEntry("pod-b", "dram")])
+
+        scorer = LongestPrefixScorer({"hbm": 2.0, "dram": 1.0})
+        index = InMemoryIndex(InMemoryIndexConfig(size=64, pod_cache_size=4))
+        warm(index)
+        before = scorer.score(keys, index.lookup(keys, set()))
+        assert before == {"pod-a": 16.0, "pod-b": 4.0}
+
+        dropped = index.shed(1.0)
+        assert dropped > 0
+        assert index.lookup(keys, set()) == {}  # floor: nothing scores
+
+        warm(index)  # pods re-advertise (re-derivable state, never truth)
+        after = scorer.score(keys, index.lookup(keys, set()))
+        assert after == before
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: /resource/status + the /readyz resource section
+# ---------------------------------------------------------------------------
+
+
+class TestResourceHttpSurface:
+    def _service(self, resourcegov):
+        pytest.importorskip("aiohttp")
+        from tests.conftest import TEST_MODEL_NAME, TEST_TOKENIZER_JSON
+        from llm_d_kv_cache_manager_tpu.api.http_service import (
+            ScoringService,
+        )
+        from llm_d_kv_cache_manager_tpu.kvcache.indexer import (
+            Indexer,
+            IndexerConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+            TokenProcessorConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.tokenization.pool import (
+            TokenizationPool,
+            TokenizersPoolConfig,
+        )
+
+        indexer = Indexer(
+            config=IndexerConfig(
+                token_processor_config=TokenProcessorConfig(block_size=4),
+            ),
+            tokenization_pool=TokenizationPool(
+                TokenizersPoolConfig(
+                    workers=1,
+                    local_tokenizer_files={
+                        TEST_MODEL_NAME: TEST_TOKENIZER_JSON
+                    },
+                ),
+            ),
+        )
+        indexer.run()
+        env = {
+            "zmq_endpoint": "tcp://*:0",
+            "zmq_topic": "kv@",
+            "pool_concurrency": 1,
+            "hash_seed": "",
+            "block_size": 4,
+            "http_port": 0,
+            "enable_metrics": False,
+            "resourcegov": resourcegov,
+            "resourcegov_budget_mb": 64.0,
+        }
+        return ScoringService(env, indexer=indexer)
+
+    def test_resource_status_and_readyz_section(self):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        service = self._service(resourcegov=True)
+
+        async def run():
+            async with TestClient(TestServer(service.make_app())) as client:
+                service.start(with_subscriber=False)
+                resp = await client.get("/resource/status")
+                assert resp.status == 200
+                doc = await resp.json()
+                assert doc["level"] == "ok"
+                assert doc["budget_mb"] == 64.0
+                assert "obs" in doc["meters"]
+                assert "index" in doc["meters"]
+                # The always-on hooks (load/antientropy join them when
+                # their trackers are enabled in this process).
+                assert {"fleethealth", "transfer"} <= set(
+                    doc["reaper"]["hooks"]
+                )
+                # Critical is degraded-but-ready: the section rides
+                # /readyz without ever gating it.
+                resp = await client.get("/readyz")
+                assert resp.status == 200
+                data = await resp.json()
+                assert data["resource"]["level"] == "ok"
+
+        try:
+            asyncio.run(run())
+        finally:
+            service.stop()
+
+    def test_governor_off_keeps_surface_quiet(self):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        service = self._service(resourcegov=False)
+        assert service.resourcegov is None
+        assert service.reaper is not None  # the leak fix runs either way
+
+        async def run():
+            async with TestClient(TestServer(service.make_app())) as client:
+                service.start(with_subscriber=False)
+                resp = await client.get("/resource/status")
+                assert resp.status == 400  # explicit: governor disabled
+                doc = await resp.json()
+                assert "disabled" in doc["error"]
+                assert "fleethealth" in doc["reaper"]["hooks"]
+                # Until the reaper has actually fanned out a departure,
+                # the readyz section stays out of the payload's way.
+                resp = await client.get("/readyz")
+                data = await resp.json()
+                assert data["resource"] is None
+
+        try:
+            asyncio.run(run())
+        finally:
+            service.stop()
